@@ -1,0 +1,35 @@
+"""dpgo_tpu — a TPU-native distributed pose-graph optimization framework.
+
+Built from scratch with the capabilities of the reference C++ library
+lajoiepy/dpgo (distributed certifiably-correct PGO, T-RO 2021; asynchronous
+parallel distributed PGO, RA-L 2020), re-designed for TPU: agents are shards
+of a JAX device mesh, the Riemannian block-coordinate descent inner loop is
+an XLA-compiled ``lax.while_loop``, sparse connection-Laplacian products are
+edge-list segment-sums, and neighbor pose exchange is an ICI/DCN collective.
+"""
+
+from .config import (
+    AgentParams,
+    RobustCostParams,
+    RobustCostType,
+    ROptAlg,
+    Schedule,
+    SolverParams,
+)
+from .types import EdgeSet, Measurements, edge_set_from_measurements
+from .utils.g2o import read_g2o
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AgentParams",
+    "RobustCostParams",
+    "RobustCostType",
+    "ROptAlg",
+    "Schedule",
+    "SolverParams",
+    "EdgeSet",
+    "Measurements",
+    "edge_set_from_measurements",
+    "read_g2o",
+]

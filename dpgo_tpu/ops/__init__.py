@@ -1,0 +1,1 @@
+from . import averaging  # noqa: F401

@@ -1,0 +1,83 @@
+"""Robust cost weight functions.
+
+TPU-native equivalent of reference ``src/DPGO_robust.cpp:23-103``
+(``RobustCost``).  The reference wraps mutable state (GNC ``mu`` and
+iteration counter) in a class; here the weight functions are pure and
+batched — ``mu`` lives in the optimizer state pytree and is advanced
+functionally (``gnc_update_mu``), so the whole GNC outer loop stays inside
+jitted code.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .config import RobustCostParams, RobustCostType
+
+
+def weight(r: jax.Array, params: RobustCostParams, mu: jax.Array | float = 0.0) -> jax.Array:
+    """Weight w(r) in [0, 1] for residual norm ``r`` (elementwise).
+
+    Matches reference ``RobustCost::weight`` (``DPGO_robust.cpp:23-67``) for
+    every supported cost type.  ``mu`` is the GNC control parameter (only
+    used by GNC_TLS).
+    """
+    ct = params.cost_type
+    if ct == RobustCostType.L2:
+        return jnp.ones_like(r)
+    if ct == RobustCostType.L1:
+        return 1.0 / r
+    if ct == RobustCostType.Huber:
+        return jnp.where(r < params.huber_threshold, 1.0, params.huber_threshold / r)
+    if ct == RobustCostType.TLS:
+        return jnp.where(r < params.tls_threshold, 1.0, 0.0)
+    if ct == RobustCostType.GM:
+        a = 1.0 + r * r
+        return 1.0 / (a * a)
+    if ct == RobustCostType.GNC_TLS:
+        # The reference keeps mu as managed internal state so it is always
+        # positive; here it is explicit, so reject a forgotten/zero mu (with
+        # mu=0 every residual would silently map to weight 0).
+        if isinstance(mu, (int, float)) and mu <= 0:
+            raise ValueError("GNC_TLS requires a positive mu (e.g. params.gnc_init_mu)")
+        return gnc_tls_weight(r, mu, params.gnc_barc)
+    raise NotImplementedError(f"weight function for {ct} is not implemented")
+
+
+def gnc_tls_weight(r: jax.Array, mu: jax.Array | float, barc: float) -> jax.Array:
+    """GNC-TLS weight, eq. (14) of the GNC paper (reference ``DPGO_robust.cpp:49-62``).
+
+    w = 0                              if r^2 >= (mu+1)/mu * barc^2
+      = 1                              if r^2 <= mu/(mu+1) * barc^2
+      = sqrt(barc^2 mu (mu+1) / r^2) - mu   otherwise
+    """
+    barc_sq = barc * barc
+    r_sq = r * r
+    upper = (mu + 1.0) / mu * barc_sq
+    lower = mu / (mu + 1.0) * barc_sq
+    # Guard the sqrt against r = 0 in the (unused) middle branch.
+    safe_r_sq = jnp.maximum(r_sq, 1e-30)
+    mid = jnp.sqrt(barc_sq * mu * (mu + 1.0) / safe_r_sq) - mu
+    w = jnp.where(r_sq >= upper, 0.0, jnp.where(r_sq <= lower, 1.0, mid))
+    return jnp.clip(w, 0.0, 1.0)
+
+
+def gnc_update_mu(mu: jax.Array, params: RobustCostParams) -> jax.Array:
+    """One GNC annealing step: mu <- mu_step * mu (reference ``DPGO_robust.cpp:85-103``)."""
+    return mu * params.gnc_mu_step
+
+
+def gnc_init_mu(params: RobustCostParams) -> float:
+    return params.gnc_init_mu
+
+
+def is_weight_converged(w: jax.Array, tol: float = 1e-4) -> jax.Array:
+    """Elementwise: has this edge's GNC weight converged to {0, 1}?
+
+    Reference ``PGOAgent::computeConvergedLoopClosureRatio`` counts weights
+    exactly equal to 0 or 1 (``PGOAgent.cpp:1247-1289``); since the GNC-TLS
+    outer branches return exact constants this tolerance check is equivalent
+    while also being robust to float rounding.
+    """
+    return (w < tol) | (w > 1.0 - tol)

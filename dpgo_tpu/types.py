@@ -1,0 +1,162 @@
+"""Core data containers.
+
+The reference stores measurements as ``std::vector<RelativeSEMeasurement>``
+(``include/DPGO/RelativeSEMeasurement.h:21-89``) and poses as an Eigen matrix
+``r x (d+1)n``.  The TPU-native layout is struct-of-arrays throughout:
+
+* ``Measurements`` — host-side numpy arrays for a batch of relative SE(d)
+  measurements (the full dataset, or one agent's slice).
+* ``EdgeSet`` — the on-device pytree used by all jitted kernels.  Edges index
+  into a pose buffer ``X: [N, r, d+1]`` where each pose block is
+  ``[Y_i | p_i]`` (lifted rotation ``Y_i in St(r, d)``, translation
+  ``p_i in R^r``).  A local problem's buffer is ``concat([local X, neighbor
+  Z])`` so private and inter-agent edges share one code path; gradients are
+  only accumulated for the first ``n_local`` slots.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class Measurements:
+    """A batch of relative SE(d) measurements (host side, numpy).
+
+    Fields mirror ``RelativeSEMeasurement`` (reference
+    ``RelativeSEMeasurement.h:21-89``): edge (r1, p1) -> (r2, p2), rotation
+    ``R``, translation ``t``, precisions ``kappa``/``tau``, GNC ``weight``,
+    and the fixed-inlier flag.
+    """
+
+    d: int
+    num_poses: int  # total number of poses referenced (global indexing)
+    r1: np.ndarray  # [m] uint robot id of tail
+    p1: np.ndarray  # [m] pose index of tail
+    r2: np.ndarray  # [m] robot id of head
+    p2: np.ndarray  # [m] pose index of head
+    R: np.ndarray  # [m, d, d]
+    t: np.ndarray  # [m, d]
+    kappa: np.ndarray  # [m]
+    tau: np.ndarray  # [m]
+    weight: np.ndarray  # [m], GNC weight in [0, 1]
+    is_known_inlier: np.ndarray  # [m] bool
+
+    def __len__(self) -> int:
+        return int(self.r1.shape[0])
+
+    def select(self, idx) -> "Measurements":
+        """A new Measurements containing rows ``idx`` (bool mask or indices)."""
+        return Measurements(
+            d=self.d,
+            num_poses=self.num_poses,
+            r1=self.r1[idx],
+            p1=self.p1[idx],
+            r2=self.r2[idx],
+            p2=self.p2[idx],
+            R=self.R[idx],
+            t=self.t[idx],
+            kappa=self.kappa[idx],
+            tau=self.tau[idx],
+            weight=self.weight[idx],
+            is_known_inlier=self.is_known_inlier[idx],
+        )
+
+    @staticmethod
+    def concatenate(parts: list["Measurements"]) -> "Measurements":
+        assert parts
+        return Measurements(
+            d=parts[0].d,
+            num_poses=max(p.num_poses for p in parts),
+            r1=np.concatenate([p.r1 for p in parts]),
+            p1=np.concatenate([p.p1 for p in parts]),
+            r2=np.concatenate([p.r2 for p in parts]),
+            p2=np.concatenate([p.p2 for p in parts]),
+            R=np.concatenate([p.R for p in parts]),
+            t=np.concatenate([p.t for p in parts]),
+            kappa=np.concatenate([p.kappa for p in parts]),
+            tau=np.concatenate([p.tau for p in parts]),
+            weight=np.concatenate([p.weight for p in parts]),
+            is_known_inlier=np.concatenate([p.is_known_inlier for p in parts]),
+        )
+
+
+class EdgeSet(NamedTuple):
+    """On-device struct-of-arrays edge list (optionally with leading batch dims).
+
+    ``i``/``j`` index the tail/head pose blocks in a pose buffer
+    ``X: [N, r, d+1]``.  ``weight`` is the (mutable) GNC weight; ``mask`` is
+    1.0 for valid edges and 0.0 for padding; ``is_lc`` marks loop closures
+    (only these are ever reweighted by GNC — odometry edges are trusted,
+    reference ``PGOAgent.cpp:1181-1245`` iterates loop closures only);
+    ``fixed_weight`` marks known inliers whose weight is pinned to 1
+    (reference ``RelativeSEMeasurement.h:47``).
+    """
+
+    i: jax.Array  # [E] int32
+    j: jax.Array  # [E] int32
+    R: jax.Array  # [E, d, d]
+    t: jax.Array  # [E, d]
+    kappa: jax.Array  # [E]
+    tau: jax.Array  # [E]
+    weight: jax.Array  # [E]
+    mask: jax.Array  # [E]
+    is_lc: jax.Array  # [E]
+    fixed_weight: jax.Array  # [E]
+
+    @property
+    def d(self) -> int:
+        return self.R.shape[-1]
+
+
+def edge_set_from_measurements(
+    meas: Measurements,
+    tail_index: np.ndarray | None = None,
+    head_index: np.ndarray | None = None,
+    is_lc: np.ndarray | None = None,
+    pad_to: int | None = None,
+    dtype=jnp.float32,
+) -> EdgeSet:
+    """Build an on-device EdgeSet from host measurements.
+
+    By default edges index poses by their global index ``p1``/``p2``
+    (single-buffer, centralized problem).  ``tail_index``/``head_index``
+    override the buffer indices (used by the multi-agent builder to point
+    shared-edge endpoints into the neighbor section of the buffer).
+    """
+    m = len(meas)
+    ti = np.asarray(meas.p1 if tail_index is None else tail_index, np.int32)
+    hi = np.asarray(meas.p2 if head_index is None else head_index, np.int32)
+    if is_lc is None:
+        # Default: an edge is odometry iff same robot and consecutive indices
+        # (partitioning convention of MultiRobotExample.cpp:104-113).
+        is_lc = ~((meas.r1 == meas.r2) & (meas.p1 + 1 == meas.p2))
+    is_lc = np.asarray(is_lc, bool)
+
+    n_pad = (pad_to or m) - m
+    assert n_pad >= 0
+
+    def pad(x, fill=0):
+        if n_pad == 0:
+            return x
+        width = [(0, n_pad)] + [(0, 0)] * (x.ndim - 1)
+        return np.pad(x, width, constant_values=fill)
+
+    d = meas.d
+    return EdgeSet(
+        i=jnp.asarray(pad(ti)),
+        j=jnp.asarray(pad(hi)),
+        R=jnp.asarray(pad(np.broadcast_to(np.eye(d), (m, d, d)) if m == 0 else meas.R), dtype),
+        t=jnp.asarray(pad(meas.t), dtype),
+        kappa=jnp.asarray(pad(meas.kappa), dtype),
+        tau=jnp.asarray(pad(meas.tau), dtype),
+        weight=jnp.asarray(pad(meas.weight), dtype),
+        mask=jnp.asarray(pad(np.ones(m)), dtype),
+        is_lc=jnp.asarray(pad(is_lc.astype(np.float64)), dtype),
+        fixed_weight=jnp.asarray(pad(meas.is_known_inlier.astype(np.float64)), dtype),
+    )

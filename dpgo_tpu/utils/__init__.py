@@ -1,0 +1,1 @@
+from . import g2o, lie  # noqa: F401

@@ -1,0 +1,143 @@
+"""g2o dataset reader.
+
+TPU-native replacement for reference ``read_g2o_file``
+(``src/DPGO_utils.cpp:78-212``) and the multi-robot key decoding
+``key_to_robot_keyframe`` (``src/DPGO_utils.cpp:21-33``).  Parses with
+vectorized numpy over all EDGE lines at once instead of a per-line
+``stringstream`` loop, producing the struct-of-arrays ``Measurements``
+container directly (no per-edge objects).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..types import Measurements
+from .lie import quat_to_rotation, rotation2d
+
+_KEY_BITS = 64
+_CHR_BITS = 8
+_LBL_BITS = 8
+_INDEX_BITS = _KEY_BITS - _CHR_BITS - _LBL_BITS
+_INDEX_MASK = (1 << _INDEX_BITS) - 1
+
+
+def key_to_robot_keyframe(key):
+    """Decode gtsam-style symbol keys: high byte = robot char, low 48 bits = index.
+
+    Vectorized port of reference ``key_to_robot_keyframe``
+    (``DPGO_utils.cpp:21-33``).  Plain small integers decode to robot 0 with
+    index = key.
+    """
+    key = np.asarray(key, dtype=np.uint64)
+    robot = (key >> np.uint64(_INDEX_BITS + _LBL_BITS)) & np.uint64(0xFF)
+    index = key & np.uint64(_INDEX_MASK)
+    return robot.astype(np.int32), index.astype(np.int64)
+
+
+def read_g2o(path: str) -> Measurements:
+    """Parse a .g2o file into a ``Measurements`` batch.
+
+    Supports ``EDGE_SE2`` and ``EDGE_SE3:QUAT``; ``VERTEX_*`` lines only
+    contribute to the pose count, as in the reference (which ignores vertex
+    initial values, ``DPGO_utils.cpp:196-199``).  Precisions follow the
+    reference's information-divergence-minimizing choices
+    (``DPGO_utils.cpp:139-143``, ``184-194``):
+
+    * SE(2): ``tau = 2 / tr(Sigma_t^-1)`` from the 2x2 translation info block,
+      ``kappa = I33`` directly.
+    * SE(3): ``tau = 3 / tr(Sigma_t^-1)``, ``kappa = 3 / (2 tr(Sigma_R^-1))``.
+    """
+    se2_rows: list[list[float]] = []
+    se3_rows: list[list[float]] = []
+    se2_keys: list[tuple[int, int]] = []
+    se3_keys: list[tuple[int, int]] = []
+    num_vertices = 0
+    max_index = -1
+
+    with open(path) as f:
+        for line in f:
+            if not line:
+                continue
+            tok_end = line.find(" ")
+            tag = line[:tok_end]
+            if tag == "EDGE_SE2" or tag == "EDGE_SE3:QUAT":
+                toks = line[tok_end:].split()
+                # Keys must be parsed as ints: gtsam symbol keys exceed 2^53
+                # and would lose their low (index) bits through float64.
+                key = (int(toks[0]), int(toks[1]))
+                vals = [float(x) for x in toks[2:]]
+                if tag == "EDGE_SE2":
+                    se2_keys.append(key)
+                    se2_rows.append(vals)
+                else:
+                    se3_keys.append(key)
+                    se3_rows.append(vals)
+            elif tag.startswith("VERTEX"):
+                num_vertices += 1
+            elif tag:
+                raise ValueError(f"Unrecognized g2o token: {tag!r}")
+
+    if se2_rows and se3_rows:
+        raise ValueError("Mixed SE2/SE3 edges in one file")
+    if not se2_rows and not se3_rows:
+        raise ValueError(f"No edges found in {path}")
+
+    if se3_rows:
+        d = 3
+        rows = np.asarray(se3_rows, dtype=np.float64)
+        keys = np.asarray(se3_keys, dtype=np.uint64)
+        keys1, keys2 = keys[:, 0], keys[:, 1]
+        t = rows[:, 0:3]
+        R = quat_to_rotation(rows[:, 3:7])  # (qx, qy, qz, qw)
+        info = rows[:, 7:28]
+        # Upper-triangular 6x6 info: order I11..I16, I22..I26, I33..I36, I44..I46, I55, I56, I66
+        I11, I12, I13 = info[:, 0], info[:, 1], info[:, 2]
+        I22, I23, I33 = info[:, 6], info[:, 7], info[:, 11]
+        I44, I45, I46 = info[:, 15], info[:, 16], info[:, 17]
+        I55, I56, I66 = info[:, 18], info[:, 19], info[:, 20]
+        TranCov = np.stack(
+            [I11, I12, I13, I12, I22, I23, I13, I23, I33], axis=-1
+        ).reshape(-1, 3, 3)
+        RotCov = np.stack(
+            [I44, I45, I46, I45, I55, I56, I46, I56, I66], axis=-1
+        ).reshape(-1, 3, 3)
+        tau = 3.0 / np.trace(np.linalg.inv(TranCov), axis1=-2, axis2=-1)
+        kappa = 3.0 / (2.0 * np.trace(np.linalg.inv(RotCov), axis1=-2, axis2=-1))
+    else:
+        d = 2
+        rows = np.asarray(se2_rows, dtype=np.float64)
+        keys = np.asarray(se2_keys, dtype=np.uint64)
+        keys1, keys2 = keys[:, 0], keys[:, 1]
+        t = rows[:, 0:2]
+        R = rotation2d(rows[:, 2])
+        I11, I12, _I13, I22, _I23, I33 = (rows[:, 3 + k] for k in range(6))
+        TranCov = np.stack([I11, I12, I12, I22], axis=-1).reshape(-1, 2, 2)
+        tau = 2.0 / np.trace(np.linalg.inv(TranCov), axis1=-2, axis2=-1)
+        kappa = I33
+
+    r1, p1 = key_to_robot_keyframe(keys1)
+    r2, p2 = key_to_robot_keyframe(keys2)
+    max_index = int(max(p1.max(), p2.max()))
+
+    # Deliberate divergence: the reference returns #VERTEX-lines + 1
+    # (``DPGO_utils.cpp:197,209``), one more than the real pose count for
+    # files that list every vertex (e.g. 126 for the 125-pose smallGrid3D),
+    # leaving a measurement-less trailing pose.  We use the actual count.
+    num_poses = max(num_vertices, max_index + 1)
+
+    m = len(rows)
+    return Measurements(
+        d=d,
+        num_poses=num_poses,
+        r1=r1,
+        p1=p1,
+        r2=r2,
+        p2=p2,
+        R=R,
+        t=t,
+        kappa=np.asarray(kappa, np.float64),
+        tau=np.asarray(tau, np.float64),
+        weight=np.ones(m),
+        is_known_inlier=np.zeros(m, dtype=bool),
+    )

@@ -1,0 +1,42 @@
+"""Test configuration: run on a virtual 8-device CPU mesh with float64.
+
+The reference validates all math on CPU with gtest (``tests/*.cpp``); here
+the same pyramid runs under pytest on the CPU backend so collective code
+paths execute without TPU hardware (multi-device via
+``--xla_force_host_platform_device_count``), and in f64 so golden-value
+comparisons are tight.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize force-registers a TPU-tunnel platform and
+# overrides jax_platforms to "axon,cpu"; pin tests back to the virtual
+# multi-device CPU backend (a single TPU grant exists — concurrent test
+# processes would deadlock on it, and tests must not depend on hardware).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+DATA_DIR = "/root/reference/data"
+
+
+@pytest.fixture(scope="session")
+def data_dir():
+    return DATA_DIR
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

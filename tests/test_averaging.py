@@ -1,0 +1,95 @@
+"""Tests for (robust) averaging, mirroring reference tests/testUtils.cpp:72-180."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from dpgo_tpu.ops import averaging
+from dpgo_tpu.utils import lie
+
+
+def random_rotation(rng, d=3):
+    return np.asarray(lie.project_to_rotation(jnp.asarray(rng.standard_normal((d, d)))))
+
+
+def perturbed(R, rng, angle):
+    # Rotate R by `angle` radians about a random axis.
+    axis = rng.standard_normal(3)
+    axis /= np.linalg.norm(axis)
+    q = np.concatenate([np.sin(angle / 2) * axis, [np.cos(angle / 2)]])
+    return lie.quat_to_rotation(q) @ R
+
+
+def test_single_translation_averaging(rng):
+    ts = jnp.asarray(rng.standard_normal((10, 3)))
+    tau = jnp.asarray(rng.uniform(0.5, 2.0, 10))
+    t = averaging.single_translation_averaging(ts, tau)
+    expected = (np.asarray(tau)[:, None] * np.asarray(ts)).sum(0) / np.asarray(tau).sum()
+    assert np.allclose(t, expected, atol=1e-12)
+
+
+def test_single_rotation_averaging_trivial(rng):
+    # One measurement: average equals the measurement (testUtils.cpp:74-88).
+    R = random_rotation(rng)
+    out = averaging.single_rotation_averaging(jnp.asarray(R[None]))
+    assert np.allclose(out, R, atol=1e-10)
+
+
+def test_single_rotation_averaging_noisy(rng):
+    R = random_rotation(rng)
+    Rs = np.stack([perturbed(R, rng, rng.normal(0.0, 0.05)) for _ in range(50)])
+    out = np.asarray(averaging.single_rotation_averaging(jnp.asarray(Rs)))
+    # Mean should be close to truth (chordal error well below noise).
+    assert np.linalg.norm(out - R) < 0.1
+
+
+def test_robust_rotation_averaging_trivial(rng):
+    # Single-measurement robust case (testUtils.cpp:90-103).
+    R = random_rotation(rng)
+    res = averaging.robust_single_rotation_averaging(jnp.asarray(R[None]))
+    assert np.allclose(res.R, R, atol=1e-8)
+    assert res.inlier_mask.tolist() == [True]
+
+
+def test_robust_rotation_averaging_outliers(rng):
+    # 10 inliers + 40 outliers; exact inlier-set recovery (testUtils.cpp:105-139).
+    R = random_rotation(rng)
+    inliers = [perturbed(R, rng, rng.normal(0.0, 0.01)) for _ in range(10)]
+    outliers = [random_rotation(rng) for _ in range(40)]
+    Rs = jnp.asarray(np.stack(inliers + outliers))
+    thresh = lie.angular_to_chordal_so3(0.5)  # generous inlier threshold
+    res = averaging.robust_single_rotation_averaging(Rs, error_threshold=thresh)
+    mask = np.asarray(res.inlier_mask)
+    assert mask[:10].all(), f"lost inliers: {mask[:10]}"
+    assert not mask[10:].any(), "outliers accepted"
+    assert np.linalg.norm(np.asarray(res.R) - R) < 0.05
+
+
+def test_robust_pose_averaging_outliers(rng):
+    # testUtils.cpp:141-180: pose averaging with outliers.
+    R = random_rotation(rng)
+    t = rng.standard_normal(3)
+    kR, kt = 10, 40
+    inl_R = [perturbed(R, rng, rng.normal(0.0, 0.005)) for _ in range(kR)]
+    inl_t = [t + 0.01 * rng.standard_normal(3) for _ in range(kR)]
+    out_R = [random_rotation(rng) for _ in range(kt)]
+    out_t = [t + 5.0 * rng.standard_normal(3) for _ in range(kt)]
+    Rs = jnp.asarray(np.stack(inl_R + out_R))
+    ts = jnp.asarray(np.stack(inl_t + out_t))
+    res = averaging.robust_single_pose_averaging(Rs, ts, error_threshold=1.0)
+    mask = np.asarray(res.inlier_mask)
+    assert mask[:kR].all()
+    assert not mask[kR:].any()
+    assert np.linalg.norm(np.asarray(res.R) - R) < 0.05
+    assert np.linalg.norm(np.asarray(res.t) - t) < 0.05
+
+
+def test_robust_averaging_is_jittable(rng):
+    import jax
+
+    R = random_rotation(rng)
+    Rs = jnp.asarray(np.stack([perturbed(R, rng, 0.01) for _ in range(5)]))
+    fn = jax.jit(
+        lambda Rs: averaging.robust_single_rotation_averaging(Rs, error_threshold=0.5)
+    )
+    res = fn(Rs)
+    assert np.asarray(res.inlier_mask).all()

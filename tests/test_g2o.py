@@ -1,0 +1,75 @@
+"""Tests for the g2o reader against the reference datasets."""
+
+import numpy as np
+
+from dpgo_tpu.utils import g2o
+
+
+def test_key_decoding_plain_ints():
+    r, p = g2o.key_to_robot_keyframe(np.array([0, 5, 1000]))
+    assert np.array_equal(r, [0, 0, 0])
+    assert np.array_equal(p, [0, 5, 1000])
+
+
+def test_key_decoding_robot_chars():
+    # gtsam symbol: chr in the top byte, index in the low 48 bits.
+    key = (np.uint64(ord("b")) << np.uint64(56)) | np.uint64(42)
+    r, p = g2o.key_to_robot_keyframe(key)
+    assert int(r) == ord("b")
+    assert int(p) == 42
+
+
+def test_read_small_grid(data_dir):
+    m = g2o.read_g2o(f"{data_dir}/smallGrid3D.g2o")
+    assert m.d == 3
+    assert m.num_poses == 125
+    assert len(m) == 297
+    # Rotations must be valid.
+    eye = np.broadcast_to(np.eye(3), (297, 3, 3))
+    assert np.allclose(np.swapaxes(m.R, -1, -2) @ m.R, eye, atol=1e-6)
+    assert np.all(m.kappa > 0)
+    assert np.all(m.tau > 0)
+    assert np.all(m.weight == 1.0)
+
+
+def test_read_se2(data_dir):
+    m = g2o.read_g2o(f"{data_dir}/kitti_00.g2o")
+    assert m.d == 2
+    # The file has no VERTEX lines; ids are contiguous 0..4540.
+    assert m.num_poses == 4541
+    assert len(m) == 4676
+    eye = np.broadcast_to(np.eye(2), (len(m), 2, 2))
+    assert np.allclose(np.swapaxes(m.R, -1, -2) @ m.R, eye, atol=1e-8)
+
+
+def test_read_sphere2500(data_dir):
+    m = g2o.read_g2o(f"{data_dir}/sphere2500.g2o")
+    assert m.num_poses == 2500
+    assert len(m) == 4949
+
+
+def test_multi_robot_keys_parse_exactly(tmp_path):
+    # gtsam symbol keys exceed 2^53; index bits must survive parsing.
+    key_a = (ord("a") << 56) | 41
+    key_b = (ord("b") << 56) | 42
+    p = tmp_path / "mr.g2o"
+    p.write_text(
+        f"EDGE_SE2 {key_a} {key_b} 1.0 0.0 0.1 4.0 0.0 0.0 4.0 0.0 9.0\n"
+    )
+    m = g2o.read_g2o(str(p))
+    assert int(m.r1[0]) == ord("a") and int(m.p1[0]) == 41
+    assert int(m.r2[0]) == ord("b") and int(m.p2[0]) == 42
+
+
+def test_se2_kappa_is_i33(data_dir, tmp_path):
+    # For SE(2), kappa is taken directly from I33 (DPGO_utils.cpp:144).
+    p = tmp_path / "tiny.g2o"
+    p.write_text(
+        "VERTEX_SE2 0 0 0 0\n"
+        "VERTEX_SE2 1 1 0 0\n"
+        "EDGE_SE2 0 1 1.0 0.0 0.1 4.0 0.0 0.0 4.0 0.0 9.0\n"
+    )
+    m = g2o.read_g2o(str(p))
+    assert np.isclose(m.kappa[0], 9.0)
+    # tau = 2 / tr(inv(diag(4,4))) = 2 / 0.5 = 4
+    assert np.isclose(m.tau[0], 4.0)

@@ -1,0 +1,85 @@
+"""Tests for rotation/Stiefel primitives, mirroring reference tests/testUtils.cpp."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpgo_tpu.utils import lie
+
+
+def test_fixed_stiefel_orthonormal_and_deterministic():
+    # Mirrors testUtils.cpp:12-25 (orthonormality + determinism across calls).
+    d, r = 3, 5
+    Y1 = np.asarray(lie.fixed_stiefel(r, d, dtype=jnp.float64))
+    Y2 = np.asarray(lie.fixed_stiefel(r, d, dtype=jnp.float64))
+    assert np.allclose(Y1.T @ Y1, np.eye(d), atol=1e-12)
+    assert np.array_equal(Y1, Y2)
+
+
+@pytest.mark.parametrize("d,r", [(2, 2), (3, 3), (3, 5), (2, 5)])
+def test_project_to_stiefel(rng, d, r):
+    # Mirrors testUtils.cpp:27-37 (random-matrix projection, 50 trials batched).
+    M = rng.standard_normal((50, r, d))
+    Y = np.asarray(lie.project_to_stiefel(jnp.asarray(M)))
+    eye = np.broadcast_to(np.eye(d), (50, d, d))
+    assert np.allclose(np.swapaxes(Y, -1, -2) @ Y, eye, atol=1e-10)
+
+
+@pytest.mark.parametrize("d", [2, 3])
+def test_project_to_rotation(rng, d):
+    M = rng.standard_normal((100, d, d))
+    R = np.asarray(lie.project_to_rotation(jnp.asarray(M)))
+    eye = np.broadcast_to(np.eye(d), (100, d, d))
+    assert np.allclose(np.swapaxes(R, -1, -2) @ R, eye, atol=1e-10)
+    assert np.allclose(np.linalg.det(R), 1.0, atol=1e-10)
+
+
+def test_project_to_rotation_fixes_reflection():
+    # A reflection must be mapped to a proper rotation, not itself.
+    M = np.diag([1.0, 1.0, -1.0])
+    R = np.asarray(lie.project_to_rotation(jnp.asarray(M)))
+    assert np.allclose(np.linalg.det(R), 1.0, atol=1e-12)
+
+
+def test_quat_roundtrip(rng):
+    q = rng.standard_normal((200, 4))
+    q /= np.linalg.norm(q, axis=-1, keepdims=True)
+    R = lie.quat_to_rotation(q)
+    eye = np.broadcast_to(np.eye(3), (200, 3, 3))
+    assert np.allclose(np.swapaxes(R, -1, -2) @ R, eye, atol=1e-12)
+    assert np.allclose(np.linalg.det(R), 1.0, atol=1e-12)
+    q2 = lie.rotation_to_quat(R)
+    R2 = lie.quat_to_rotation(q2)
+    assert np.allclose(R, R2, atol=1e-10)
+
+
+def test_rotation2d():
+    R = lie.rotation2d(np.pi / 2)
+    assert np.allclose(R, [[0, -1], [1, 0]], atol=1e-12)
+
+
+def test_chi2inv_matches_empirical(rng):
+    # Mirrors testUtils.cpp:55-70: quantile vs empirical quantile of samples.
+    quantile, dof = 0.9, 3
+    thresh = lie.chi2inv(quantile, dof)
+    samples = rng.chisquare(dof, size=100_000)
+    frac = np.mean(samples < thresh)
+    assert abs(frac - quantile) < 0.01
+
+
+def test_angular_to_chordal():
+    assert lie.angular_to_chordal_so3(0.0) == 0.0
+    # A rotation by pi about z has chordal distance ||R - I||_F = 2*sqrt(2).
+    assert np.isclose(lie.angular_to_chordal_so3(np.pi), 2 * np.sqrt(2))
+    Rz = lie.quat_to_rotation(np.array([0.0, 0.0, np.sin(0.3), np.cos(0.3)]))
+    ang = 0.6
+    assert np.isclose(np.linalg.norm(Rz - np.eye(3)), lie.angular_to_chordal_so3(ang))
+
+
+def test_random_stiefel_batch():
+    import jax
+
+    Y = lie.random_stiefel(jax.random.PRNGKey(0), 5, 3, batch=(7,), dtype=jnp.float64)
+    Y = np.asarray(Y)
+    eye = np.broadcast_to(np.eye(3), (7, 3, 3))
+    assert np.allclose(np.swapaxes(Y, -1, -2) @ Y, eye, atol=1e-12)

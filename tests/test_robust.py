@@ -1,0 +1,71 @@
+"""Tests for robust cost weights (reference src/DPGO_robust.cpp:23-103)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from dpgo_tpu import robust
+from dpgo_tpu.config import RobustCostParams, RobustCostType
+
+
+def P(ct, **kw):
+    return RobustCostParams(cost_type=ct, **kw)
+
+
+def test_l2():
+    r = jnp.array([0.1, 1.0, 100.0])
+    assert np.allclose(robust.weight(r, P(RobustCostType.L2)), 1.0)
+
+
+def test_l1():
+    r = jnp.array([0.5, 2.0])
+    assert np.allclose(robust.weight(r, P(RobustCostType.L1)), [2.0, 0.5])
+
+
+def test_huber():
+    p = P(RobustCostType.Huber)  # threshold 3
+    r = jnp.array([1.0, 3.0, 6.0])
+    assert np.allclose(robust.weight(r, p), [1.0, 1.0, 0.5])
+
+
+def test_tls():
+    p = P(RobustCostType.TLS)  # threshold 10
+    r = jnp.array([9.0, 11.0])
+    assert np.allclose(robust.weight(r, p), [1.0, 0.0])
+
+
+def test_gm():
+    r = jnp.array([0.0, 1.0])
+    assert np.allclose(robust.weight(r, P(RobustCostType.GM)), [1.0, 0.25])
+
+
+def test_gnc_tls_branches():
+    barc, mu = 10.0, 0.5
+    barc_sq = barc * barc
+    upper = (mu + 1) / mu * barc_sq  # 300
+    lower = mu / (mu + 1) * barc_sq  # 100/1.5
+
+    r = jnp.sqrt(jnp.array([upper + 1, lower - 1, (upper + lower) / 2]))
+    w = np.asarray(robust.gnc_tls_weight(r, mu, barc))
+    assert w[0] == 0.0
+    assert w[1] == 1.0
+    mid_expected = np.sqrt(barc_sq * mu * (mu + 1) / ((upper + lower) / 2)) - mu
+    assert np.isclose(w[2], mid_expected)
+    assert 0.0 < w[2] < 1.0
+
+
+def test_gnc_tls_monotone_in_residual():
+    w = np.asarray(robust.gnc_tls_weight(jnp.linspace(0.1, 50.0, 100), 0.3, 10.0))
+    assert np.all(np.diff(w) <= 1e-12)
+
+
+def test_gnc_mu_annealing():
+    p = P(RobustCostType.GNC_TLS)
+    mu = jnp.asarray(p.gnc_init_mu)
+    mu2 = robust.gnc_update_mu(mu, p)
+    assert np.isclose(float(mu2), 1e-4 * 1.4)
+
+
+def test_weight_converged():
+    w = jnp.array([0.0, 1.0, 0.5, 1e-9, 1 - 1e-9])
+    conv = np.asarray(robust.is_weight_converged(w))
+    assert conv.tolist() == [True, True, False, True, True]
