@@ -1,0 +1,111 @@
+"""Single-agent (centralized) pose-graph optimization — the minimum
+end-to-end slice.
+
+Equivalent of reference ``PGOAgent::localPoseGraphOptimization``
+(``PGOAgent.cpp:964-1005``) and the ``single-robot-example`` driver
+(``examples/SingleRobotExample.cpp``): chordal (or odometry) initialization
+followed by a Riemannian trust-region solve of the full problem on one
+device.  Everything from initialization through the RTR loop is jitted; this
+exercises every hot kernel of the framework (edge-list Laplacian ops,
+batched manifold projections, tCG) and is the first performance checkpoint
+(SURVEY.md section 7, M1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SolverParams
+from ..types import EdgeSet, Measurements, edge_set_from_measurements
+from ..utils.lie import fixed_stiefel, project_to_rotation
+from ..ops import chordal, manifold, quadratic, solver
+
+
+def lift(T: jax.Array, ylift: jax.Array) -> jax.Array:
+    """Lift SE(d) poses T [n, d, d+1] to rank r: X_i = YLift T_i
+    (reference ``PGOAgent.cpp:183,415``)."""
+    return jnp.einsum("rd,nde->nre", ylift, T)
+
+
+def round_solution(X: jax.Array, ylift: jax.Array) -> jax.Array:
+    """Round lifted X [n, r, d+1] back to SE(d): T = YLift^T X, then project
+    rotation blocks to SO(d) (reference ``PGOAgent::roundSolution``,
+    ``PGOAgent.cpp:487-494``)."""
+    T = jnp.einsum("rd,nre->nde", ylift, X)
+    d = ylift.shape[1]
+    R = project_to_rotation(T[..., :d])
+    return jnp.concatenate([R, T[..., d:]], axis=-1)
+
+
+def make_problem(edges: EdgeSet, n: int, precond_shift: float = 0.1) -> solver.Problem:
+    """Assemble solver closures for a single-buffer problem (all edges
+    private; the buffer is exactly the n local poses)."""
+    blocks = quadratic.diag_blocks(edges, n)
+    chol = quadratic.precond_factors(blocks, precond_shift)
+    return solver.Problem(
+        cost=lambda X: quadratic.cost(X, edges),
+        egrad=lambda X: quadratic.egrad(X, edges),
+        ehess=lambda X, V: quadratic.hessvec(V, edges, n),
+        precond=lambda X, V: quadratic.precond_apply(chol, V),
+    )
+
+
+@dataclasses.dataclass
+class LocalSolveResult:
+    T: jax.Array  # [n, d, d+1] rounded SE(d) trajectory
+    X: jax.Array  # [n, r, d+1] lifted solution
+    cost: float
+    grad_norm: float
+    iters: int
+
+
+@partial(jax.jit, static_argnames=("n", "rank", "params", "max_iters",
+                                   "grad_norm_tol", "init"))
+def _solve_local_jit(edges: EdgeSet, n: int, rank: int, params: SolverParams,
+                     max_iters: int, grad_norm_tol: float, init: str):
+    dtype = edges.R.dtype
+    d = edges.d
+    if init == "chordal":
+        T0 = chordal.chordal_initialization(edges, n)
+    elif init == "odometry":
+        T0 = chordal.odometry_from_edges(edges, n)
+    else:
+        raise ValueError(f"unknown init {init!r}")
+
+    ylift = fixed_stiefel(rank, d, dtype) if rank > d \
+        else jnp.eye(rank, d, dtype=dtype)
+    X0 = lift(T0, ylift)
+    problem = make_problem(edges, n, params.precond_shift)
+    out = solver.rtr_solve(problem, X0, params, max_iters=max_iters,
+                           grad_norm_tol=grad_norm_tol)
+    T = round_solution(out.X, ylift)
+    return T, out
+
+
+def solve_local(
+    meas: Measurements,
+    rank: int | None = None,
+    params: SolverParams | None = None,
+    max_iters: int = 100,
+    grad_norm_tol: float = 1e-1,
+    init: str = "chordal",
+    dtype=jnp.float64,
+) -> LocalSolveResult:
+    """Centralized PGO solve of a full measurement set.
+
+    Defaults mirror the reference's local solve configuration
+    (``PGOAgent.cpp:979-987``: RTR, gradnorm tol 1e-1; rank r = d means no
+    relaxation).  ``rank > d`` gives the lifted (Burer-Monteiro) solve.
+    """
+    params = params or SolverParams(initial_radius=1e1, max_inner_iters=50)
+    n = meas.num_poses
+    rank = meas.d if rank is None else rank
+    edges = edge_set_from_measurements(meas, dtype=dtype)
+    T, out = _solve_local_jit(edges, n, rank, params, max_iters,
+                              grad_norm_tol, init)
+    return LocalSolveResult(T=T, X=out.X, cost=float(out.f),
+                            grad_norm=float(out.grad_norm), iters=int(out.iters))

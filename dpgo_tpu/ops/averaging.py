@@ -29,7 +29,9 @@ def single_translation_averaging(ts: jax.Array, tau: jax.Array | None = None,
     w = jnp.ones(k, ts.dtype) if tau is None else tau
     if mask is not None:
         w = w * mask
-    return (w[:, None] * ts).sum(0) / w.sum()
+    # Guard the all-zero-weight case (e.g. GNC rejected every measurement):
+    # return 0 instead of NaN; callers detect failure via an empty inlier set.
+    return (w[:, None] * ts).sum(0) / jnp.maximum(w.sum(), 1e-30)
 
 
 def single_rotation_averaging(Rs: jax.Array, kappa: jax.Array | None = None,

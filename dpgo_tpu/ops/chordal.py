@@ -1,0 +1,187 @@
+"""Chordal and odometry initialization.
+
+TPU-native equivalent of reference ``chordalInitialization`` /
+``recoverTranslations`` / ``odometryInitialization``
+(``src/DPGO_utils.cpp:377-476``).  The reference solves two sparse
+least-squares problems with SuiteSparse SPQR; there is no sparse QR on TPU,
+so both solves become Jacobi-preconditioned conjugate gradients on the
+normal equations, with the graph operators applied edge-wise
+(gather / scatter-add) — the same technique as ``ops.quadratic``.
+
+Stage 1 (rotations): minimize  sum_e kappa_e ||R_j - R_i Rtilde_e||_F^2
+over unconstrained d x d blocks with R_0 = I pinned (the reference drops the
+first block column of B3, ``DPGO_utils.cpp:390``), then project each block
+to SO(d).
+
+Stage 2 (translations): with rotations fixed, minimize
+sum_e tau_e ||t_j - t_i - R_i ttilde_e||^2 with t_0 = 0 pinned.
+
+Both systems are graph-Laplacian-like: SPD on the pinned subspace, diagonal
+blocks = (weighted) vertex degrees, so Jacobi scaling is a natural
+preconditioner.  This is init-only work; a few hundred CG iterations are
+acceptable (SURVEY.md hard-part #6).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..types import EdgeSet
+from ..utils.lie import project_to_rotation
+
+
+def _pin0(x: jax.Array) -> jax.Array:
+    """Zero the slot-0 block (the pinned/anchored pose)."""
+    return x.at[0].set(0.0)
+
+
+def _cg(matvec, b, precond, maxiter: int, tol: float):
+    """Standard preconditioned CG (jax.scipy's cg with explicit M)."""
+    x, _ = jax.scipy.sparse.linalg.cg(matvec, b, M=precond, maxiter=maxiter, tol=tol)
+    return x
+
+
+def chordal_rotations(edges: EdgeSet, n: int, maxiter: int = 2000,
+                      tol: float = 1e-10) -> jax.Array:
+    """Solve the chordal rotation relaxation; returns [n, d, d] in SO(d).
+
+    Equivalent to the reference's B3 SPQR solve + per-block SO(d) projection
+    (``DPGO_utils.cpp:388-410``).
+    """
+    d = edges.d
+    dtype = edges.R.dtype
+    wk = edges.mask * edges.weight * edges.kappa
+
+    def residual_op(Rs):
+        # A: [n, d, d] -> per-edge sqrt(kappa)-weighted residual (R fixed at
+        # identity handled by caller via constant split).
+        Ri = Rs[edges.i]
+        Rj = Rs[edges.j]
+        return Rj - jnp.einsum("eab,ebc->eac", Ri, edges.R)
+
+    def residual_adjoint(res):
+        # A^T: per-edge residuals -> per-vertex accumulation.
+        out = jnp.zeros((n, d, d), dtype)
+        contrib_j = wk[:, None, None] * res
+        contrib_i = -jnp.einsum("eab,ecb->eac", wk[:, None, None] * res, edges.R)
+        return out.at[edges.j].add(contrib_j).at[edges.i].add(contrib_i)
+
+    def H(Rs):  # normal operator restricted to the pinned subspace
+        return _pin0(residual_adjoint(residual_op(_pin0(Rs))))
+
+    # Constant part: pose 0 fixed at identity.
+    R_fixed = jnp.zeros((n, d, d), dtype).at[0].set(jnp.eye(d, dtype=dtype))
+    b = _pin0(-residual_adjoint(residual_op(R_fixed)))
+
+    # Jacobi preconditioner: weighted degree per vertex (diagonal blocks of
+    # the rotation connection Laplacian are kappa-degree * I).
+    deg = jnp.zeros((n,), dtype).at[edges.i].add(wk).at[edges.j].add(wk)
+    deg = jnp.maximum(deg, 1e-12)
+
+    def precond(Rs):
+        return _pin0(Rs / deg[:, None, None])
+
+    sol = _cg(H, b, precond, maxiter, tol)
+    Rs = sol.at[0].set(jnp.eye(d, dtype=dtype))
+    return project_to_rotation(Rs)
+
+
+def recover_translations(edges: EdgeSet, Rs: jax.Array, n: int,
+                         maxiter: int = 2000, tol: float = 1e-10) -> jax.Array:
+    """Least-squares translations given rotations; returns [n, d], t_0 = 0.
+
+    Equivalent to the reference's B1/B2 SPQR solve
+    (``recoverTranslations``, ``DPGO_utils.cpp:449-476``).
+    """
+    d = edges.d
+    dtype = Rs.dtype
+    wt = edges.mask * edges.weight * edges.tau
+
+    def residual_op(ts):
+        return ts[edges.j] - ts[edges.i]
+
+    def residual_adjoint(res):
+        out = jnp.zeros((n, d), dtype)
+        wres = wt[:, None] * res
+        return out.at[edges.j].add(wres).at[edges.i].add(-wres)
+
+    def H(ts):
+        return _pin0(residual_adjoint(residual_op(_pin0(ts))))
+
+    # Constant: measured offsets R_i ttilde_e (and the pinned t_0 = 0).
+    offs = jnp.einsum("eab,eb->ea", Rs[edges.i], edges.t)
+    b = _pin0(residual_adjoint(offs))
+
+    deg = jnp.zeros((n,), dtype).at[edges.i].add(wt).at[edges.j].add(wt)
+    deg = jnp.maximum(deg, 1e-12)
+
+    def precond(ts):
+        return _pin0(ts / deg[:, None])
+
+    return _cg(H, b, precond, maxiter, tol)
+
+
+def chordal_initialization(edges: EdgeSet, n: int, maxiter: int = 2000,
+                           tol: float = 1e-10) -> jax.Array:
+    """Full chordal init; returns T [n, d, d+1] = [R_i | t_i] per pose.
+
+    Matches the output convention of reference ``chordalInitialization``
+    (``DPGO_utils.cpp:377-424``), reshaped pose-major.
+    """
+    Rs = chordal_rotations(edges, n, maxiter, tol)
+    ts = recover_translations(edges, Rs, n, maxiter, tol)
+    return jnp.concatenate([Rs, ts[..., None]], axis=-1)
+
+
+def odometry_from_edges(edges: EdgeSet, n: int) -> jax.Array:
+    """Select the odometry chain (k -> k+1) out of an arbitrary edge set and
+    chain-propagate it; returns T [n, d, d+1].
+
+    Robust to duplicates: among candidate edges with ``j == i + 1``, an edge
+    flagged as odometry (``is_lc == 0``) wins over a consecutive loop
+    closure, ties broken by edge order (scatter-min priority selection).  A
+    pose with no incoming odometry edge gets an identity step — the chain
+    continues rather than silently mis-pairing measurements.
+    """
+    E = edges.i.shape[0]
+    d = edges.d
+    dtype = edges.R.dtype
+    cand = (edges.j == edges.i + 1) & (edges.mask > 0) & (edges.i < n - 1)
+    big = jnp.asarray(2 * E + 1, jnp.int32)
+    # priority = is_lc * E + edge_index: odometry-flagged first, then stable.
+    prio = (edges.is_lc > 0).astype(jnp.int32) * E + jnp.arange(E, dtype=jnp.int32)
+    prio = jnp.where(cand, prio, big)
+    i_safe = jnp.where(cand, edges.i, 0)  # keep scatter indices in bounds
+    best = jnp.full((n - 1,), big, jnp.int32).at[i_safe].min(prio)
+    valid = best < big
+    idx = jnp.where(valid, best % E, 0)
+    eye = jnp.eye(d, dtype=dtype)
+    R_odo = jnp.where(valid[:, None, None], edges.R[idx], eye)
+    t_odo = jnp.where(valid[:, None], edges.t[idx], jnp.zeros(d, dtype))
+    return odometry_initialization(R_odo, t_odo)
+
+
+def odometry_initialization(R_odo: jax.Array, t_odo: jax.Array) -> jax.Array:
+    """Chain-propagate odometry; returns T [n, d, d+1], pose 0 = identity.
+
+    ``R_odo: [n-1, d, d]``, ``t_odo: [n-1, d]`` are measurements k -> k+1.
+    Reference ``odometryInitialization`` (``DPGO_utils.cpp:426-447``), as an
+    associative scan over SE(d) composition (log-depth on device instead of
+    a sequential chain).
+    """
+    d = R_odo.shape[-1]
+    dtype = R_odo.dtype
+    eye = jnp.broadcast_to(jnp.eye(d, dtype=dtype), (1, d, d))
+    zero = jnp.zeros((1, d), dtype)
+    Rs = jnp.concatenate([eye, R_odo], axis=0)
+    ts = jnp.concatenate([zero, t_odo], axis=0)
+
+    def compose(a, b):
+        # (Ra, ta) then relative (Rb, tb): R = Ra Rb, t = ta + Ra tb
+        Ra, ta = a
+        Rb, tb = b
+        return Ra @ Rb, ta + jnp.einsum("...ab,...b->...a", Ra, tb)
+
+    R_acc, t_acc = jax.lax.associative_scan(compose, (Rs, ts))
+    return jnp.concatenate([R_acc, t_acc[..., None]], axis=-1)

@@ -1,0 +1,160 @@
+"""Edge-list quadratic PGO cost: f(X) = 0.5 <Q, X^T X> + <X, G>, without Q or G.
+
+The reference materializes the sparse connection Laplacian ``Q`` (CHOLMOD /
+Eigen sparse, ``DPGO_utils.cpp:214-286``, ``PGOAgent.cpp:720-781``) and the
+linear term ``G`` from neighbor poses (``PGOAgent.cpp:783-859``), then
+multiplies ``X * Q`` (``QuadraticProblem.cpp:50-73``).  On TPU, sparse
+matrices with (d+1)-block structure are better expressed as the edge list
+itself: residuals per edge via two gathers, gradients via scatter-add
+(segment sum).  XLA fuses the whole thing; there is no assembled matrix.
+
+For an SE(d) edge e = (i -> j) with measurement (R_e, t_e), precisions
+(kappa_e, tau_e) and GNC weight w_e, and pose blocks X_i = [Y_i | p_i]:
+
+    rR_e = Y_j - Y_i R_e          (r x d)     "rotation residual"
+    rt_e = p_j - p_i - Y_i t_e    (r,)        "translation residual"
+
+    f(X) = 0.5 sum_e w_e (kappa_e ||rR_e||_F^2 + tau_e ||rt_e||^2)
+
+which reproduces the reference cost exactly (the connection Laplacian is
+the Gram matrix of these residuals; see ``constructOrientedConnection-
+IncidenceMatrixSE``, ``DPGO_utils.cpp:214-276``).
+
+A *local* (per-agent) problem evaluates the same sum over a buffer
+``Xbuf = concat([X_local (n), Z_neighbor (s)])``: private edges index both
+endpoints < n, shared edges have one endpoint >= n.  The gradient restricted
+to the first n slots is then exactly ``X Q + G`` of the reference; the
+Hessian-vector product is the same linear map with the neighbor slots zeroed
+(neighbors are constants).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..types import EdgeSet
+
+
+def _edge_terms(Xbuf: jax.Array, edges: EdgeSet):
+    """Per-edge residuals. Xbuf: [N, r, d+1] -> (rR [E, r, d], rt [E, r])."""
+    Xi = Xbuf[edges.i]  # [E, r, d+1]
+    Xj = Xbuf[edges.j]
+    Yi, pi = Xi[..., :-1], Xi[..., -1]
+    Yj, pj = Xj[..., :-1], Xj[..., -1]
+    rR = Yj - Yi @ edges.R
+    rt = pj - pi - jnp.einsum("erd,ed->er", Yi, edges.t)
+    return rR, rt
+
+
+def cost(Xbuf: jax.Array, edges: EdgeSet) -> jax.Array:
+    """f(X) = 0.5 sum_e w_e (kappa ||rR||^2 + tau ||rt||^2).
+
+    Matches reference ``QuadraticProblem::f`` (``QuadraticProblem.cpp:50-60``)
+    up to the constant ||neighbor||^2 terms for shared edges (which the
+    reference's <X,G> form drops; irrelevant for optimization).
+    """
+    rR, rt = _edge_terms(Xbuf, edges)
+    w = edges.mask * edges.weight
+    quad = edges.kappa * jnp.sum(rR * rR, axis=(-2, -1)) + \
+        edges.tau * jnp.sum(rt * rt, axis=-1)
+    return 0.5 * jnp.sum(w * quad)
+
+
+def egrad(Xbuf: jax.Array, edges: EdgeSet, n_out: int | None = None) -> jax.Array:
+    """Euclidean gradient d f / d Xbuf, accumulated for the first ``n_out`` slots.
+
+    Equivalent of the reference's ``X Q + G`` (``QuadraticProblem.cpp:62-66``)
+    when ``Xbuf``'s tail slots hold (fixed) neighbor poses.  The map is linear
+    in ``Xbuf``, so it doubles as the Hessian-vector product ``V Q``
+    (``QuadraticProblem.cpp:68-73``) when called on a tangent vector whose
+    neighbor slots are zero — see ``hessvec``.
+    """
+    N = Xbuf.shape[0]
+    dtype = Xbuf.dtype
+    rR, rt = _edge_terms(Xbuf, edges)
+    w = edges.mask * edges.weight
+    wk = (w * edges.kappa)[:, None, None]
+    wt = (w * edges.tau)[:, None]
+
+    # d/d X_j: [ wk * rR | wt * rt ]
+    gj = jnp.concatenate([wk * rR, (wt * rt)[..., None]], axis=-1)
+    # d/d X_i: [ -wk * rR R^T - wt * outer(rt, t) | -wt * rt ]
+    giY = -(wk * rR) @ jnp.swapaxes(edges.R, -1, -2) \
+        - (wt * rt)[..., None] * edges.t[:, None, :]
+    gi = jnp.concatenate([giY, -(wt * rt)[..., None]], axis=-1)
+
+    out = jnp.zeros((N,) + Xbuf.shape[1:], dtype)
+    out = out.at[edges.i].add(gi).at[edges.j].add(gj)
+    return out if n_out is None else out[:n_out]
+
+
+def hessvec(Vlocal: jax.Array, edges: EdgeSet, n_buf: int) -> jax.Array:
+    """Hessian-vector product restricted to local poses: (V Q)_local.
+
+    ``Vlocal: [n_local, r, d+1]`` is zero-padded to the full buffer size so
+    neighbor poses act as constants (their Hessian block is excluded).
+    """
+    n_local = Vlocal.shape[0]
+    pad = jnp.zeros((n_buf - n_local,) + Vlocal.shape[1:], Vlocal.dtype)
+    Vbuf = jnp.concatenate([Vlocal, pad], axis=0)
+    return egrad(Vbuf, edges, n_out=n_local)
+
+
+def diag_blocks(edges: EdgeSet, n_buf: int, n_out: int | None = None) -> jax.Array:
+    """Diagonal (d+1)x(d+1) blocks of the connection Laplacian Q.
+
+    Per edge (i -> j), block i receives T Omega T^T and block j receives
+    Omega (the same structure the reference assembles for shared edges at
+    ``PGOAgent.cpp:744-777``; for private edges these are Q's diagonal
+    blocks from ``A Omega A^T``):
+
+        B_ii = [[ w kappa I + w tau t t^T ,  w tau t ],
+                [ w tau t^T               ,  w tau   ]]
+        B_jj = diag(w kappa, ..., w kappa, w tau)
+
+    Used by the block-Jacobi preconditioner that replaces the reference's
+    CHOLMOD factorization of Q + 0.1 I (``QuadraticProblem.cpp:31-42``).
+    """
+    E, d = edges.t.shape
+    dtype = edges.t.dtype
+    w = edges.mask * edges.weight
+    wk = w * edges.kappa
+    wt = w * edges.tau
+
+    Bi = jnp.zeros((E, d + 1, d + 1), dtype)
+    Bi = Bi.at[:, :d, :d].set(
+        wk[:, None, None] * jnp.eye(d, dtype=dtype)
+        + wt[:, None, None] * edges.t[:, :, None] * edges.t[:, None, :]
+    )
+    Bi = Bi.at[:, :d, d].set(wt[:, None] * edges.t)
+    Bi = Bi.at[:, d, :d].set(wt[:, None] * edges.t)
+    Bi = Bi.at[:, d, d].set(wt)
+
+    diag_j = jnp.concatenate([jnp.tile(wk[:, None], (1, d)), wt[:, None]], axis=-1)
+    Bj = diag_j[:, :, None] * jnp.eye(d + 1, dtype=dtype)
+
+    out = jnp.zeros((n_buf, d + 1, d + 1), dtype)
+    out = out.at[edges.i].add(Bi).at[edges.j].add(Bj)
+    return out if n_out is None else out[:n_out]
+
+
+def precond_factors(blocks: jax.Array, shift: float) -> jax.Array:
+    """Cholesky factors of (B_pose + shift I), batched over poses.
+
+    The shift mirrors the reference's regularized factorization of
+    Q + 0.1 I (``QuadraticProblem.cpp:37-42``) and guarantees SPD blocks.
+    """
+    dh = blocks.shape[-1]
+    return jnp.linalg.cholesky(blocks + shift * jnp.eye(dh, dtype=blocks.dtype))
+
+
+def precond_apply(chol: jax.Array, V: jax.Array) -> jax.Array:
+    """Solve V_pose (B_pose + shift I)^{-1} per pose.
+
+    V: [n, r, d+1], chol: [n, d+1, d+1] lower.  Because each block is
+    symmetric, right-division is a standard cho_solve on V^T.
+    """
+    Vt = jnp.swapaxes(V, -1, -2)  # [n, d+1, r]
+    sol = jax.scipy.linalg.cho_solve((chol, True), Vt)
+    return jnp.swapaxes(sol, -1, -2)

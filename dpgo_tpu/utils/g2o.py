@@ -57,16 +57,15 @@ def read_g2o(path: str) -> Measurements:
 
     with open(path) as f:
         for line in f:
-            if not line:
+            toks = line.split()  # whitespace-agnostic, like the reference's stringstream
+            if not toks:
                 continue
-            tok_end = line.find(" ")
-            tag = line[:tok_end]
+            tag = toks[0]
             if tag == "EDGE_SE2" or tag == "EDGE_SE3:QUAT":
-                toks = line[tok_end:].split()
                 # Keys must be parsed as ints: gtsam symbol keys exceed 2^53
                 # and would lose their low (index) bits through float64.
-                key = (int(toks[0]), int(toks[1]))
-                vals = [float(x) for x in toks[2:]]
+                key = (int(toks[1]), int(toks[2]))
+                vals = [float(x) for x in toks[3:]]
                 if tag == "EDGE_SE2":
                     se2_keys.append(key)
                     se2_rows.append(vals)
@@ -75,7 +74,7 @@ def read_g2o(path: str) -> Measurements:
                     se3_rows.append(vals)
             elif tag.startswith("VERTEX"):
                 num_vertices += 1
-            elif tag:
+            else:
                 raise ValueError(f"Unrecognized g2o token: {tag!r}")
 
     if se2_rows and se3_rows:

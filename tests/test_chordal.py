@@ -1,0 +1,59 @@
+"""Tests for chordal / odometry initialization (reference DPGO_utils.cpp:377-476)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from dpgo_tpu.ops import chordal
+from dpgo_tpu.types import edge_set_from_measurements
+from synthetic import make_measurements, trajectory_error
+
+
+def test_odometry_init_recovers_chain(rng):
+    meas, (Rs, ts) = make_measurements(rng, n=20, d=3, num_lc=0)
+    T = chordal.odometry_initialization(jnp.asarray(meas.R), jnp.asarray(meas.t))
+    assert trajectory_error(T, Rs, ts) < 1e-10
+
+
+def test_chordal_init_exact_on_noiseless_graph(rng):
+    # With exact measurements the chordal relaxation is tight: recovery up to
+    # the anchored gauge (analog of testTriangleGraph's 1e-4 golden check,
+    # but property-based).
+    for d in (2, 3):
+        meas, (Rs, ts) = make_measurements(rng, n=15, d=d, num_lc=8)
+        edges = edge_set_from_measurements(meas, dtype=jnp.float64)
+        T = np.asarray(chordal.chordal_initialization(edges, meas.num_poses))
+        assert trajectory_error(T, Rs, ts) < 1e-6, f"d={d}"
+
+
+def test_chordal_init_noisy_graph_close(rng):
+    meas, (Rs, ts) = make_measurements(rng, n=30, d=3, num_lc=15,
+                                       rot_noise=0.02, trans_noise=0.02)
+    edges = edge_set_from_measurements(meas, dtype=jnp.float64)
+    T = np.asarray(chordal.chordal_initialization(edges, meas.num_poses))
+    # Rotations must stay valid and the trajectory near truth.
+    R = T[..., :3]
+    eye = np.broadcast_to(np.eye(3), R.shape)
+    assert np.allclose(np.swapaxes(R, -1, -2) @ R, eye, atol=1e-8)
+    assert trajectory_error(T, Rs, ts) < 0.5
+
+
+def test_chordal_on_real_dataset(data_dir):
+    # smallGrid3D end-to-end: init must produce valid rotations and a
+    # drastically lower cost than a random start.
+    from dpgo_tpu.utils.g2o import read_g2o
+    from dpgo_tpu.ops import quadratic
+    from dpgo_tpu.models.local_pgo import lift
+
+    meas = read_g2o(f"{data_dir}/smallGrid3D.g2o")
+    edges = edge_set_from_measurements(meas, dtype=jnp.float64)
+    T = chordal.chordal_initialization(edges, meas.num_poses)
+    X = lift(T, jnp.eye(3, dtype=jnp.float64))
+    f_chordal = float(quadratic.cost(X, edges))
+
+    rng = np.random.default_rng(0)
+    Xr = jnp.asarray(rng.standard_normal(np.asarray(X).shape))
+    f_rand = float(quadratic.cost(Xr, edges))
+    assert f_chordal < 0.01 * f_rand
+    R = np.asarray(T[..., :3])
+    eye = np.broadcast_to(np.eye(3), R.shape)
+    assert np.allclose(np.swapaxes(R, -1, -2) @ R, eye, atol=1e-8)

@@ -1,0 +1,72 @@
+"""End-to-end single-agent solves (reference single-robot-example path)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpgo_tpu.models import local_pgo
+from dpgo_tpu.ops import quadratic
+from dpgo_tpu.types import edge_set_from_measurements
+from synthetic import make_measurements, trajectory_error
+
+
+def test_solve_local_noiseless_exact(rng):
+    meas, (Rs, ts) = make_measurements(rng, n=12, d=3, num_lc=6)
+    res = local_pgo.solve_local(meas, grad_norm_tol=1e-9, max_iters=100)
+    assert res.cost < 1e-12
+    assert trajectory_error(res.T, Rs, ts) < 1e-5
+
+
+def test_solve_local_odometry_init(rng):
+    meas, (Rs, ts) = make_measurements(rng, n=12, d=3, num_lc=4)
+    res = local_pgo.solve_local(meas, init="odometry", grad_norm_tol=1e-9)
+    assert res.cost < 1e-12
+    assert trajectory_error(res.T, Rs, ts) < 1e-5
+
+
+def test_solve_local_se2(rng):
+    meas, (Rs, ts) = make_measurements(rng, n=15, d=2, num_lc=6,
+                                       rot_noise=0.02, trans_noise=0.02)
+    res = local_pgo.solve_local(meas, grad_norm_tol=1e-6)
+    assert res.grad_norm < 1e-6
+    R = res.T[..., :2]
+    eye = np.broadcast_to(np.eye(2), np.asarray(R).shape)
+    assert np.allclose(np.swapaxes(np.asarray(R), -1, -2) @ np.asarray(R), eye, atol=1e-8)
+
+
+def test_lifted_rank_matches_unlifted_optimum(rng):
+    # Burer-Monteiro: at moderate noise the rank-d and rank-r solves must
+    # round to (essentially) the same rotation-valid cost.
+    meas, _ = make_measurements(rng, n=20, d=3, num_lc=10,
+                                rot_noise=0.03, trans_noise=0.03)
+    res_d = local_pgo.solve_local(meas, rank=3, grad_norm_tol=1e-8, max_iters=300)
+    res_r = local_pgo.solve_local(meas, rank=5, grad_norm_tol=1e-8, max_iters=300)
+
+    edges = edge_set_from_measurements(meas, dtype=jnp.float64)
+    eye3 = jnp.eye(3, dtype=jnp.float64)
+
+    def rounded_cost(T):
+        return float(quadratic.cost(local_pgo.lift(jnp.asarray(T), eye3), edges))
+
+    c_d = rounded_cost(res_d.T)
+    c_r = rounded_cost(res_r.T)
+    assert c_r <= c_d * 1.01 + 1e-12
+
+
+def test_smallgrid_end_to_end(data_dir):
+    # The reference demo dataset: 125 poses, 297 edges (README.md:31-34).
+    from dpgo_tpu.utils.g2o import read_g2o
+
+    meas = read_g2o(f"{data_dir}/smallGrid3D.g2o")
+    res = local_pgo.solve_local(meas, rank=5, grad_norm_tol=1e-4, max_iters=200)
+    assert res.grad_norm < 1e-4
+    # Solution improves on the chordal initialization.
+    edges = edge_set_from_measurements(meas, dtype=jnp.float64)
+    from dpgo_tpu.ops import chordal
+
+    T0 = chordal.chordal_initialization(edges, meas.num_poses)
+    from dpgo_tpu.utils.lie import fixed_stiefel
+
+    ylift = fixed_stiefel(5, 3, jnp.float64)
+    f0 = float(quadratic.cost(local_pgo.lift(T0, ylift), edges))
+    assert res.cost <= f0

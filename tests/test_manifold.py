@@ -1,0 +1,78 @@
+"""Tests for the lifted SE(d) product manifold ops."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dpgo_tpu.ops import manifold
+from dpgo_tpu.utils import lie
+
+
+def random_point(key, n=7, r=5, d=3):
+    kY, kp = jax.random.split(key)
+    Y = lie.random_stiefel(kY, r, d, batch=(n,), dtype=jnp.float64)
+    p = jax.random.normal(kp, (n, r), jnp.float64)
+    return manifold.join(Y, p)
+
+
+def test_project_restores_orthonormality():
+    key = jax.random.PRNGKey(0)
+    X = random_point(key) + 0.1 * jax.random.normal(key, (7, 5, 4), jnp.float64)
+    Xp = manifold.project(X)
+    Y, _ = manifold.split(Xp)
+    YtY = np.asarray(jnp.swapaxes(Y, -1, -2) @ Y)
+    assert np.allclose(YtY, np.broadcast_to(np.eye(3), (7, 3, 3)), atol=1e-12)
+
+
+def test_tangent_project_properties():
+    key = jax.random.PRNGKey(1)
+    X = random_point(key)
+    V = jax.random.normal(jax.random.PRNGKey(2), X.shape, jnp.float64)
+    PV = manifold.tangent_project(X, V)
+    # Idempotent.
+    assert np.allclose(manifold.tangent_project(X, PV), PV, atol=1e-12)
+    # Tangency: sym(Y^T W) = 0 per block.
+    Y, _ = manifold.split(X)
+    W, _ = manifold.split(PV)
+    S = manifold.sym(jnp.swapaxes(Y, -1, -2) @ W)
+    assert np.allclose(S, 0.0, atol=1e-12)
+    # Orthogonality of the residual: <V - PV, T> = 0 for tangent T.
+    T2 = manifold.tangent_project(X, jax.random.normal(jax.random.PRNGKey(3), X.shape, jnp.float64))
+    assert abs(float(manifold.inner(V - PV, T2))) < 1e-10
+
+
+def test_retract_stays_on_manifold_and_is_first_order():
+    key = jax.random.PRNGKey(4)
+    X = random_point(key)
+    V = manifold.tangent_project(X, jax.random.normal(jax.random.PRNGKey(5), X.shape, jnp.float64))
+    X1 = manifold.retract(X, V)
+    Y1, _ = manifold.split(X1)
+    YtY = np.asarray(jnp.swapaxes(Y1, -1, -2) @ Y1)
+    assert np.allclose(YtY, np.broadcast_to(np.eye(3), YtY.shape), atol=1e-12)
+    # First-order: R_X(tV) = X + tV + O(t^2).
+    for t in [1e-3, 1e-4]:
+        Xt = manifold.retract(X, t * V)
+        err = float(jnp.max(jnp.abs(Xt - (X + t * V))))
+        assert err < 10 * t * t * float(manifold.norm(V)) ** 2
+
+
+def test_rhess_symmetry():
+    # The Riemannian Hessian must be self-adjoint on the tangent space.
+    key = jax.random.PRNGKey(6)
+    X = random_point(key, n=4)
+    eg = jax.random.normal(jax.random.PRNGKey(7), X.shape, jnp.float64)
+
+    # A synthetic symmetric Euclidean Hessian: H(V) = A V + V B with A sym.
+    A = jax.random.normal(jax.random.PRNGKey(8), (4, 5, 5), jnp.float64)
+    A = A + jnp.swapaxes(A, -1, -2)
+
+    def ehess(V):
+        return jnp.einsum("nab,nbc->nac", A, V)
+
+    U = manifold.tangent_project(X, jax.random.normal(jax.random.PRNGKey(9), X.shape, jnp.float64))
+    V = manifold.tangent_project(X, jax.random.normal(jax.random.PRNGKey(10), X.shape, jnp.float64))
+    HU = manifold.ehess_to_rhess(X, eg, ehess(U), U)
+    HV = manifold.ehess_to_rhess(X, eg, ehess(V), V)
+    lhs = float(manifold.inner(HU, V))
+    rhs = float(manifold.inner(U, HV))
+    assert abs(lhs - rhs) < 1e-8 * max(1.0, abs(lhs))

@@ -1,0 +1,174 @@
+"""Tests for the RTR / truncated-CG solver (replacing ROPTLIB RTRNewton)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpgo_tpu.config import SolverParams
+from dpgo_tpu.models.local_pgo import lift, make_problem, round_solution
+from dpgo_tpu.ops import manifold, solver
+from dpgo_tpu.types import edge_set_from_measurements
+from dpgo_tpu.utils.lie import fixed_stiefel
+from synthetic import make_measurements, trajectory_error
+
+
+def setup_problem(rng, n=15, d=3, rank=5, **kw):
+    meas, truth = make_measurements(rng, n=n, d=d, **kw)
+    edges = edge_set_from_measurements(meas, dtype=jnp.float64)
+    problem = make_problem(edges, n)
+    return meas, edges, problem, truth
+
+
+def test_tcg_solves_spd_newton_system(rng):
+    # Validate the CG machinery itself on a synthetic SPD operator (the PGO
+    # Hessian away from a critical point is generally indefinite — tCG's
+    # negative-curvature exit there is by design and covered by the RTR
+    # convergence tests).
+    shape = (4, 3, 4)
+    dim = int(np.prod(shape))
+    B = rng.standard_normal((dim, dim))
+    Hmat = 4.0 * np.eye(dim) + B @ B.T / dim
+    g = jnp.asarray(rng.standard_normal(shape))
+    X = jnp.zeros(shape, jnp.float64)  # unused by hvp/precond below
+
+    hvp = lambda V: jnp.reshape(jnp.asarray(Hmat) @ jnp.reshape(V, (-1,)), shape)
+
+    res = solver.truncated_cg(X, g, hvp, lambda V: V, jnp.asarray(1e9),
+                              max_iters=200, kappa=1e-12, theta=1.0)
+    assert not bool(res.hit_boundary)
+    eta_exact = -np.linalg.solve(Hmat, np.asarray(g).reshape(-1)).reshape(shape)
+    assert np.allclose(res.eta, eta_exact, atol=1e-8)
+    # Heta bookkeeping must match H @ eta.
+    assert np.allclose(res.heta, np.asarray(hvp(res.eta)), atol=1e-8)
+
+    # Perfect preconditioner (M = H^{-1}): converges in one iteration.
+    Hinv = np.linalg.inv(Hmat)
+    pre = lambda V: jnp.reshape(jnp.asarray(Hinv) @ jnp.reshape(V, (-1,)), shape)
+    res1 = solver.truncated_cg(X, g, hvp, pre, jnp.asarray(1e9), max_iters=200,
+                               kappa=1e-10)
+    assert int(res1.iters) <= 2
+    assert np.allclose(res1.eta, eta_exact, atol=1e-8)
+
+    # Small radius: the step must land on the boundary.
+    res_b = solver.truncated_cg(X, g, hvp, lambda V: V, jnp.asarray(1e-3),
+                                max_iters=200)
+    assert bool(res_b.hit_boundary)
+    assert np.isclose(float(manifold.norm(res_b.eta)), 1e-3, rtol=1e-9)
+
+
+def test_tcg_on_pgo_model_decreases(rng):
+    # On the real (possibly indefinite) PGO Hessian, tCG must return a step
+    # with negative model value within the radius.
+    meas, edges, problem, (Rs, ts) = setup_problem(rng, num_lc=8)
+    ylift = jnp.eye(3, dtype=jnp.float64)
+    X_opt = lift(jnp.asarray(np.concatenate([Rs, ts[..., None]], -1)), ylift)
+    pert = 1e-2 * jax.random.normal(jax.random.PRNGKey(0), X_opt.shape, jnp.float64)
+    X = manifold.project(X_opt + pert)
+
+    eg = problem.egrad(X)
+    g = manifold.rgrad(X, eg)
+    hvp = lambda V: manifold.ehess_to_rhess(X, eg, problem.ehess(X, V), V)
+    pre = lambda V: manifold.tangent_project(X, problem.precond(X, V))
+    res = solver.truncated_cg(X, g, hvp, pre, jnp.asarray(10.0), max_iters=50)
+    m = float(manifold.inner(g, res.eta) + 0.5 * manifold.inner(res.eta, res.heta))
+    assert m < 0
+    assert float(manifold.norm(res.eta)) <= 10.0 * (1 + 1e-9)
+
+
+def test_rtr_solves_noiseless_graph_exactly(rng):
+    meas, edges, problem, (Rs, ts) = setup_problem(rng, num_lc=8)
+    n = meas.num_poses
+    ylift = jnp.eye(3, dtype=jnp.float64)
+    # Perturbed start: odometry-ish with noise.
+    X0 = lift(jnp.asarray(
+        np.concatenate([Rs + 0.1 * rng.standard_normal(Rs.shape),
+                        (ts + 0.5 * rng.standard_normal(ts.shape))[..., None]], -1)),
+        ylift)
+    X0 = manifold.project(X0)
+    params = SolverParams(initial_radius=10.0, max_inner_iters=50)
+    out = solver.rtr_solve(problem, X0, params, max_iters=100, grad_norm_tol=1e-8)
+    # Noiseless: optimal cost 0, exact recovery after rounding.
+    assert float(out.f) < 1e-12
+    T = round_solution(out.X, ylift)
+    assert trajectory_error(T, Rs, ts) < 1e-5
+
+
+def test_rtr_monotone_and_reaches_tol(rng):
+    meas, edges, problem, _ = setup_problem(rng, n=25, num_lc=12,
+                                            rot_noise=0.05, trans_noise=0.05)
+    n = meas.num_poses
+    from dpgo_tpu.ops import chordal
+    ylift = fixed_stiefel(5, 3, jnp.float64)
+    X0 = lift(chordal.chordal_initialization(edges, n), ylift)
+    f0 = float(problem.cost(X0))
+    params = SolverParams(initial_radius=100.0, max_inner_iters=50)
+    out = solver.rtr_solve(problem, X0, params, max_iters=200, grad_norm_tol=1e-6)
+    assert float(out.f) <= f0
+    assert float(out.grad_norm) < 1e-6
+
+
+def test_rtr_single_step_decreases_cost(rng):
+    meas, edges, problem, _ = setup_problem(rng, n=20, num_lc=10,
+                                            rot_noise=0.05, trans_noise=0.05)
+    n = meas.num_poses
+    from dpgo_tpu.ops import chordal
+    ylift = fixed_stiefel(5, 3, jnp.float64)
+    X0 = lift(chordal.chordal_initialization(edges, n), ylift)
+    # RBCD per-iteration budget (PGOAgent.cpp:1131-1137).
+    params = SolverParams(grad_norm_tol=1e-2, max_inner_iters=10,
+                          initial_radius=100.0)
+    out = solver.rtr_single_step(problem, X0, params)
+    f0 = float(problem.cost(X0))
+    assert float(out.f) <= f0
+    # Either the step was accepted or the gradient was already below tol.
+    assert bool(out.done) or float(out.grad_norm) < 1e-2
+
+
+def test_rtr_single_step_noop_below_tol(rng):
+    meas, edges, problem, (Rs, ts) = setup_problem(rng, num_lc=6)
+    ylift = jnp.eye(3, dtype=jnp.float64)
+    X_opt = lift(jnp.asarray(np.concatenate([Rs, ts[..., None]], -1)), ylift)
+    params = SolverParams(grad_norm_tol=1e-2)
+    out = solver.rtr_single_step(problem, X_opt, params)
+    # Already optimal (noiseless truth): unchanged.
+    assert np.allclose(out.X, X_opt, atol=1e-12)
+
+
+def test_rgd_step_decreases_cost(rng):
+    meas, edges, problem, _ = setup_problem(rng, n=15, num_lc=6,
+                                            rot_noise=0.05, trans_noise=0.05)
+    from dpgo_tpu.ops import chordal
+    ylift = fixed_stiefel(5, 3, jnp.float64)
+    X0 = lift(chordal.chordal_initialization(edges, meas.num_poses), ylift)
+    X1 = solver.rgd_step(problem, X0, stepsize=1e-4)
+    assert float(problem.cost(X1)) < float(problem.cost(X0))
+
+
+def test_rgd_linesearch_converges(rng):
+    meas, edges, problem, _ = setup_problem(rng, n=10, num_lc=4,
+                                            rot_noise=0.02, trans_noise=0.02)
+    from dpgo_tpu.ops import chordal
+    ylift = fixed_stiefel(5, 3, jnp.float64)
+    X0 = lift(chordal.chordal_initialization(edges, meas.num_poses), ylift)
+    X1 = solver.rgd_linesearch(problem, X0, max_iters=50, grad_norm_tol=1e-4)
+    assert float(problem.cost(X1)) <= float(problem.cost(X0))
+
+
+def test_block_jacobi_precond_speeds_tcg(rng):
+    # The preconditioner must reduce tCG iterations to a fixed residual
+    # target vs identity (SURVEY hard-part #2: validate iteration counts).
+    meas, edges, problem, _ = setup_problem(rng, n=40, num_lc=20,
+                                            rot_noise=0.05, trans_noise=0.05)
+    n = meas.num_poses
+    from dpgo_tpu.ops import chordal
+    ylift = fixed_stiefel(5, 3, jnp.float64)
+    X = lift(chordal.chordal_initialization(edges, n), ylift)
+    eg = problem.egrad(X)
+    g = manifold.rgrad(X, eg)
+    hvp = lambda V: manifold.ehess_to_rhess(X, eg, problem.ehess(X, V), V)
+
+    pre = lambda V: manifold.tangent_project(X, problem.precond(X, V))
+    res_pre = solver.truncated_cg(X, g, hvp, pre, jnp.asarray(1e9), 500, kappa=1e-6)
+    res_id = solver.truncated_cg(X, g, hvp, lambda V: V, jnp.asarray(1e9), 500, kappa=1e-6)
+    assert int(res_pre.iters) <= int(res_id.iters)
