@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from ..config import SolverParams
 from ..types import EdgeSet, Measurements, edge_set_from_measurements
-from ..utils.lie import fixed_stiefel, project_to_rotation
+from ..utils.lie import lifting_matrix, project_to_rotation
 from ..ops import chordal, manifold, quadratic, solver
 
 
@@ -76,8 +76,7 @@ def _solve_local_jit(edges: EdgeSet, n: int, rank: int, params: SolverParams,
     else:
         raise ValueError(f"unknown init {init!r}")
 
-    ylift = fixed_stiefel(rank, d, dtype) if rank > d \
-        else jnp.eye(rank, d, dtype=dtype)
+    ylift = lifting_matrix(rank, d, dtype)
     X0 = lift(T0, ylift)
     problem = make_problem(edges, n, params.precond_shift)
     out = solver.rtr_solve(problem, X0, params, max_iters=max_iters,

@@ -1,0 +1,429 @@
+"""Multi-agent Riemannian block-coordinate descent (RBCD) — the distributed
+core of the framework.
+
+Replaces the reference's per-robot ``PGOAgent`` object graph
+(``src/PGOAgent.cpp``) and the in-process message loop of
+``examples/MultiRobotExample.cpp`` with a TPU-native design (SURVEY.md
+section 7): all agents' states live in one batched array ``X: [A, n_max, r,
+d+1]``, a single jitted step function updates the selected/all blocks, and
+"communication" is an array gather of the public-pose table (a collective in
+the sharded path, ``dpgo_tpu.parallel``).
+
+Mapping to the reference:
+
+* measurement classification odometry / private LC / shared LC
+  (``PGOAgent.cpp:197-248``)  ->  host-side graph builder, one padded
+  ``EdgeSet`` per agent whose indices point into a per-agent buffer
+  ``[local poses | neighbor slots]``.
+* ``constructQMatrix`` / ``constructGMatrix`` (``PGOAgent.cpp:720-859``)
+  ->  nothing to construct: the per-agent cost/gradient/Hessian evaluate
+  edge-wise against the buffer (``ops.quadratic``); fixed neighbor slots
+  reproduce Q's shared-edge diagonal blocks and the linear term G exactly.
+* ``iterate(true)`` + ``QuadraticOptimizer`` (``PGOAgent.cpp:642-718``,
+  ``1093-1145``)  ->  ``ops.solver.rtr_single_step`` vmapped over agents.
+* greedy selection by block gradient norm
+  (``MultiRobotExample.cpp:242-256``)  ->  GREEDY schedule (argmax of the
+  per-agent Riemannian gradient norms, computed locally — no centralized
+  oracle needed).  JACOBI updates all agents each round (the TPU-native
+  default; Jacobi-style parallel RBCD is what the reference's async mode
+  realizes in wall-clock).  ASYNC fires each agent with an independent
+  Bernoulli clock per round (``PGOAgent.cpp:876-898`` semantics).
+* termination status gossip (``PGOAgent.h:163-207``, ``shouldTerminate``,
+  ``PGOAgent.cpp:1007-1031``)  ->  per-agent relative-change array reduced
+  with ``all``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import AgentParams, Schedule
+from ..types import EdgeSet, Measurements, edge_set_from_measurements
+from ..utils.lie import lifting_matrix as _lifting_matrix
+from ..utils.partition import Partition, partition_contiguous
+from ..ops import chordal, manifold, quadratic, solver
+from .local_pgo import lift, round_solution
+
+
+@dataclasses.dataclass(frozen=True)
+class GraphMeta:
+    """Static shape metadata (hashable; a jit static argument)."""
+
+    num_robots: int
+    n_max: int
+    e_max: int
+    s_max: int  # neighbor slots per agent
+    p_max: int  # public poses per agent
+    d: int
+    rank: int
+
+
+class MultiAgentGraph(NamedTuple):
+    """Batched per-agent problem data (pytree of [A, ...] arrays)."""
+
+    edges: EdgeSet  # fields [A, E_max]; i/j index into [n_max + S_max] buffer
+    meas_id: jax.Array  # [A, E_max] global measurement id (weight consistency)
+    n: jax.Array  # [A] pose counts
+    pose_mask: jax.Array  # [A, n_max]
+    pub_idx: jax.Array  # [A, P_max] local indices of public poses
+    pub_mask: jax.Array  # [A, P_max]
+    nbr_robot: jax.Array  # [A, S_max]
+    nbr_pub: jax.Array  # [A, S_max] slot into that robot's public row
+    nbr_mask: jax.Array  # [A, S_max]
+    global_index: jax.Array  # [A, n_max] local -> global pose id (0 for pad)
+
+
+class RBCDState(NamedTuple):
+    X: jax.Array  # [A, n_max, r, d+1]
+    weights: jax.Array  # [A, E_max] robust (GNC) weights per edge
+    iteration: jax.Array  # int32
+    key: jax.Array  # PRNG key (async schedule)
+    rel_change: jax.Array  # [A]
+    ready: jax.Array  # [A] bool
+
+
+def build_graph(part: Partition, rank: int, dtype=jnp.float32):
+    """Assemble padded per-agent arrays from a partitioned measurement set.
+
+    Each shared measurement appears in both endpoint agents' edge lists with
+    the remote endpoint redirected to a neighbor slot — the same double
+    bookkeeping as ``PGOAgent::addSharedLoopClosure`` (reference
+    ``PGOAgent.cpp:228-248``), but as index arrays instead of dictionaries.
+    """
+    A = part.num_robots
+    meas = part.meas
+    d = meas.d
+    n_max = part.n_max
+    M = len(meas)
+
+    # Public poses: local endpoints of inter-robot edges.
+    pub: list[dict[int, int]] = [dict() for _ in range(A)]
+    for k in range(M):
+        a, b = int(meas.r1[k]), int(meas.r2[k])
+        if a != b:
+            pub[a].setdefault(int(meas.p1[k]), len(pub[a]))
+            pub[b].setdefault(int(meas.p2[k]), len(pub[b]))
+
+    # Neighbor slots: remote (robot, pose) pairs referenced per agent.
+    nbr: list[dict[tuple[int, int], int]] = [dict() for _ in range(A)]
+    edge_rows: list[list[tuple]] = [[] for _ in range(A)]  # (i, j, meas_id)
+    for k in range(M):
+        a, b = int(meas.r1[k]), int(meas.r2[k])
+        p, q = int(meas.p1[k]), int(meas.p2[k])
+        if a == b:
+            edge_rows[a].append((p, q, k))
+        else:
+            sa = nbr[a].setdefault((b, q), len(nbr[a]))
+            edge_rows[a].append((p, n_max + sa, k))
+            sb = nbr[b].setdefault((a, p), len(nbr[b]))
+            edge_rows[b].append((n_max + sb, q, k))
+
+    e_max = max(1, max(len(r) for r in edge_rows))
+    s_max = max(1, max(len(x) for x in nbr))
+    p_max = max(1, max(len(x) for x in pub))
+
+    cls = part.classify()  # 0 odo, 1 private LC, 2 shared
+
+    ei = np.zeros((A, e_max), np.int32)
+    ej = np.zeros((A, e_max), np.int32)
+    eR = np.tile(np.eye(d), (A, e_max, 1, 1))
+    et = np.zeros((A, e_max, d))
+    ekap = np.zeros((A, e_max))
+    etau = np.zeros((A, e_max))
+    emask = np.zeros((A, e_max))
+    eis_lc = np.zeros((A, e_max))
+    efix = np.zeros((A, e_max))
+    eweight = np.ones((A, e_max))
+    meas_id = np.zeros((A, e_max), np.int32)
+
+    for a in range(A):
+        for idx, (i, j, k) in enumerate(edge_rows[a]):
+            ei[a, idx] = i
+            ej[a, idx] = j
+            eR[a, idx] = meas.R[k]
+            et[a, idx] = meas.t[k]
+            ekap[a, idx] = meas.kappa[k]
+            etau[a, idx] = meas.tau[k]
+            emask[a, idx] = 1.0
+            eis_lc[a, idx] = 0.0 if cls[k] == 0 else 1.0
+            efix[a, idx] = float(meas.is_known_inlier[k])
+            eweight[a, idx] = meas.weight[k]
+            meas_id[a, idx] = k
+
+    pub_idx = np.zeros((A, p_max), np.int64)
+    pub_mask = np.zeros((A, p_max))
+    for a in range(A):
+        for q, pos in pub[a].items():
+            pub_idx[a, pos] = q
+            pub_mask[a, pos] = 1.0
+
+    nbr_robot = np.zeros((A, s_max), np.int32)
+    nbr_pub = np.zeros((A, s_max), np.int32)
+    nbr_mask = np.zeros((A, s_max))
+    for a in range(A):
+        for (b, q), slot in nbr[a].items():
+            nbr_robot[a, slot] = b
+            nbr_pub[a, slot] = pub[b][q]
+            nbr_mask[a, slot] = 1.0
+
+    pose_mask = (np.arange(n_max)[None, :] < part.n[:, None]).astype(np.float64)
+
+    edges = EdgeSet(
+        i=jnp.asarray(ei), j=jnp.asarray(ej),
+        R=jnp.asarray(eR, dtype), t=jnp.asarray(et, dtype),
+        kappa=jnp.asarray(ekap, dtype), tau=jnp.asarray(etau, dtype),
+        weight=jnp.asarray(eweight, dtype), mask=jnp.asarray(emask, dtype),
+        is_lc=jnp.asarray(eis_lc, dtype), fixed_weight=jnp.asarray(efix, dtype),
+    )
+    graph = MultiAgentGraph(
+        edges=edges,
+        meas_id=jnp.asarray(meas_id),
+        n=jnp.asarray(part.n, jnp.int32),
+        pose_mask=jnp.asarray(pose_mask, dtype),
+        pub_idx=jnp.asarray(np.maximum(pub_idx, 0), jnp.int32),
+        pub_mask=jnp.asarray(pub_mask, dtype),
+        nbr_robot=jnp.asarray(nbr_robot),
+        nbr_pub=jnp.asarray(nbr_pub),
+        nbr_mask=jnp.asarray(nbr_mask, dtype),
+        global_index=jnp.asarray(np.maximum(part.global_index, 0), jnp.int32),
+    )
+    meta = GraphMeta(num_robots=A, n_max=n_max, e_max=e_max, s_max=s_max,
+                     p_max=p_max, d=d, rank=rank)
+    return graph, meta
+
+
+# ---------------------------------------------------------------------------
+# Global <-> per-agent layout
+# ---------------------------------------------------------------------------
+
+def scatter_to_agents(Xg: jax.Array, graph: MultiAgentGraph) -> jax.Array:
+    """Global pose array [N, ...] -> per-agent [A, n_max, ...]."""
+    return Xg[graph.global_index]
+
+
+def gather_to_global(Xa: jax.Array, graph: MultiAgentGraph, n_total: int) -> jax.Array:
+    """Per-agent [A, n_max, ...] -> global [N, ...] (padding dropped)."""
+    flat_idx = graph.global_index.reshape(-1)
+    flat = Xa.reshape((-1,) + Xa.shape[2:])
+    w = graph.pose_mask.reshape(-1)
+    out = jnp.zeros((n_total,) + Xa.shape[2:], Xa.dtype)
+    return out.at[flat_idx].add(flat * w.reshape((-1,) + (1,) * (Xa.ndim - 2)))
+
+
+def public_table(X: jax.Array, graph: MultiAgentGraph) -> jax.Array:
+    """Extract each agent's public poses: [A, P_max, r, d+1].
+
+    This is the message payload of the framework — the analog of
+    ``getSharedPoseDict`` (reference ``PGOAgent.cpp:95-105``).
+    """
+    return jax.vmap(lambda x, idx: x[idx])(X, graph.pub_idx)
+
+
+def neighbor_buffer(Xpub: jax.Array, graph: MultiAgentGraph) -> jax.Array:
+    """Resolve neighbor slots from the (gathered) public table:
+    [A, S_max, r, d+1].  The analog of ``updateNeighborPoses``
+    (reference ``PGOAgent.cpp:434-458``)."""
+    Z = Xpub[graph.nbr_robot, graph.nbr_pub]
+    return Z * graph.nbr_mask[:, :, None, None]
+
+
+# ---------------------------------------------------------------------------
+# The jitted step
+# ---------------------------------------------------------------------------
+
+def _agent_local_problem(z, edges, chol, n_max):
+    """Solver closures for one agent given fixed neighbor buffer z."""
+
+    def buf(Xl):
+        return jnp.concatenate([Xl, z], axis=0)
+
+    n_buf = n_max + z.shape[0]
+    return solver.Problem(
+        cost=lambda Xl: quadratic.cost(buf(Xl), edges),
+        egrad=lambda Xl: quadratic.egrad(buf(Xl), edges, n_out=n_max),
+        ehess=lambda Xl, V: quadratic.hessvec(V, edges, n_buf=n_buf),
+        precond=lambda Xl, V: quadratic.precond_apply(chol, V),
+    )
+
+
+def _agent_update(X_local, z, edges, params: AgentParams):
+    """One local RTR step for a single agent (vmapped over A).
+
+    Returns the updated block and the block gradient norm at the *starting*
+    point — the greedy selection metric (``MultiRobotExample.cpp:242-256``)
+    — which the solver computes anyway.
+    """
+    n_max = X_local.shape[0]
+    blocks = quadratic.diag_blocks(edges, n_max + z.shape[0], n_out=n_max)
+    chol = quadratic.precond_factors(blocks, params.solver.precond_shift)
+    problem = _agent_local_problem(z, edges, chol, n_max)
+    out = solver.rtr_single_step(problem, X_local, params.solver)
+    return out.X, out.grad_norm_init
+
+
+@partial(jax.jit, static_argnames=("meta", "params"))
+def rbcd_step(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
+              params: AgentParams) -> RBCDState:
+    """One synchronous RBCD round over all agents.
+
+    Communication happens once per round: the public-pose table is built
+    from X and re-distributed to neighbor buffers (plain gathers here; an
+    all-gather collective in the sharded path).
+    """
+    X = state.X
+    edges = graph.edges._replace(weight=state.weights)
+
+    Xpub = public_table(X, graph)
+    Z = neighbor_buffer(Xpub, graph)
+
+    X_upd, gn0 = jax.vmap(lambda x, z, e: _agent_update(x, z, e, params))(X, Z, edges)
+
+    schedule = params.schedule
+    key, sub = jax.random.split(state.key)
+    if schedule == Schedule.JACOBI:
+        fired = jnp.ones((meta.num_robots,), bool)
+    elif schedule == Schedule.GREEDY:
+        fired = jnp.arange(meta.num_robots) == jnp.argmax(gn0)
+    elif schedule == Schedule.ASYNC:
+        fired = jax.random.bernoulli(sub, params.async_update_prob,
+                                     (meta.num_robots,))
+    else:
+        raise ValueError(f"unknown schedule {schedule}")
+    X_next = jnp.where(fired[:, None, None, None], X_upd, X)
+
+    # Status update (reference PGOAgent.cpp:703-716): masked relative change.
+    # Only fired agents refresh their status — non-selected agents keep their
+    # previous readiness, as iterate(false) does in the reference.
+    diff = (X_next - X) * graph.pose_mask[:, :, None, None]
+    rel_new = jnp.sqrt(jnp.sum(diff * diff, axis=(1, 2, 3)) /
+                       jnp.maximum(graph.n.astype(X.dtype), 1.0))
+    rel = jnp.where(fired, rel_new, state.rel_change)
+    ready = jnp.where(fired, rel_new <= params.rel_change_tol, state.ready)
+
+    return RBCDState(X=X_next, weights=state.weights,
+                     iteration=state.iteration + 1, key=key,
+                     rel_change=rel, ready=ready)
+
+
+# ---------------------------------------------------------------------------
+# Initialization, rounding, and the high-level driver
+# ---------------------------------------------------------------------------
+
+def init_state(graph: MultiAgentGraph, meta: GraphMeta, X0: jax.Array,
+               seed: int = 0) -> RBCDState:
+    A = meta.num_robots
+    dtype = X0.dtype
+    return RBCDState(
+        X=X0,
+        weights=graph.edges.weight,
+        iteration=jnp.array(0, jnp.int32),
+        key=jax.random.PRNGKey(seed),
+        rel_change=jnp.full((A,), jnp.inf, dtype),
+        ready=jnp.zeros((A,), bool),
+    )
+
+
+def centralized_chordal_init(part: Partition, meta: GraphMeta, graph: MultiAgentGraph,
+                             dtype=jnp.float32) -> jax.Array:
+    """Centralized chordal init, lifted and scattered to agents — the demo
+    initialization of ``MultiRobotExample.cpp:158-165``."""
+    edges_g = edge_set_from_measurements(part.meas_global, dtype=dtype)
+    T0 = chordal.chordal_initialization(edges_g, part.meas_global.num_poses)
+    ylift = lifting_matrix(meta, dtype)
+    X0g = lift(T0, ylift)
+    return scatter_to_agents(X0g, graph)
+
+
+def lifting_matrix(meta: GraphMeta, dtype=jnp.float32) -> jax.Array:
+    """The shared lifting matrix YLift for this problem's (rank, d)."""
+    return _lifting_matrix(meta.rank, meta.d, dtype)
+
+
+def round_global(Xg: jax.Array, ylift: jax.Array) -> jax.Array:
+    """Round a global lifted solution to SE(d) and express it in the frame of
+    the global anchor (pose 0 = identity), as
+    ``getTrajectoryInGlobalFrame`` does (reference ``PGOAgent.cpp:500-519``)."""
+    T = round_solution(Xg, ylift)
+    d = ylift.shape[1]
+    R, t = T[..., :d], T[..., d]
+    Ra_inv = R[0].T
+    R_out = jnp.einsum("ab,nbc->nac", Ra_inv, R)
+    t_out = jnp.einsum("ab,nb->na", Ra_inv, t - t[0])
+    return jnp.concatenate([R_out, t_out[..., None]], axis=-1)
+
+
+@dataclasses.dataclass
+class RBCDResult:
+    T: jax.Array  # [N, d, d+1] rounded global trajectory
+    X: jax.Array  # [A, n_max, r, d+1]
+    cost_history: list
+    grad_norm_history: list
+    iterations: int
+    terminated_by: str
+
+
+def solve_rbcd(
+    meas: Measurements,
+    num_robots: int,
+    params: AgentParams | None = None,
+    max_iters: int | None = None,
+    grad_norm_tol: float = 0.1,
+    eval_every: int = 1,
+    dtype=jnp.float64,
+    part: Partition | None = None,
+) -> RBCDResult:
+    """Distributed solve with centralized monitoring — the analog of the
+    ``multi-robot-example`` driver loop (``MultiRobotExample.cpp:175-264``):
+    per round, all agents exchange public poses and update per the schedule;
+    the centralized cost/gradnorm trace gates termination at ``grad_norm_tol``
+    (0.1 in the reference driver)."""
+    params = params or AgentParams(d=meas.d, r=5, num_robots=num_robots)
+    max_iters = params.max_num_iters if max_iters is None else max_iters
+
+    part = part or partition_contiguous(meas, num_robots)
+    graph, meta = build_graph(part, params.r, dtype)
+    X0 = centralized_chordal_init(part, meta, graph, dtype)
+    state = init_state(graph, meta, X0)
+
+    # Centralized evaluation problem (the demo's oracle, used for the
+    # convergence gate and benchmark curves).
+    n_total = part.meas_global.num_poses
+    edges_g = edge_set_from_measurements(part.meas_global, dtype=dtype)
+
+    @jax.jit
+    def central_metrics(Xa):
+        Xg = gather_to_global(Xa, graph, n_total)
+        f = quadratic.cost(Xg, edges_g)
+        g = manifold.rgrad(Xg, quadratic.egrad(Xg, edges_g))
+        return f, manifold.norm(g)
+
+    cost_hist, gn_hist = [], []
+    terminated_by = "max_iters"
+    it = 0
+    for it in range(max_iters):
+        state = rbcd_step(state, graph, meta, params)
+        # Host syncs (metrics readback + consensus flag) only every
+        # eval_every rounds so device dispatch stays ahead of the host.
+        if (it + 1) % eval_every == 0:
+            f, gn = central_metrics(state.X)
+            cost_hist.append(float(f))
+            gn_hist.append(float(gn))
+            if float(gn) < grad_norm_tol:
+                terminated_by = "grad_norm"
+                break
+            if bool(jnp.all(state.ready)):
+                terminated_by = "consensus"
+                break
+
+    ylift = lifting_matrix(meta, dtype)
+    Xg = gather_to_global(state.X, graph, n_total)
+    T = round_global(Xg, ylift)
+    return RBCDResult(T=T, X=state.X, cost_history=cost_hist,
+                      grad_norm_history=gn_hist, iterations=it + 1,
+                      terminated_by=terminated_by)

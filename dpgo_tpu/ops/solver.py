@@ -137,6 +137,7 @@ class RTRState(NamedTuple):
     radius: jax.Array
     f: jax.Array
     grad_norm: jax.Array
+    grad_norm_init: jax.Array  # gradient norm at the starting point
     iters: jax.Array
     accepted: jax.Array  # was the last proposed step accepted?
     done: jax.Array
@@ -195,11 +196,13 @@ def rtr_solve(problem: Problem, X0: jax.Array, params: SolverParams,
         g_new = manifold.rgrad(X_new, eg_new)
         gn = manifold.norm(g_new)
         return (RTRState(X=X_new, radius=radius, f=f_new, grad_norm=gn,
+                         grad_norm_init=rtr.grad_norm_init,
                          iters=rtr.iters + 1, accepted=accept, done=gn < gtol),
                 eg_new, g_new)
 
     init = (RTRState(X=X0, radius=jnp.asarray(params.initial_radius, X0.dtype),
-                     f=f0, grad_norm=gn0, iters=jnp.array(0, jnp.int32),
+                     f=f0, grad_norm=gn0, grad_norm_init=gn0,
+                     iters=jnp.array(0, jnp.int32),
                      accepted=jnp.array(False), done=gn0 < gtol),
             eg0, g0)
     out, _, _ = jax.lax.while_loop(cond, body, init)
@@ -229,11 +232,12 @@ def rtr_single_step(problem: Problem, X0: jax.Array,
     def body(s: RTRState):
         X_new, f_new, accept, _, _ = _rtr_attempt(problem, s.X, s.f, g, eg, s.radius, params)
         return RTRState(X=X_new, radius=jnp.where(accept, s.radius, s.radius / 4.0),
-                        f=f_new, grad_norm=s.grad_norm, iters=s.iters + 1,
-                        accepted=accept, done=accept)
+                        f=f_new, grad_norm=s.grad_norm, grad_norm_init=s.grad_norm_init,
+                        iters=s.iters + 1, accepted=accept, done=accept)
 
     init = RTRState(X=X0, radius=jnp.asarray(params.initial_radius, X0.dtype),
-                    f=f0, grad_norm=gn0, iters=jnp.array(0, jnp.int32),
+                    f=f0, grad_norm=gn0, grad_norm_init=gn0,
+                    iters=jnp.array(0, jnp.int32),
                     accepted=jnp.array(False), done=below_tol)
     out = jax.lax.while_loop(cond, body, init)
     # Recompute the gradient norm at the final point for status reporting.

@@ -143,6 +143,21 @@ def fixed_stiefel(r: int, d: int, dtype=jnp.float32) -> jax.Array:
     return random_stiefel(jax.random.PRNGKey(1), r, d, dtype=jnp.float64).astype(dtype)
 
 
+def lifting_matrix(rank: int, d: int, dtype=jnp.float32) -> jax.Array:
+    """The shared lifting matrix YLift in St(rank, d).
+
+    Identity for rank == d (no relaxation); the deterministic fixed Stiefel
+    element otherwise (robot 0 generates and broadcasts it in the reference,
+    ``PGOAgent.cpp:46``; determinism makes every agent agree without a
+    broadcast).  Single source of truth for the rank-lifting policy.
+    """
+    if rank < d:
+        raise ValueError(f"relaxation rank {rank} must be >= d = {d}")
+    if rank == d:
+        return jnp.eye(d, dtype=dtype)
+    return fixed_stiefel(rank, d, dtype)
+
+
 def angular_to_chordal_so3(rad: float) -> float:
     """Angular distance (radians) -> chordal (Frobenius) distance on SO(3).
 

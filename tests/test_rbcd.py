@@ -1,0 +1,158 @@
+"""Multi-agent sync RBCD tests (reference multi-robot-example semantics)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpgo_tpu.config import AgentParams, Schedule, SolverParams
+from dpgo_tpu.models import local_pgo, rbcd
+from dpgo_tpu.ops import manifold, quadratic
+from dpgo_tpu.types import edge_set_from_measurements
+from dpgo_tpu.utils.partition import partition_contiguous
+from synthetic import make_measurements, trajectory_error
+
+
+def test_partition_contiguous(rng):
+    meas, _ = make_measurements(rng, n=20, d=3, num_lc=10)
+    part = partition_contiguous(meas, 4)
+    assert part.n.sum() == 20
+    assert part.n.tolist() == [5, 5, 5, 5]
+    cls = part.classify()
+    # Edge categories consistent: every shared edge crosses robots.
+    shared = cls == 2
+    assert np.all(part.meas.r1[shared] != part.meas.r2[shared])
+    assert np.all(part.meas.r1[~shared] == part.meas.r2[~shared])
+    # Round trip local -> global matches original global ids.
+    g1 = part.global_index[part.meas.r1, part.meas.p1]
+    assert np.array_equal(g1, part.meas_global.p1)
+
+
+def test_partition_by_keys(rng):
+    import dataclasses
+
+    from dpgo_tpu.utils.partition import partition_by_keys
+
+    # Build a 2-robot measurement set with robot-encoded, NON-dense pose ids
+    # (robot 98's ids start at 10).
+    meas, _ = make_measurements(rng, n=20, d=3, num_lc=10)
+    robot_of = (np.arange(20) >= 10).astype(np.int32)
+    keyed = dataclasses.replace(
+        meas,
+        r1=np.where(robot_of[meas.p1] == 0, 97, 98).astype(np.int32),
+        r2=np.where(robot_of[meas.p2] == 0, 97, 98).astype(np.int32),
+        p1=meas.p1,  # robot 98's local ids are 10..19: not dense from 0
+        p2=meas.p2,
+    )
+    part = partition_by_keys(keyed)
+    assert part.num_robots == 2
+    assert part.n.tolist() == [10, 10]
+    # Local ids densified to 0..9 per robot.
+    assert part.meas.p1.max() < 10 and part.meas.p2.max() < 10
+    # Global indexing is a bijection onto 0..19.
+    gids = np.unique(np.concatenate([part.meas_global.p1, part.meas_global.p2]))
+    assert len(gids) == 20
+    # The partitioned problem still solves to the same optimum.
+    params = AgentParams(d=3, r=5, num_robots=2, schedule=Schedule.JACOBI)
+    res = rbcd.solve_rbcd(part.meas_global, 2, params, max_iters=100,
+                          grad_norm_tol=1e-5, part=part)
+    assert res.grad_norm_history[-1] < 1e-5
+
+
+def test_local_problems_reproduce_global_cost_and_grad(rng):
+    # Sum of per-agent private costs + half-counted shared costs == global
+    # cost; per-agent block gradient == global gradient restricted to block.
+    meas, _ = make_measurements(rng, n=24, d=3, num_lc=12,
+                                rot_noise=0.05, trans_noise=0.05)
+    part = partition_contiguous(meas, 4)
+    graph, meta = rbcd.build_graph(part, rank=5, dtype=jnp.float64)
+
+    Xg = jnp.asarray(np.random.default_rng(1).standard_normal((24, 5, 4)))
+    Xa = rbcd.scatter_to_agents(Xg, graph)
+    Z = rbcd.neighbor_buffer(rbcd.public_table(Xa, graph), graph)
+
+    edges_g = edge_set_from_measurements(part.meas_global, dtype=jnp.float64)
+    g_global = quadratic.egrad(Xg, edges_g)
+
+    for a in range(4):
+        buf = jnp.concatenate([Xa[a], Z[a]], axis=0)
+        import jax
+
+        g_local = quadratic.egrad(buf, jax.tree.map(lambda x: x[a], graph.edges),
+                                  n_out=meta.n_max)
+        na = int(graph.n[a])
+        expected = g_global[part.global_index[a, :na]]
+        assert np.allclose(g_local[:na], expected, atol=1e-10), f"agent {a}"
+
+
+@pytest.mark.parametrize("schedule", [Schedule.JACOBI, Schedule.GREEDY])
+def test_rbcd_converges_noiseless(rng, schedule):
+    meas, (Rs, ts) = make_measurements(rng, n=20, d=3, num_lc=10)
+    params = AgentParams(d=3, r=5, num_robots=4, schedule=schedule,
+                         solver=SolverParams())
+    res = rbcd.solve_rbcd(meas, 4, params, max_iters=200, grad_norm_tol=1e-6)
+    assert res.grad_norm_history[-1] < 1e-6
+    assert trajectory_error(res.T, Rs, ts) < 1e-4
+
+
+def test_rbcd_matches_centralized_on_noisy_graph(rng):
+    meas, _ = make_measurements(rng, n=30, d=3, num_lc=15,
+                                rot_noise=0.05, trans_noise=0.05)
+    central = local_pgo.solve_local(meas, rank=5, grad_norm_tol=1e-6,
+                                    max_iters=300)
+    params = AgentParams(d=3, r=5, num_robots=5, schedule=Schedule.JACOBI)
+    res = rbcd.solve_rbcd(meas, 5, params, max_iters=300, grad_norm_tol=1e-4)
+    # Distributed must reach (nearly) the centralized optimum.
+    assert res.cost_history[-1] <= central.cost * 1.01 + 1e-9
+
+
+def test_rbcd_cost_monotone_jacobi(rng):
+    meas, _ = make_measurements(rng, n=24, d=3, num_lc=10,
+                                rot_noise=0.05, trans_noise=0.05)
+    params = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.JACOBI)
+    res = rbcd.solve_rbcd(meas, 4, params, max_iters=30, grad_norm_tol=0.0)
+    c = res.cost_history
+    # Jacobi RBCD on a partitioned quadratic need not be strictly monotone in
+    # theory, but on these graphs it should never increase materially.
+    assert all(c[k + 1] <= c[k] * (1 + 1e-6) + 1e-9 for k in range(len(c) - 1))
+
+
+def test_rbcd_async_schedule_runs(rng):
+    meas, _ = make_measurements(rng, n=20, d=3, num_lc=8,
+                                rot_noise=0.03, trans_noise=0.03)
+    params = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.ASYNC,
+                         async_update_prob=0.5)
+    res = rbcd.solve_rbcd(meas, 4, params, max_iters=60, grad_norm_tol=1e-3)
+    assert res.cost_history[-1] <= res.cost_history[0]
+
+
+def test_rbcd_se2(rng):
+    meas, _ = make_measurements(rng, n=20, d=2, num_lc=8,
+                                rot_noise=0.02, trans_noise=0.02)
+    # Tight rel-change tol so the consensus gate (reference default 5e-3)
+    # doesn't stop the solve early, and a tight local-solver gradnorm tol
+    # (the reference's per-step 1e-2 floor would cap global convergence).
+    params = AgentParams(d=2, r=3, num_robots=4, schedule=Schedule.JACOBI,
+                         rel_change_tol=1e-10,
+                         solver=SolverParams(grad_norm_tol=1e-7))
+    res = rbcd.solve_rbcd(meas, 4, params, max_iters=100, grad_norm_tol=1e-4)
+    assert res.grad_norm_history[-1] < 1e-4
+
+
+def test_rbcd_smallgrid_vs_centralized(data_dir):
+    # The reference demo config: 5 robots on smallGrid3D, r = 5
+    # (README.md:31-34, MultiRobotExample gate gradnorm < 0.1).
+    from dpgo_tpu.utils.g2o import read_g2o
+
+    meas = read_g2o(f"{data_dir}/smallGrid3D.g2o")
+    central = local_pgo.solve_local(meas, rank=5, grad_norm_tol=1e-3,
+                                    max_iters=300)
+    params = AgentParams(d=3, r=5, num_robots=5, schedule=Schedule.JACOBI)
+    res = rbcd.solve_rbcd(meas, 5, params, max_iters=100, grad_norm_tol=0.1)
+    # Either gate may fire first (the consensus rel-change default 5e-3 is
+    # the reference's); what matters is solution quality.
+    assert res.terminated_by in ("grad_norm", "consensus")
+    assert res.cost_history[-1] <= central.cost * 1.05
+    # Anchored output frame: pose 0 is the identity.
+    T = np.asarray(res.T)
+    assert np.allclose(T[0, :, :3], np.eye(3), atol=1e-8)
+    assert np.allclose(T[0, :, 3], 0.0, atol=1e-8)
