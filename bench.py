@@ -1,0 +1,127 @@
+"""Benchmark: synchronous RBCD throughput on sphere2500 with 8 agents, r=5
+(BASELINE.md north-star config #2).
+
+Measures full RBCD rounds/sec — each round = public-pose exchange + one RTR
+(truncated-CG) step for every agent — on the default JAX backend (TPU when
+present), and the same problem on the CPU backend in float64 as the
+stand-in for the reference's SuiteSparse/ROPTLIB CPU implementation (the
+reference publishes no numbers and its ROPTLIB dependency is git-fetched at
+configure time, unavailable offline — see BASELINE.md).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+DATASET = "/root/reference/data/sphere2500.g2o"
+NUM_ROBOTS = 8
+RANK = 5
+ROUNDS = int(os.environ.get("BENCH_ROUNDS", "30"))
+CPU_ROUNDS = int(os.environ.get("BENCH_CPU_ROUNDS", "10"))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build(dtype):
+    from dpgo_tpu.config import AgentParams
+    from dpgo_tpu.models import rbcd
+    from dpgo_tpu.utils.partition import partition_contiguous
+
+    if os.path.exists(DATASET):
+        from dpgo_tpu.utils.g2o import read_g2o
+        meas = read_g2o(DATASET)
+    else:  # fall back to a same-order synthetic problem
+        from dpgo_tpu.utils.synthetic import make_measurements
+        meas, _ = make_measurements(np.random.default_rng(0), n=2500, d=3,
+                                    num_lc=2449, rot_noise=0.01,
+                                    trans_noise=0.01)
+    params = AgentParams(d=3, r=RANK, num_robots=NUM_ROBOTS)
+    part = partition_contiguous(meas, NUM_ROBOTS)
+    graph, meta = rbcd.build_graph(part, RANK, dtype)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, dtype)
+    state = rbcd.init_state(graph, meta, X0)
+    return state, graph, meta, params
+
+
+def time_rounds(device, dtype, rounds):
+    import jax
+    from dpgo_tpu.models import rbcd
+
+    state, graph, meta, params = build(dtype)
+    state = jax.device_put(state, device)
+    graph = jax.device_put(graph, device)
+
+    step = lambda s: rbcd.rbcd_step(s, graph, meta, params)
+    t0 = time.perf_counter()
+    state = step(state)
+    jax.block_until_ready(state.X)
+    log(f"  [{device.platform}] compile+first round: "
+        f"{time.perf_counter() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        state = step(state)
+    jax.block_until_ready(state.X)
+    dt = time.perf_counter() - t0
+    assert bool(np.isfinite(np.asarray(state.X)).all()), "non-finite state"
+    return rounds / dt
+
+
+def cpu_baseline_subprocess() -> float:
+    """Measure the f64 CPU baseline in a clean subprocess (x64 must be on
+    for a true double-precision run, but enabling it in the TPU process
+    breaks the tunnel compiler)."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_ENABLE_X64="1",
+               BENCH_MODE="cpu")
+    out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                         env=env, capture_output=True, text=True, timeout=1800)
+    sys.stderr.write(out.stderr)
+    if out.returncode != 0:
+        raise RuntimeError(f"cpu baseline failed:\n{out.stderr[-2000:]}")
+    return float(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("BENCH_MODE") == "cpu":
+        cpu = jax.devices("cpu")[0]
+        ips = time_rounds(cpu, jnp.float64, CPU_ROUNDS)
+        log(f"  cpu baseline: {ips:.2f} rounds/s (float64)")
+        print(ips)
+        return
+
+    dev = jax.devices()[0]
+    log(f"benchmark device: {dev.platform} ({dev.device_kind})")
+    bench_dtype = "float32" if dev.platform != "cpu" else "float64"
+    ips = time_rounds(dev, getattr(jnp, bench_dtype), ROUNDS)
+    log(f"  {ips:.2f} RBCD rounds/s ({bench_dtype})")
+
+    if dev.platform == "cpu":
+        cpu_ips = ips
+    else:
+        cpu_ips = cpu_baseline_subprocess()
+
+    print(json.dumps({
+        "metric": "rbcd_rounds_per_sec_sphere2500_8agents_r5",
+        "value": round(ips, 3),
+        "unit": "rounds/s",
+        "vs_baseline": round(ips / cpu_ips, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

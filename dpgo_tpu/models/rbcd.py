@@ -83,7 +83,7 @@ class RBCDState(NamedTuple):
     X: jax.Array  # [A, n_max, r, d+1]
     weights: jax.Array  # [A, E_max] robust (GNC) weights per edge
     iteration: jax.Array  # int32
-    key: jax.Array  # PRNG key (async schedule)
+    key: jax.Array  # [A, 2] per-agent PRNG keys (async schedule)
     rel_change: jax.Array  # [A]
     ready: jax.Array  # [A] bool
 
@@ -267,32 +267,46 @@ def _agent_update(X_local, z, edges, params: AgentParams):
     return out.X, out.grad_norm_init
 
 
-@partial(jax.jit, static_argnames=("meta", "params"))
-def rbcd_step(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
-              params: AgentParams) -> RBCDState:
-    """One synchronous RBCD round over all agents.
+def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
+                params: AgentParams, axis_name: str | None = None) -> RBCDState:
+    """One synchronous RBCD round over the agents held by this device.
 
     Communication happens once per round: the public-pose table is built
-    from X and re-distributed to neighbor buffers (plain gathers here; an
-    all-gather collective in the sharded path).
+    from X and re-distributed to neighbor buffers.  When ``axis_name`` is
+    set, this function is the per-shard body of ``shard_map`` over a device
+    mesh (``dpgo_tpu.parallel``): the table is exchanged by ``all_gather``
+    over ICI (the analog of the reference's pose message exchange,
+    ``MultiRobotExample.cpp:186-213``) and the greedy schedule resolves its
+    argmax over gathered per-agent gradient norms.  With ``axis_name=None``
+    the same body runs single-device over all agents (plain gathers).
     """
     X = state.X
     edges = graph.edges._replace(weight=state.weights)
+    A_loc = X.shape[0]  # agents on this shard (= meta.num_robots if unsharded)
 
-    Xpub = public_table(X, graph)
+    Xpub_local = public_table(X, graph)
+    if axis_name is None:
+        Xpub = Xpub_local
+        agent_ids = jnp.arange(A_loc)
+    else:
+        Xpub = jax.lax.all_gather(Xpub_local, axis_name, axis=0, tiled=True)
+        agent_ids = jax.lax.axis_index(axis_name) * A_loc + jnp.arange(A_loc)
     Z = neighbor_buffer(Xpub, graph)
 
     X_upd, gn0 = jax.vmap(lambda x, z, e: _agent_update(x, z, e, params))(X, Z, edges)
 
     schedule = params.schedule
-    key, sub = jax.random.split(state.key)
+    split = jax.vmap(lambda k: jax.random.split(k, 2))(state.key)  # [A, 2, 2]
+    key, sub = split[:, 0], split[:, 1]
     if schedule == Schedule.JACOBI:
-        fired = jnp.ones((meta.num_robots,), bool)
+        fired = jnp.ones((A_loc,), bool)
     elif schedule == Schedule.GREEDY:
-        fired = jnp.arange(meta.num_robots) == jnp.argmax(gn0)
+        gn_all = gn0 if axis_name is None else \
+            jax.lax.all_gather(gn0, axis_name, axis=0, tiled=True)
+        fired = agent_ids == jnp.argmax(gn_all)
     elif schedule == Schedule.ASYNC:
-        fired = jax.random.bernoulli(sub, params.async_update_prob,
-                                     (meta.num_robots,))
+        fired = jax.vmap(
+            lambda k: jax.random.bernoulli(k, params.async_update_prob))(sub)
     else:
         raise ValueError(f"unknown schedule {schedule}")
     X_next = jnp.where(fired[:, None, None, None], X_upd, X)
@@ -311,6 +325,11 @@ def rbcd_step(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
                      rel_change=rel, ready=ready)
 
 
+#: Jitted RBCD round. Single-device over all agents with the default
+#: ``axis_name=None``; the sharded path re-wraps ``_rbcd_round`` in shard_map.
+rbcd_step = jax.jit(_rbcd_round, static_argnames=("meta", "params", "axis_name"))
+
+
 # ---------------------------------------------------------------------------
 # Initialization, rounding, and the high-level driver
 # ---------------------------------------------------------------------------
@@ -323,7 +342,7 @@ def init_state(graph: MultiAgentGraph, meta: GraphMeta, X0: jax.Array,
         X=X0,
         weights=graph.edges.weight,
         iteration=jnp.array(0, jnp.int32),
-        key=jax.random.PRNGKey(seed),
+        key=jax.random.split(jax.random.PRNGKey(seed), A),
         rel_change=jnp.full((A,), jnp.inf, dtype),
         ready=jnp.zeros((A,), bool),
     )
@@ -368,31 +387,25 @@ class RBCDResult:
     terminated_by: str
 
 
-def solve_rbcd(
-    meas: Measurements,
-    num_robots: int,
-    params: AgentParams | None = None,
-    max_iters: int | None = None,
+def run_rbcd(
+    state: RBCDState,
+    graph: MultiAgentGraph,
+    meta: GraphMeta,
+    step,
+    part: Partition,
+    max_iters: int,
     grad_norm_tol: float = 0.1,
     eval_every: int = 1,
     dtype=jnp.float64,
-    part: Partition | None = None,
 ) -> RBCDResult:
-    """Distributed solve with centralized monitoring — the analog of the
-    ``multi-robot-example`` driver loop (``MultiRobotExample.cpp:175-264``):
-    per round, all agents exchange public poses and update per the schedule;
-    the centralized cost/gradnorm trace gates termination at ``grad_norm_tol``
-    (0.1 in the reference driver)."""
-    params = params or AgentParams(d=meas.d, r=5, num_robots=num_robots)
-    max_iters = params.max_num_iters if max_iters is None else max_iters
-
-    part = part or partition_contiguous(meas, num_robots)
-    graph, meta = build_graph(part, params.r, dtype)
-    X0 = centralized_chordal_init(part, meta, graph, dtype)
-    state = init_state(graph, meta, X0)
-
-    # Centralized evaluation problem (the demo's oracle, used for the
-    # convergence gate and benchmark curves).
+    """The driver loop shared by the single-device and mesh-sharded solvers —
+    the analog of the ``multi-robot-example`` loop
+    (``MultiRobotExample.cpp:175-264``): per round ``step`` exchanges public
+    poses and updates agents per the schedule; the centralized cost/gradnorm
+    trace (the demo's oracle) gates termination at ``grad_norm_tol`` (0.1 in
+    the reference driver), with agent consensus (all ``ready``) as the
+    deployed alternative (``shouldTerminate``, ``PGOAgent.cpp:1007-1031``).
+    """
     n_total = part.meas_global.num_poses
     edges_g = edge_set_from_measurements(part.meas_global, dtype=dtype)
 
@@ -407,7 +420,7 @@ def solve_rbcd(
     terminated_by = "max_iters"
     it = 0
     for it in range(max_iters):
-        state = rbcd_step(state, graph, meta, params)
+        state = step(state)
         # Host syncs (metrics readback + consensus flag) only every
         # eval_every rounds so device dispatch stays ahead of the host.
         if (it + 1) % eval_every == 0:
@@ -427,3 +440,26 @@ def solve_rbcd(
     return RBCDResult(T=T, X=state.X, cost_history=cost_hist,
                       grad_norm_history=gn_hist, iterations=it + 1,
                       terminated_by=terminated_by)
+
+
+def solve_rbcd(
+    meas: Measurements,
+    num_robots: int,
+    params: AgentParams | None = None,
+    max_iters: int | None = None,
+    grad_norm_tol: float = 0.1,
+    eval_every: int = 1,
+    dtype=jnp.float64,
+    part: Partition | None = None,
+) -> RBCDResult:
+    """Distributed solve on one device with centralized monitoring."""
+    params = params or AgentParams(d=meas.d, r=5, num_robots=num_robots)
+    max_iters = params.max_num_iters if max_iters is None else max_iters
+
+    part = part or partition_contiguous(meas, num_robots)
+    graph, meta = build_graph(part, params.r, dtype)
+    X0 = centralized_chordal_init(part, meta, graph, dtype)
+    state = init_state(graph, meta, X0)
+    step = lambda s: rbcd_step(s, graph, meta, params)
+    return run_rbcd(state, graph, meta, step, part, max_iters,
+                    grad_norm_tol, eval_every, dtype)

@@ -1,0 +1,132 @@
+"""Sharded RBCD: agents distributed over a TPU device mesh.
+
+This is the framework's distributed communication backend (SURVEY.md
+section 2.4).  The reference has *no* networking code in-library — transport
+is supplied externally (in-process calls in ``examples/MultiRobotExample.cpp``,
+ROS pub/sub in ``dpgo_ros``).  Here the transport is the device mesh itself:
+
+* agents = shards of a 1-D mesh axis ``"agent"`` (several agents per device
+  when ``num_robots > mesh size``);
+* public-pose exchange (``getSharedPoseDict`` -> ``updateNeighborPoses``,
+  reference ``PGOAgent.cpp:95-105``, ``434-458``) = one ``all_gather`` of the
+  padded public-pose table over ICI (DCN across slices — same code);
+* status consensus (``PGOAgentStatus`` gossip + ``shouldTerminate``,
+  reference ``PGOAgent.cpp:1007-1031``) = the driver reducing the sharded
+  ``ready`` flags (a tiny all-reduce under jit);
+* the lifting matrix / global anchor broadcast
+  (``MultiRobotExample.cpp:139-146``, ``258-263``) = replicated arrays.
+
+The per-shard round body is ``models.rbcd._rbcd_round`` with
+``axis_name="agent"`` — identical math to the single-device path, so the
+sharded and unsharded solvers agree bitwise up to XLA reduction order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import AgentParams
+from ..types import Measurements
+from ..utils.partition import Partition, partition_contiguous
+from ..models import rbcd
+from ..models.rbcd import (GraphMeta, MultiAgentGraph, RBCDState,
+                           centralized_chordal_init, init_state)
+
+AXIS = "agent"
+
+
+def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over the ``"agent"`` axis.
+
+    On real hardware this spans the TPU slice (ICI); under
+    ``--xla_force_host_platform_device_count=N`` it spans N virtual CPU
+    devices, which is how the collective paths are tested without a TPU.
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if num_devices is not None:
+        if num_devices > devices.size:
+            raise ValueError(
+                f"requested {num_devices} devices but only {devices.size} "
+                "are available")
+        devices = devices[:num_devices]
+    return Mesh(devices, (AXIS,))
+
+
+def _specs(mesh: Mesh, tree):
+    """PartitionSpec pytree: leading axis over agents for [A, ...] arrays,
+    replicated for scalars."""
+    def spec(x):
+        return P(AXIS) if jnp.ndim(x) >= 1 else P()
+    return jax.tree.map(spec, tree)
+
+
+def shard_problem(mesh: Mesh, state: RBCDState, graph: MultiAgentGraph):
+    """Place state and graph on the mesh: agent-sharded leading axes.
+
+    ``num_robots`` must be a multiple of the mesh size (each device holds
+    the same number of agent blocks).
+    """
+    A = state.X.shape[0]
+    n_dev = mesh.devices.size
+    if A % n_dev != 0:
+        raise ValueError(
+            f"num_robots={A} must be a multiple of mesh size {n_dev}; "
+            "pick a divisible robot count or a smaller mesh")
+
+    def put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    state = jax.tree.map(put, state, _specs(mesh, state))
+    graph = jax.tree.map(put, graph, _specs(mesh, graph))
+    return state, graph
+
+
+def make_sharded_step(mesh: Mesh, meta: GraphMeta, params: AgentParams):
+    """Compile the sharded RBCD round: shard_map of the per-shard body over
+    the agent axis, jitted as one XLA program (collectives included)."""
+    body = partial(rbcd._rbcd_round, meta=meta, params=params, axis_name=AXIS)
+
+    def step(state: RBCDState, graph: MultiAgentGraph) -> RBCDState:
+        in_specs = (_specs(mesh, state), _specs(mesh, graph))
+        out_specs = _specs(mesh, state)
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)(state, graph)
+
+    return jax.jit(step)
+
+
+def solve_rbcd_sharded(
+    meas: Measurements,
+    num_robots: int,
+    mesh: Mesh | None = None,
+    params: AgentParams | None = None,
+    max_iters: int | None = None,
+    grad_norm_tol: float = 0.1,
+    eval_every: int = 1,
+    dtype=jnp.float64,
+    part: Partition | None = None,
+) -> rbcd.RBCDResult:
+    """Distributed solve over a device mesh — the deployment path of the
+    framework (``models.rbcd.solve_rbcd`` is the single-device debug path).
+    Shares the driver loop (``rbcd.run_rbcd``); only problem placement and
+    the step function differ."""
+    mesh = mesh or make_mesh()
+    params = params or AgentParams(d=meas.d, r=5, num_robots=num_robots)
+    max_iters = params.max_num_iters if max_iters is None else max_iters
+
+    part = part or partition_contiguous(meas, num_robots)
+    graph, meta = rbcd.build_graph(part, params.r, dtype)
+    X0 = centralized_chordal_init(part, meta, graph, dtype)
+    state = init_state(graph, meta, X0)
+    state, graph = shard_problem(mesh, state, graph)
+
+    sharded_step = make_sharded_step(mesh, meta, params)
+    step = lambda s: sharded_step(s, graph)
+    return rbcd.run_rbcd(state, graph, meta, step, part, max_iters,
+                         grad_norm_tol, eval_every, dtype)

@@ -1,98 +1,10 @@
-"""Synthetic pose-graph generation for tests.
+"""Test shim: synthetic pose-graph generation lives in the package now
+(``dpgo_tpu.utils.synthetic``) so drivers and benchmarks can use it too."""
 
-Plays the role of the reference's hand-coded micro-graphs
-(``tests/testLineGraph.cpp``, ``tests/testTriangleGraph.cpp``) in
-property-based form: generate a random ground-truth trajectory, emit exact
-or noise-perturbed relative measurements, and assert recovery.
-"""
-
-import numpy as np
-import jax.numpy as jnp
-
-from dpgo_tpu.types import Measurements
-from dpgo_tpu.utils import lie
-
-
-def random_rotation(rng, d=3):
-    return np.asarray(lie.project_to_rotation(jnp.asarray(rng.standard_normal((d, d)))))
-
-
-def random_trajectory(rng, n, d=3, step=1.0):
-    """Ground-truth poses: random rotations, random-walk translations."""
-    Rs = np.stack([random_rotation(rng, d) for _ in range(n)])
-    ts = np.cumsum(step * rng.standard_normal((n, d)), axis=0)
-    # Anchor pose 0 at the identity for easy gauge comparison.
-    R0inv = Rs[0].T
-    ts = (ts - ts[0]) @ R0inv.T
-    Rs = np.einsum("ab,nbc->nac", R0inv, Rs)
-    return Rs, ts
-
-
-def relative_measurement(Rs, ts, i, j, rng=None, rot_noise=0.0, trans_noise=0.0, d=3):
-    """Relative measurement i -> j: R = R_i^T R_j, t = R_i^T (t_j - t_i)."""
-    R = Rs[i].T @ Rs[j]
-    t = Rs[i].T @ (ts[j] - ts[i])
-    if rng is not None and rot_noise > 0:
-        axis = rng.standard_normal(3 if d == 3 else 1)
-        if d == 3:
-            axis /= np.linalg.norm(axis)
-            ang = rng.normal(0, rot_noise)
-            q = np.concatenate([np.sin(ang / 2) * axis, [np.cos(ang / 2)]])
-            R = lie.quat_to_rotation(q) @ R
-        else:
-            R = np.asarray(lie.rotation2d(rng.normal(0, rot_noise))) @ R
-    if rng is not None and trans_noise > 0:
-        t = t + rng.normal(0, trans_noise, d)
-    return R, t
-
-
-def make_measurements(rng, n, d=3, num_lc=5, rot_noise=0.0, trans_noise=0.0,
-                      kappa=100.0, tau=10.0, outlier_lc=0):
-    """Odometry chain + random loop closures (+ optional gross outliers)."""
-    Rs, ts = random_trajectory(rng, n, d)
-    edges = [(i, i + 1) for i in range(n - 1)]
-    while len(edges) < (n - 1) + num_lc:
-        i, j = sorted(rng.choice(n, 2, replace=False))
-        if j > i + 1 and (i, j) not in edges:
-            edges.append((int(i), int(j)))
-    Rm, tm = [], []
-    for (i, j) in edges:
-        R, t = relative_measurement(Rs, ts, i, j, rng, rot_noise, trans_noise, d)
-        Rm.append(R)
-        tm.append(t)
-    # Gross outliers: random rotation + large random translation.
-    for _ in range(outlier_lc):
-        i, j = sorted(rng.choice(n, 2, replace=False))
-        if j == i:
-            continue
-        edges.append((int(i), int(j)))
-        Rm.append(random_rotation(rng, d))
-        tm.append(5.0 * rng.standard_normal(d))
-    m = len(edges)
-    e = np.asarray(edges)
-    meas = Measurements(
-        d=d, num_poses=n,
-        r1=np.zeros(m, np.int32), p1=e[:, 0].astype(np.int64),
-        r2=np.zeros(m, np.int32), p2=e[:, 1].astype(np.int64),
-        R=np.stack(Rm), t=np.stack(tm),
-        kappa=np.full(m, kappa), tau=np.full(m, tau),
-        weight=np.ones(m), is_known_inlier=np.zeros(m, bool),
-    )
-    return meas, (Rs, ts)
-
-
-def trajectory_error(T, Rs, ts):
-    """Max pose error of T [n, d, d+1] vs ground truth, after aligning
-    pose 0 (gauge)."""
-    d = Rs.shape[-1]
-    R_est = np.asarray(T[..., :d])
-    t_est = np.asarray(T[..., d])
-    # Align: G = pose0_true * pose0_est^{-1}
-    Rg = Rs[0] @ R_est[0].T
-    tg = ts[0] - Rg @ t_est[0]
-    R_al = np.einsum("ab,nbc->nac", Rg, R_est)
-    t_al = t_est @ Rg.T + tg
-    return max(
-        float(np.abs(R_al - Rs).max()),
-        float(np.abs(t_al - ts).max()),
-    )
+from dpgo_tpu.utils.synthetic import (  # noqa: F401
+    make_measurements,
+    random_rotation,
+    random_trajectory,
+    relative_measurement,
+    trajectory_error,
+)
