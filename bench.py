@@ -98,6 +98,12 @@ def main():
     import jax.numpy as jnp
 
     if os.environ.get("BENCH_MODE") == "cpu":
+        # The env JAX_PLATFORMS=cpu alone is not enough: the image's
+        # sitecustomize re-registers the TPU tunnel and overrides
+        # jax_platforms, and a second process touching the tunnel would
+        # deadlock on the single TPU grant — pin the backend in code, as
+        # tests/conftest.py does.
+        jax.config.update("jax_platforms", "cpu")
         cpu = jax.devices("cpu")[0]
         ips = time_rounds(cpu, jnp.float64, CPU_ROUNDS)
         log(f"  cpu baseline: {ips:.2f} rounds/s (float64)")
@@ -107,6 +113,11 @@ def main():
     dev = jax.devices()[0]
     log(f"benchmark device: {dev.platform} ({dev.device_kind})")
     bench_dtype = "float32" if dev.platform != "cpu" else "float64"
+    if bench_dtype == "float64":
+        # CPU-only host: actually enable double precision (safe here — no
+        # TPU tunnel in this process; enabling x64 under the tunnel is what
+        # breaks its compiler).
+        jax.config.update("jax_enable_x64", True)
     ips = time_rounds(dev, getattr(jnp, bench_dtype), ROUNDS)
     log(f"  {ips:.2f} RBCD rounds/s ({bench_dtype})")
 

@@ -105,8 +105,13 @@ class AgentParams:
     # Robust optimization (GNC)
     robust: RobustCostParams = RobustCostParams()
     robust_init_min_inliers: int = 2
-    robust_opt_num_weight_updates: int = 10
-    robust_opt_num_resets: int = 0
+    # Beyond-reference: cap on the number of GNC weight updates (<= 0 means
+    # unlimited, the reference behavior; mu annealing is separately capped at
+    # robust.gnc_max_iters steps as in the reference).  Converged weights
+    # make further updates no-ops, but with warm start disabled each update
+    # also resets the iterate, so an uncapped schedule never settles — set a
+    # finite cap for that configuration.
+    robust_opt_num_weight_updates: int = 0
     robust_opt_inner_iters: int = 30
     robust_opt_warm_start: bool = True
     robust_opt_min_convergence_ratio: float = 0.8

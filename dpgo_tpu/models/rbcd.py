@@ -43,7 +43,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import AgentParams, Schedule
+from ..config import AgentParams, RobustCostType, Schedule
+from .. import robust
 from ..types import EdgeSet, Measurements, edge_set_from_measurements
 from ..utils.lie import lifting_matrix as _lifting_matrix
 from ..utils.partition import Partition, partition_contiguous
@@ -86,6 +87,19 @@ class RBCDState(NamedTuple):
     key: jax.Array  # [A, 2] per-agent PRNG keys (async schedule)
     rel_change: jax.Array  # [A]
     ready: jax.Array  # [A] bool
+    # Nesterov acceleration (RA-L 2020; reference PGOAgent.cpp:1054-1091).
+    # V is the auxiliary sequence (None when acceleration is off); gamma and
+    # alpha are the per-agent momentum scalars.  Y is recomputed every round
+    # from (X, V, alpha) and never carried across rounds (the reference's
+    # stored Y is always refreshed by updateY before any use).
+    V: jax.Array | None  # [A, n_max, r, d+1] or None
+    gamma: jax.Array  # [A]
+    alpha: jax.Array  # [A]
+    # GNC control parameter (reference RobustCost::mu, DPGO_robust.cpp:85-103).
+    mu: jax.Array  # scalar
+    # Initial guess, kept only when the robust warm start is disabled: the
+    # iterate resets to it on every weight update (PGOAgent.cpp:657-662).
+    X_init: jax.Array | None  # [A, n_max, r, d+1] or None
 
 
 def build_graph(part: Partition, rank: int, dtype=jnp.float32):
@@ -267,33 +281,136 @@ def _agent_update(X_local, z, edges, params: AgentParams):
     return out.X, out.grad_norm_init
 
 
+def _edge_residuals(X_local, z, edges):
+    """Unweighted per-edge residual norms sqrt(kappa ||rR||^2 + tau ||rt||^2)
+    for one agent — ``computeMeasurementError`` (reference
+    ``DPGO_utils.cpp:509-515``) evaluated in the lifted space, as
+    ``updateLoopClosuresWeights`` does (``PGOAgent.cpp:1181-1245``)."""
+    buf = jnp.concatenate([X_local, z], axis=0)
+    rR, rt = quadratic._edge_terms(buf, edges)
+    sq = edges.kappa * jnp.sum(rR * rR, axis=(-2, -1)) + \
+        edges.tau * jnp.sum(rt * rt, axis=-1)
+    return jnp.sqrt(jnp.maximum(sq, 0.0))
+
+
+def _gnc_update_weights(X, Z, edges, mu, params: AgentParams):
+    """Recompute robust weights for every loop-closure edge (all agents).
+
+    Reference semantics (``PGOAgent::updateLoopClosuresWeights``,
+    ``PGOAgent.cpp:1181-1245``): residual from the current iterate X and the
+    cached neighbor pose; weight from the robust cost at the current mu;
+    odometry and known-inlier edges keep weight 1.  The reference's ownership
+    rule (agent i updates shared edges only toward j > i, the other endpoint
+    receives the published weight) exists because cached poses may be stale
+    across robots; here both endpoint agents evaluate the *same* gathered
+    public poses in the same round, so independent recomputation yields
+    bitwise-identical weights on both copies and no ownership/publish
+    machinery is needed.
+    """
+    res = jax.vmap(lambda x, z, e: _edge_residuals(x, z, e))(X, Z, edges)
+    w_new = robust.weight(res, params.robust, mu)
+    update = edges.mask * edges.is_lc * (1.0 - edges.fixed_weight)
+    return jnp.where(update > 0, w_new, edges.weight)
+
+
+def _converged_weight_ratio(edges, params: AgentParams):
+    """Per-agent fraction of non-known-inlier LC edges with weight in {0,1}
+    (reference ``computeConvergedLoopClosureRatio``, ``PGOAgent.cpp:1247-1289``;
+    meaningful for GNC_TLS only, 1.0 otherwise)."""
+    if params.robust.cost_type != RobustCostType.GNC_TLS:
+        return None
+    lc = edges.mask * edges.is_lc * (1.0 - edges.fixed_weight)
+    conv = robust.is_weight_converged(edges.weight).astype(lc.dtype)
+    tot = jnp.sum(lc, axis=-1)
+    return jnp.where(tot > 0, jnp.sum(lc * conv, axis=-1) / jnp.maximum(tot, 1.0),
+                     jnp.ones_like(tot))
+
+
 def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
-                params: AgentParams, axis_name: str | None = None) -> RBCDState:
+                params: AgentParams, axis_name: str | None = None,
+                update_weights: bool = False, restart: bool = False) -> RBCDState:
     """One synchronous RBCD round over the agents held by this device.
 
     Communication happens once per round: the public-pose table is built
-    from X and re-distributed to neighbor buffers.  When ``axis_name`` is
-    set, this function is the per-shard body of ``shard_map`` over a device
-    mesh (``dpgo_tpu.parallel``): the table is exchanged by ``all_gather``
-    over ICI (the analog of the reference's pose message exchange,
-    ``MultiRobotExample.cpp:186-213``) and the greedy schedule resolves its
-    argmax over gathered per-agent gradient norms.  With ``axis_name=None``
-    the same body runs single-device over all agents (plain gathers).
+    from X (and from the Nesterov sequence Y when accelerated — the aux-pose
+    exchange of ``getAuxSharedPoseDict``/``updateAuxNeighborPoses``,
+    reference ``PGOAgent.cpp:107-118``, ``460-479``) and re-distributed to
+    neighbor buffers.  When ``axis_name`` is set, this function is the
+    per-shard body of ``shard_map`` over a device mesh (``dpgo_tpu.parallel``):
+    the table is exchanged by ``all_gather`` over ICI (the analog of the
+    reference's pose message exchange, ``MultiRobotExample.cpp:186-213``) and
+    the greedy schedule resolves its argmax over gathered per-agent gradient
+    norms.  With ``axis_name=None`` the same body runs single-device over all
+    agents (plain gathers).
+
+    ``update_weights`` and ``restart`` are static flags the driver raises on
+    the rounds where the reference's modular counters fire
+    (``shouldUpdateLoopClosureWeights``: every ``robust_opt_inner_iters``;
+    ``shouldRestart``: every ``restart_interval`` when accelerated) — keeping
+    the schedule on the host compiles each round variant branch-free.
+
+    A restart round reproduces ``restartNesterovAcceleration`` (reference
+    ``PGOAgent.cpp:1040-1052``): the accelerated step is discarded (X reset
+    to the pre-round value), a plain un-accelerated step is taken instead,
+    and the aux state collapses (V = Y = X, gamma = alpha = 0) — so it
+    compiles as a plain round plus aux reset, with no wasted solve.
     """
+    accel = params.acceleration and state.V is not None
+    if accel and params.schedule == Schedule.ASYNC:
+        # The reference forbids this combination (assert at PGOAgent.cpp:863):
+        # Nesterov momentum assumes lockstep gamma sequences.
+        raise ValueError("acceleration is not supported with the ASYNC schedule")
     X = state.X
-    edges = graph.edges._replace(weight=state.weights)
+    weights = state.weights
+    mu = state.mu
+    V, gamma, alpha = state.V, state.gamma, state.alpha
     A_loc = X.shape[0]  # agents on this shard (= meta.num_robots if unsharded)
+    A_tot = meta.num_robots
 
-    Xpub_local = public_table(X, graph)
     if axis_name is None:
-        Xpub = Xpub_local
         agent_ids = jnp.arange(A_loc)
+        gather = lambda t: t
     else:
-        Xpub = jax.lax.all_gather(Xpub_local, axis_name, axis=0, tiled=True)
         agent_ids = jax.lax.axis_index(axis_name) * A_loc + jnp.arange(A_loc)
-    Z = neighbor_buffer(Xpub, graph)
+        gather = lambda t: jax.lax.all_gather(t, axis_name, axis=0, tiled=True)
 
-    X_upd, gn0 = jax.vmap(lambda x, z, e: _agent_update(x, z, e, params))(X, Z, edges)
+    # Regular neighbor buffer (from X) — needed always when un-accelerated,
+    # and on weight-update / restart rounds when accelerated.
+    need_regular = (not accel) or restart or update_weights
+    Z = neighbor_buffer(gather(public_table(X, graph)), graph) if need_regular \
+        else None
+
+    # --- GNC weight update (before the pose update, reference iterate()
+    # PGOAgent.cpp:654-668) ---
+    if update_weights:
+        edges_r = graph.edges._replace(weight=weights)
+        weights = _gnc_update_weights(X, Z, edges_r, mu, params)
+        mu = robust.gnc_update_mu(mu, params.robust)
+        if state.X_init is not None:
+            # Warm start disabled: reset the iterate to the initial guess
+            # BEFORE this round's optimization (PGOAgent.cpp:657-662); the
+            # reset X also refreshes the regular neighbor buffer.
+            X = state.X_init
+            Z = neighbor_buffer(gather(public_table(X, graph)), graph)
+        if accel:  # initializeAcceleration (PGOAgent.cpp:1054-1063)
+            V = X
+            gamma = jnp.zeros_like(gamma)
+            alpha = jnp.zeros_like(alpha)
+    edges = graph.edges._replace(weight=weights)
+
+    # --- Acceleration bookkeeping (PGOAgent.cpp:1065-1091) ---
+    if accel and not restart:
+        gamma = (1.0 + jnp.sqrt(1.0 + 4.0 * (A_tot * gamma) ** 2)) / (2.0 * A_tot)
+        alpha = 1.0 / (gamma * A_tot)
+        a = alpha[:, None, None, None]
+        Ynes = manifold.project((1.0 - a) * X + a * V)
+        Zaux = neighbor_buffer(gather(public_table(Ynes, graph)), graph)
+        start, Zuse = Ynes, Zaux
+    else:
+        start, Zuse = X, Z
+
+    X_upd, gn0 = jax.vmap(lambda x, z, e: _agent_update(x, z, e, params))(
+        start, Zuse, edges)
 
     schedule = params.schedule
     split = jax.vmap(lambda k: jax.random.split(k, 2))(state.key)  # [A, 2, 2]
@@ -301,33 +418,54 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
     if schedule == Schedule.JACOBI:
         fired = jnp.ones((A_loc,), bool)
     elif schedule == Schedule.GREEDY:
-        gn_all = gn0 if axis_name is None else \
-            jax.lax.all_gather(gn0, axis_name, axis=0, tiled=True)
+        gn_all = gather(gn0)
         fired = agent_ids == jnp.argmax(gn_all)
     elif schedule == Schedule.ASYNC:
         fired = jax.vmap(
             lambda k: jax.random.bernoulli(k, params.async_update_prob))(sub)
     else:
         raise ValueError(f"unknown schedule {schedule}")
-    X_next = jnp.where(fired[:, None, None, None], X_upd, X)
+    fired_b = fired[:, None, None, None]
+
+    if accel and not restart:
+        # Non-fired agents take the momentum point (updateX(false, true):
+        # X = Y, PGOAgent.cpp:1094-1098); V advances for everyone.
+        X_next = jnp.where(fired_b, X_upd, Ynes)
+        g = gamma[:, None, None, None]
+        V = manifold.project(V + g * (X_next - Ynes))
+    else:
+        X_next = jnp.where(fired_b, X_upd, X)
+        if accel:  # restart round: collapse the aux sequences
+            V = X_next
+            gamma = jnp.zeros_like(gamma)
+            alpha = jnp.zeros_like(alpha)
 
     # Status update (reference PGOAgent.cpp:703-716): masked relative change.
     # Only fired agents refresh their status — non-selected agents keep their
-    # previous readiness, as iterate(false) does in the reference.
+    # previous readiness, as iterate(false) does in the reference.  In robust
+    # mode readiness additionally requires the converged-weight ratio gate
+    # (PGOAgent.cpp:713-714).
     diff = (X_next - X) * graph.pose_mask[:, :, None, None]
     rel_new = jnp.sqrt(jnp.sum(diff * diff, axis=(1, 2, 3)) /
                        jnp.maximum(graph.n.astype(X.dtype), 1.0))
+    ready_new = rel_new <= params.rel_change_tol
+    ratio = _converged_weight_ratio(edges, params)
+    if ratio is not None:
+        ready_new &= ratio >= params.robust_opt_min_convergence_ratio
     rel = jnp.where(fired, rel_new, state.rel_change)
-    ready = jnp.where(fired, rel_new <= params.rel_change_tol, state.ready)
+    ready = jnp.where(fired, ready_new, state.ready)
 
-    return RBCDState(X=X_next, weights=state.weights,
+    return RBCDState(X=X_next, weights=weights,
                      iteration=state.iteration + 1, key=key,
-                     rel_change=rel, ready=ready)
+                     rel_change=rel, ready=ready,
+                     V=V, gamma=gamma, alpha=alpha, mu=mu,
+                     X_init=state.X_init)
 
 
 #: Jitted RBCD round. Single-device over all agents with the default
 #: ``axis_name=None``; the sharded path re-wraps ``_rbcd_round`` in shard_map.
-rbcd_step = jax.jit(_rbcd_round, static_argnames=("meta", "params", "axis_name"))
+rbcd_step = jax.jit(_rbcd_round, static_argnames=(
+    "meta", "params", "axis_name", "update_weights", "restart"))
 
 
 # ---------------------------------------------------------------------------
@@ -335,9 +473,11 @@ rbcd_step = jax.jit(_rbcd_round, static_argnames=("meta", "params", "axis_name")
 # ---------------------------------------------------------------------------
 
 def init_state(graph: MultiAgentGraph, meta: GraphMeta, X0: jax.Array,
-               seed: int = 0) -> RBCDState:
+               seed: int = 0, params: AgentParams | None = None) -> RBCDState:
     A = meta.num_robots
     dtype = X0.dtype
+    accel = params is not None and params.acceleration
+    mu0 = params.robust.gnc_init_mu if params is not None else 1e-4
     return RBCDState(
         X=X0,
         weights=graph.edges.weight,
@@ -345,6 +485,13 @@ def init_state(graph: MultiAgentGraph, meta: GraphMeta, X0: jax.Array,
         key=jax.random.split(jax.random.PRNGKey(seed), A),
         rel_change=jnp.full((A,), jnp.inf, dtype),
         ready=jnp.zeros((A,), bool),
+        V=X0 if accel else None,  # initializeAcceleration: V = X
+        gamma=jnp.zeros((A,), dtype),
+        alpha=jnp.zeros((A,), dtype),
+        mu=jnp.asarray(mu0, dtype),
+        X_init=X0 if (params is not None
+                      and params.robust.cost_type != RobustCostType.L2
+                      and not params.robust_opt_warm_start) else None,
     )
 
 
@@ -385,6 +532,22 @@ class RBCDResult:
     grad_norm_history: list
     iterations: int
     terminated_by: str
+    weights: jax.Array | None = None  # [M] final per-measurement GNC weights
+
+
+def global_weights(weights: jax.Array, graph: MultiAgentGraph,
+                   num_meas: int) -> jax.Array:
+    """Collapse per-agent edge weights [A, E_max] to per-measurement [M].
+
+    Shared measurements appear in both endpoint agents' edge lists with
+    identical weights (see ``_gnc_update_weights``), so the masked mean over
+    copies is exact; measurements nobody holds (none in practice) default
+    to 1."""
+    ids = graph.meas_id.reshape(-1)
+    m = graph.edges.mask.reshape(-1)
+    num = jnp.zeros((num_meas,), weights.dtype).at[ids].add(weights.reshape(-1) * m)
+    den = jnp.zeros((num_meas,), weights.dtype).at[ids].add(m)
+    return jnp.where(den > 0, num / jnp.maximum(den, 1.0), 1.0)
 
 
 def run_rbcd(
@@ -397,6 +560,7 @@ def run_rbcd(
     grad_norm_tol: float = 0.1,
     eval_every: int = 1,
     dtype=jnp.float64,
+    params: AgentParams | None = None,
 ) -> RBCDResult:
     """The driver loop shared by the single-device and mesh-sharded solvers —
     the analog of the ``multi-robot-example`` loop
@@ -405,26 +569,52 @@ def run_rbcd(
     trace (the demo's oracle) gates termination at ``grad_norm_tol`` (0.1 in
     the reference driver), with agent consensus (all ``ready``) as the
     deployed alternative (``shouldTerminate``, ``PGOAgent.cpp:1007-1031``).
+
+    ``step(state, update_weights, restart)`` receives the two host-side
+    static schedule flags each round.  ``params`` drives the GNC /
+    acceleration schedules (omit for plain L2 RBCD).
     """
     n_total = part.meas_global.num_poses
+    num_meas = len(part.meas_global)
     edges_g = edge_set_from_measurements(part.meas_global, dtype=dtype)
 
     @jax.jit
-    def central_metrics(Xa):
+    def central_metrics(Xa, weights):
         Xg = gather_to_global(Xa, graph, n_total)
-        f = quadratic.cost(Xg, edges_g)
-        g = manifold.rgrad(Xg, quadratic.egrad(Xg, edges_g))
+        eg = edges_g._replace(weight=global_weights(weights, graph, num_meas))
+        f = quadratic.cost(Xg, eg)
+        g = manifold.rgrad(Xg, quadratic.egrad(Xg, eg))
         return f, manifold.norm(g)
+
+    robust_on = params is not None and \
+        params.robust.cost_type != RobustCostType.L2
+    accel_on = params is not None and params.acceleration
 
     cost_hist, gn_hist = [], []
     terminated_by = "max_iters"
     it = 0
+    num_weight_updates = 0
     for it in range(max_iters):
-        state = step(state)
+        # The modular counters of the reference (shouldUpdateLoopClosure-
+        # Weights / shouldRestart, PGOAgent.cpp:1174-1179, 1033-1038) live on
+        # the host: round variants compile branch-free.  Beyond-reference:
+        # weight updates stop after robust_opt_num_weight_updates (<=0 means
+        # unlimited, the reference behavior) — once GNC weights have
+        # converged to {0,1} further updates are no-ops on the weights but,
+        # with warm start disabled, would keep resetting the iterate and
+        # prevent the solve from ever settling; the cap also bounds the
+        # mu <- 1.4 mu growth.
+        update_w = robust_on and \
+            (it + 1) % params.robust_opt_inner_iters == 0 and \
+            (params.robust_opt_num_weight_updates <= 0 or
+             num_weight_updates < params.robust_opt_num_weight_updates)
+        num_weight_updates += int(update_w)
+        restart = accel_on and (it + 1) % params.restart_interval == 0
+        state = step(state, update_w, restart)
         # Host syncs (metrics readback + consensus flag) only every
         # eval_every rounds so device dispatch stays ahead of the host.
         if (it + 1) % eval_every == 0:
-            f, gn = central_metrics(state.X)
+            f, gn = central_metrics(state.X, state.weights)
             cost_hist.append(float(f))
             gn_hist.append(float(gn))
             if float(gn) < grad_norm_tol:
@@ -439,7 +629,8 @@ def run_rbcd(
     T = round_global(Xg, ylift)
     return RBCDResult(T=T, X=state.X, cost_history=cost_hist,
                       grad_norm_history=gn_hist, iterations=it + 1,
-                      terminated_by=terminated_by)
+                      terminated_by=terminated_by,
+                      weights=global_weights(state.weights, graph, num_meas))
 
 
 def solve_rbcd(
@@ -459,7 +650,8 @@ def solve_rbcd(
     part = part or partition_contiguous(meas, num_robots)
     graph, meta = build_graph(part, params.r, dtype)
     X0 = centralized_chordal_init(part, meta, graph, dtype)
-    state = init_state(graph, meta, X0)
-    step = lambda s: rbcd_step(s, graph, meta, params)
+    state = init_state(graph, meta, X0, params=params)
+    step = lambda s, uw, rs: rbcd_step(s, graph, meta, params,
+                                       update_weights=uw, restart=rs)
     return run_rbcd(state, graph, meta, step, part, max_iters,
-                    grad_norm_tol, eval_every, dtype)
+                    grad_norm_tol, eval_every, dtype, params=params)

@@ -89,16 +89,24 @@ def shard_problem(mesh: Mesh, state: RBCDState, graph: MultiAgentGraph):
 
 def make_sharded_step(mesh: Mesh, meta: GraphMeta, params: AgentParams):
     """Compile the sharded RBCD round: shard_map of the per-shard body over
-    the agent axis, jitted as one XLA program (collectives included)."""
-    body = partial(rbcd._rbcd_round, meta=meta, params=params, axis_name=AXIS)
+    the agent axis, jitted as one XLA program (collectives included).
 
-    def step(state: RBCDState, graph: MultiAgentGraph) -> RBCDState:
+    The returned callable takes the driver's two static schedule flags
+    (``update_weights``, ``restart``); each (True/False) combination compiles
+    once."""
+
+    @partial(jax.jit, static_argnames=("update_weights", "restart"))
+    def step(state: RBCDState, graph: MultiAgentGraph,
+             update_weights: bool = False, restart: bool = False) -> RBCDState:
+        body = partial(rbcd._rbcd_round, meta=meta, params=params,
+                       axis_name=AXIS, update_weights=update_weights,
+                       restart=restart)
         in_specs = (_specs(mesh, state), _specs(mesh, graph))
         out_specs = _specs(mesh, state)
         return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=False)(state, graph)
 
-    return jax.jit(step)
+    return step
 
 
 def solve_rbcd_sharded(
@@ -123,10 +131,10 @@ def solve_rbcd_sharded(
     part = part or partition_contiguous(meas, num_robots)
     graph, meta = rbcd.build_graph(part, params.r, dtype)
     X0 = centralized_chordal_init(part, meta, graph, dtype)
-    state = init_state(graph, meta, X0)
+    state = init_state(graph, meta, X0, params=params)
     state, graph = shard_problem(mesh, state, graph)
 
     sharded_step = make_sharded_step(mesh, meta, params)
-    step = lambda s: sharded_step(s, graph)
+    step = lambda s, uw, rs: sharded_step(s, graph, update_weights=uw, restart=rs)
     return rbcd.run_rbcd(state, graph, meta, step, part, max_iters,
-                         grad_norm_tol, eval_every, dtype)
+                         grad_norm_tol, eval_every, dtype, params=params)

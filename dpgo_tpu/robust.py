@@ -64,8 +64,12 @@ def gnc_tls_weight(r: jax.Array, mu: jax.Array | float, barc: float) -> jax.Arra
 
 
 def gnc_update_mu(mu: jax.Array, params: RobustCostParams) -> jax.Array:
-    """One GNC annealing step: mu <- mu_step * mu (reference ``DPGO_robust.cpp:85-103``)."""
-    return mu * params.gnc_mu_step
+    """One GNC annealing step: mu <- mu_step * mu, capped after
+    ``gnc_max_iters`` steps (reference ``RobustCost::update``,
+    ``DPGO_robust.cpp:85-103``, stops annealing after ``GNCMaxNumIters`` —
+    weight recomputation continues at the frozen mu)."""
+    mu_max = params.gnc_init_mu * params.gnc_mu_step ** params.gnc_max_iters
+    return jnp.minimum(mu * params.gnc_mu_step, mu_max)
 
 
 def gnc_init_mu(params: RobustCostParams) -> float:

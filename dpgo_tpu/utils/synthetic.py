@@ -51,10 +51,12 @@ def make_measurements(rng, n, d=3, num_lc=5, rot_noise=0.0, trans_noise=0.0,
     """Odometry chain + random loop closures (+ optional gross outliers)."""
     Rs, ts = random_trajectory(rng, n, d)
     edges = [(i, i + 1) for i in range(n - 1)]
+    seen = set(edges)
     while len(edges) < (n - 1) + num_lc:
         i, j = sorted(rng.choice(n, 2, replace=False))
-        if j > i + 1 and (i, j) not in edges:
+        if j > i + 1 and (i, j) not in seen:
             edges.append((int(i), int(j)))
+            seen.add((int(i), int(j)))
     Rm, tm = [], []
     for (i, j) in edges:
         R, t = relative_measurement(Rs, ts, i, j, rng, rot_noise, trans_noise, d)

@@ -1,0 +1,184 @@
+"""M4 tests: Nesterov-accelerated RBCD and the GNC robust outer loop.
+
+Mirrors what the reference exercises through ``examples/MultiRobotExample.cpp``
+(acceleration flag) and the robust defaults of ``PGOAgentParameters``
+(GNC_TLS, weight updates every ``robustOptInnerIters``), plus the outlier
+recovery property tests of ``tests/testUtils.cpp:72-180`` lifted to the full
+distributed solve.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpgo_tpu.config import (AgentParams, RobustCostParams, RobustCostType,
+                             Schedule, SolverParams)
+from dpgo_tpu.models import rbcd
+from dpgo_tpu.utils.partition import partition_contiguous
+from synthetic import make_measurements, trajectory_error
+
+
+def robust_params(num_robots, d=3, r=5, inner_iters=10, **kw):
+    return AgentParams(
+        d=d, r=r, num_robots=num_robots, schedule=Schedule.JACOBI,
+        robust=RobustCostParams(cost_type=RobustCostType.GNC_TLS,
+                                gnc_barc=0.5),
+        robust_opt_inner_iters=inner_iters,
+        rel_change_tol=1e-8,
+        solver=SolverParams(grad_norm_tol=1e-6),
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Acceleration
+# ---------------------------------------------------------------------------
+
+def test_accelerated_rbcd_converges(rng):
+    meas, (Rs, ts) = make_measurements(rng, n=20, d=3, num_lc=10)
+    params = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.JACOBI,
+                         acceleration=True, restart_interval=30)
+    res = rbcd.solve_rbcd(meas, 4, params, max_iters=200, grad_norm_tol=1e-6)
+    assert res.grad_norm_history[-1] < 1e-6
+    assert trajectory_error(res.T, Rs, ts) < 1e-4
+
+
+def test_accelerated_restart_rounds_run(rng):
+    # A tiny restart interval forces several restart-variant rounds.
+    meas, _ = make_measurements(rng, n=16, d=3, num_lc=6,
+                                rot_noise=0.03, trans_noise=0.03)
+    params = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.JACOBI,
+                         acceleration=True, restart_interval=5)
+    res = rbcd.solve_rbcd(meas, 4, params, max_iters=40, grad_norm_tol=1e-5)
+    assert res.cost_history[-1] <= res.cost_history[0]
+
+
+def test_accelerated_not_slower_than_plain(rng):
+    # On a noisy graph, acceleration should reach the reference driver's
+    # gradnorm gate (0.1, MultiRobotExample.cpp:238 — tightened to 0.05
+    # here) in no more rounds than the plain schedule, modulo small-problem
+    # noise.  Note the per-step solver floor of 1e-2 (the reference's forced
+    # trust-region tolerance, PGOAgent.cpp:1134) makes gates far below that
+    # floor unreachable with momentum on: once an agent's local gradient is
+    # under the floor the solver early-exits and X tracks the momentum point
+    # Y, so the iterate dithers at the floor level by design (same behavior
+    # as the reference; its demo only ever gates at 0.1).
+    meas, _ = make_measurements(rng, n=40, d=3, num_lc=20,
+                                rot_noise=0.05, trans_noise=0.05)
+    base = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.JACOBI,
+                       rel_change_tol=1e-10)
+    accel = dataclasses.replace(base, acceleration=True, restart_interval=30)
+    r_base = rbcd.solve_rbcd(meas, 4, base, max_iters=150, grad_norm_tol=0.05)
+    r_accel = rbcd.solve_rbcd(meas, 4, accel, max_iters=150, grad_norm_tol=0.05)
+    assert r_accel.grad_norm_history[-1] < 0.05
+    assert r_accel.iterations <= r_base.iterations + 5
+
+
+def test_accelerated_greedy_schedule(rng):
+    meas, _ = make_measurements(rng, n=16, d=3, num_lc=6)
+    params = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.GREEDY,
+                         acceleration=True, restart_interval=30)
+    res = rbcd.solve_rbcd(meas, 4, params, max_iters=150, grad_norm_tol=1e-4)
+    assert res.grad_norm_history[-1] < 1e-4
+
+
+def test_async_with_acceleration_rejected(rng):
+    meas, _ = make_measurements(rng, n=12, d=3, num_lc=4)
+    params = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.ASYNC,
+                         acceleration=True)
+    with pytest.raises(ValueError, match="acceleration"):
+        rbcd.solve_rbcd(meas, 4, params, max_iters=5)
+
+
+# ---------------------------------------------------------------------------
+# GNC robust outer loop
+# ---------------------------------------------------------------------------
+
+def test_gnc_rejects_outliers_and_recovers(rng):
+    meas, (Rs, ts) = make_measurements(rng, n=24, d=3, num_lc=10,
+                                       outlier_lc=6)
+    m_in = len(meas) - 6  # outliers appended last by make_measurements
+    params = robust_params(4)
+    res = rbcd.solve_rbcd(meas, 4, params, max_iters=120, grad_norm_tol=1e-6)
+    w = np.asarray(res.weights)
+    assert np.all(w[m_in:] < 0.01), f"outlier weights not rejected: {w[m_in:]}"
+    assert np.all(w[:m_in] > 0.99), "inlier weights decayed"
+    assert trajectory_error(res.T, Rs, ts) < 1e-3
+
+
+def test_gnc_weights_consistent_between_shared_copies(rng):
+    # Shared-edge weights must be identical in both endpoint agents' edge
+    # lists (replaces the reference's ownership/publish rule,
+    # PGOAgent.cpp:1201-1221).
+    meas, _ = make_measurements(rng, n=24, d=3, num_lc=10, outlier_lc=4)
+    params = robust_params(4, inner_iters=5)
+    part = partition_contiguous(meas, 4)
+    graph, meta = rbcd.build_graph(part, params.r, jnp.float64)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float64)
+    state = rbcd.init_state(graph, meta, X0, params=params)
+    for it in range(12):
+        state = rbcd.rbcd_step(state, graph, meta, params,
+                               update_weights=(it + 1) % 5 == 0)
+    ids = np.asarray(graph.meas_id).reshape(-1)
+    msk = np.asarray(graph.edges.mask).reshape(-1) > 0
+    w = np.asarray(state.weights).reshape(-1)
+    for k in np.unique(ids[msk]):
+        copies = w[msk & (ids == k)]
+        assert np.allclose(copies, copies[0], atol=1e-12), f"meas {k}"
+
+
+def test_gnc_known_inliers_pinned(rng):
+    meas, _ = make_measurements(rng, n=20, d=3, num_lc=8, outlier_lc=4)
+    # Pin all true inlier LCs as known: their weights must stay 1 even
+    # under GNC (reference RelativeSEMeasurement.h:47, PGOAgent.cpp:1186).
+    known = np.zeros(len(meas), bool)
+    known[: len(meas) - 4] = True
+    meas = dataclasses.replace(meas, is_known_inlier=known)
+    params = robust_params(4)
+    res = rbcd.solve_rbcd(meas, 4, params, max_iters=60, grad_norm_tol=1e-6)
+    w = np.asarray(res.weights)
+    assert np.all(w[: len(known) - 4] == 1.0)
+
+
+def test_gnc_convergence_ratio_gates_consensus(rng):
+    # With undecided weights the agents must not report ready; after enough
+    # GNC annealing rounds, all weights converge to {0,1} and the gate opens.
+    meas, _ = make_measurements(rng, n=20, d=3, num_lc=8, outlier_lc=4)
+    params = robust_params(4, inner_iters=5)
+    res = rbcd.solve_rbcd(meas, 4, params, max_iters=150, grad_norm_tol=0.0)
+    w = np.asarray(res.weights)
+    lc = np.arange(len(meas)) >= (20 - 1)  # loop closures follow odometry
+    assert np.all((w[lc] < 1e-4) | (w[lc] > 1 - 1e-4))
+
+
+def test_gnc_warm_start_disabled_resets(rng):
+    # Warm start off: X resets to the initial guess after every weight
+    # update (reference PGOAgent.cpp:657-662), so each GNC cycle re-solves
+    # from scratch — use the reference's 30-round inner budget
+    # (robustOptInnerIters default, PGOAgent.h:123).
+    # Each weight update resets the iterate to the initial guess, so the
+    # budget must leave recovery rounds after the LAST update — the finite
+    # robust_opt_num_weight_updates cap passed here (the default is 0 =
+    # unlimited; beyond-reference, see config.py) is what makes full
+    # convergence reachable on this path.
+    meas, (Rs, ts) = make_measurements(rng, n=20, d=3, num_lc=8, outlier_lc=4)
+    params = robust_params(4, inner_iters=30, robust_opt_warm_start=False,
+                           robust_opt_num_weight_updates=10)
+    res = rbcd.solve_rbcd(meas, 4, params, max_iters=600, grad_norm_tol=1e-6)
+    w = np.asarray(res.weights)
+    assert np.all(w[-4:] < 0.01)
+    assert trajectory_error(res.T, Rs, ts) < 1e-3
+
+
+def test_gnc_accelerated(rng):
+    # Acceleration resets on every weight update (initializeAcceleration,
+    # PGOAgent.cpp:1054-1063); the combined path must still converge.
+    meas, (Rs, ts) = make_measurements(rng, n=24, d=3, num_lc=10, outlier_lc=4)
+    params = dataclasses.replace(robust_params(4), acceleration=True,
+                                 restart_interval=30)
+    res = rbcd.solve_rbcd(meas, 4, params, max_iters=120, grad_norm_tol=1e-6)
+    w = np.asarray(res.weights)
+    assert np.all(w[-4:] < 0.01)
+    assert trajectory_error(res.T, Rs, ts) < 1e-3

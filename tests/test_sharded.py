@@ -21,7 +21,7 @@ def _setup(meas, num_robots, params, dtype=jnp.float64):
     part = partition_contiguous(meas, num_robots)
     graph, meta = rbcd.build_graph(part, params.r, dtype)
     X0 = rbcd.centralized_chordal_init(part, meta, graph, dtype)
-    state = rbcd.init_state(graph, meta, X0)
+    state = rbcd.init_state(graph, meta, X0, params=params)
     return part, graph, meta, state
 
 
@@ -67,6 +67,60 @@ def test_sharded_solve_smallgrid(data_dir):
     costs = np.asarray(res.cost_history)
     assert np.all(np.diff(costs) <= 1e-9)
     assert res.T.shape == (meas.num_poses, 3, 4)
+
+
+def test_sharded_matches_single_device_accel_robust(rng):
+    """M4 paths (Nesterov aux exchange + GNC weight rounds + restart rounds)
+    must also agree between the sharded and single-device round bodies."""
+    from dpgo_tpu.config import RobustCostParams, RobustCostType
+
+    meas, _ = make_measurements(rng, n=48, d=3, num_lc=14, rot_noise=0.01,
+                                trans_noise=0.01, outlier_lc=4)
+    params = AgentParams(
+        d=3, r=5, num_robots=8, schedule=Schedule.JACOBI,
+        acceleration=True, restart_interval=4,
+        robust=RobustCostParams(cost_type=RobustCostType.GNC_TLS,
+                                gnc_barc=0.5),
+        robust_opt_inner_iters=3)
+    _, graph, meta, state = _setup(meas, 8, params)
+
+    mesh = make_mesh(8)
+    sh_state, sh_graph = shard_problem(mesh, state, graph)
+    step = make_sharded_step(mesh, meta, params)
+
+    for it in range(8):
+        uw = (it + 1) % 3 == 0
+        rs = (it + 1) % 4 == 0
+        state = rbcd.rbcd_step(state, graph, meta, params,
+                               update_weights=uw, restart=rs)
+        sh_state = step(sh_state, sh_graph, update_weights=uw, restart=rs)
+
+    np.testing.assert_allclose(np.asarray(sh_state.X), np.asarray(state.X),
+                               atol=1e-9)
+    np.testing.assert_allclose(np.asarray(sh_state.weights),
+                               np.asarray(state.weights), atol=1e-9)
+    np.testing.assert_allclose(np.asarray(sh_state.V), np.asarray(state.V),
+                               atol=1e-9)
+    assert np.isclose(float(sh_state.mu), float(state.mu))
+
+
+def test_sharded_solve_robust_accel(rng):
+    """End-to-end sharded robust+accelerated solve rejects outliers."""
+    from dpgo_tpu.config import RobustCostParams, RobustCostType, SolverParams
+
+    meas, _ = make_measurements(rng, n=32, d=3, num_lc=10, outlier_lc=4)
+    params = AgentParams(
+        d=3, r=5, num_robots=8, schedule=Schedule.JACOBI,
+        acceleration=True, restart_interval=30,
+        robust=RobustCostParams(cost_type=RobustCostType.GNC_TLS,
+                                gnc_barc=0.5),
+        robust_opt_inner_iters=10, rel_change_tol=1e-8,
+        solver=SolverParams(grad_norm_tol=1e-6))
+    res = solve_rbcd_sharded(meas, num_robots=8, mesh=make_mesh(8),
+                             params=params, max_iters=300, grad_norm_tol=1e-5)
+    w = np.asarray(res.weights)
+    assert np.all(w[-4:] < 0.01)
+    assert np.all(w[:-4] > 0.99)
 
 
 def test_mesh_size_divisibility(rng):
