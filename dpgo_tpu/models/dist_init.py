@@ -1,0 +1,254 @@
+"""Distributed (multi-robot) initialization — the no-centralized-init path.
+
+TPU-native equivalent of the reference's inter-agent frame alignment
+(``PGOAgent::initializeInGlobalFrame`` and helpers, reference
+``src/PGOAgent.cpp:250-432``): each agent initializes its trajectory in its
+OWN frame from its private measurements (``localInitialization``,
+``PGOAgent.cpp:947-962``), robot 0 anchors the global frame
+(``PGOAgent.cpp:182-186``), and every other robot estimates the rigid
+transform aligning its local frame to the global frame from the inter-robot
+loop closures it shares with an already-initialized neighbor — robustly,
+via GNC rotation averaging over per-edge candidate transforms.
+
+The reference runs this as a message-driven protocol (first pose message
+from an initialized neighbor triggers alignment, abort-and-retry on empty
+inlier sets, ``PGOAgent.cpp:396-400``).  Here the same dependency structure
+is a host-side BFS over the robot adjacency graph: alignment order is
+by hop distance from robot 0, each robot aligns against its
+best-connected initialized neighbor and falls back to its other initialized
+neighbors when the inlier set is too small — the batched averaging math
+runs in jitted JAX.  This is a one-time host phase; the steady-state RBCD
+loop is unaffected.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import AgentParams, RobustCostType
+from ..types import Measurements, edge_set_from_measurements
+from ..utils.lie import angular_to_chordal_so3
+from ..utils.partition import Partition
+from ..ops import averaging, chordal
+from .local_pgo import lift
+from .rbcd import GraphMeta, MultiAgentGraph, lifting_matrix, scatter_to_agents
+
+
+def _se(R: np.ndarray, t: np.ndarray, d: int) -> np.ndarray:
+    """(d+1)x(d+1) homogeneous matrix from (R [d,d], t [d])."""
+    T = np.eye(d + 1)
+    T[:d, :d] = R
+    T[:d, d] = t
+    return T
+
+
+def _se_inv(T: np.ndarray, d: int) -> np.ndarray:
+    R, t = T[:d, :d], T[:d, d]
+    return _se(R.T, -R.T @ t, d)
+
+
+def local_initialization(part: Partition, params: AgentParams,
+                         dtype=jnp.float64) -> np.ndarray:
+    """Per-agent trajectory estimate in each agent's OWN frame.
+
+    [A, n_max, d, d+1]; chordal initialization from the agent's private
+    measurements for the L2 cost, odometry propagation for robust costs —
+    the reference's ``localInitialization`` policy (``PGOAgent.cpp:947-962``,
+    odometry under GNC because the chordal solve has no outlier rejection).
+    """
+    meas = part.meas
+    A = part.num_robots
+    d = meas.d
+    use_chordal = params.robust.cost_type == RobustCostType.L2
+    out = np.zeros((A, part.n_max, d, d + 1))
+    out[..., :d] = np.eye(d)
+    for a in range(A):
+        sel = (np.asarray(meas.r1) == a) & (np.asarray(meas.r2) == a)
+        sub = dataclasses.replace(
+            meas,
+            num_poses=int(part.n[a]),
+            r1=meas.r1[sel], p1=meas.p1[sel],
+            r2=meas.r2[sel], p2=meas.p2[sel],
+            R=meas.R[sel], t=meas.t[sel],
+            kappa=meas.kappa[sel], tau=meas.tau[sel],
+            weight=meas.weight[sel], is_known_inlier=meas.is_known_inlier[sel],
+        )
+        edges = edge_set_from_measurements(sub, dtype=dtype)
+        n_a = int(part.n[a])
+        if use_chordal:
+            T = chordal.chordal_initialization(edges, n_a)
+        else:
+            T = chordal.odometry_from_edges(edges, n_a)
+        out[a, :n_a] = np.asarray(T)
+    return out
+
+
+def _alignment_candidates(part: Partition, T_local: np.ndarray,
+                          T_global: np.ndarray, b: int, a: int):
+    """Candidate frame-alignment transforms for robot ``b`` (uninitialized,
+    frame ``world1``) from robot ``a`` (initialized, frame ``world2``).
+
+    One candidate per shared edge between the two robots — the loop of
+    ``computeRobustNeighborTransformTwoStage`` over the pose dict
+    (``PGOAgent.cpp:290-305``), each candidate being
+    ``computeNeighborTransform`` (``PGOAgent.cpp:250-288``):
+
+        T_world2_world1 = T_world2_frame2 . T_frame1_frame2^-1 . T_world1_frame1^-1
+
+    where frame1 is b's endpoint pose (in b's local trajectory) and frame2
+    is a's endpoint pose (already in the global frame).  The reference
+    rounds the neighbor's lifted pose via YLift^T; here agent a's global
+    SE(d) estimate is available directly.
+    """
+    meas = part.meas
+    d = meas.d
+    r1 = np.asarray(meas.r1)
+    r2 = np.asarray(meas.r2)
+    Rs, ts = [], []
+    for k in np.nonzero(((r1 == a) & (r2 == b)) | ((r1 == b) & (r2 == a)))[0]:
+        dT = _se(np.asarray(meas.R[k]), np.asarray(meas.t[k]), d)
+        if int(r1[k]) == a:  # incoming edge a -> b
+            T_f1_f2 = _se_inv(dT, d)
+            p_b, p_a = int(meas.p2[k]), int(meas.p1[k])
+        else:                # outgoing edge b -> a
+            T_f1_f2 = dT
+            p_b, p_a = int(meas.p1[k]), int(meas.p2[k])
+        T_w2_f2 = _se(T_global[a, p_a, :, :d], T_global[a, p_a, :, d], d)
+        T_w1_f1 = _se(T_local[b, p_b, :, :d], T_local[b, p_b, :, d], d)
+        T = T_w2_f2 @ _se_inv(T_f1_f2, d) @ _se_inv(T_w1_f1, d)
+        Rs.append(T[:d, :d])
+        ts.append(T[:d, d])
+    return np.stack(Rs), np.stack(ts)
+
+
+def robust_frame_alignment(Rs: np.ndarray, ts: np.ndarray, *,
+                           two_stage: bool = True,
+                           rotation_threshold_rad: float = 0.5):
+    """Robust average of candidate transforms -> (R, t, num_inliers).
+
+    Two-stage (default): GNC rotation averaging at a ~30 degree chordal
+    threshold, then translation averaging over the rotation inliers
+    (``computeRobustNeighborTransformTwoStage``, ``PGOAgent.cpp:290-331``).
+    Single-stage: joint robust SE(d) averaging with the reference's
+    kappa=1.82 / tau=0.01 / chi2(0.9, 3) threshold
+    (``computeRobustNeighborTransform``, ``PGOAgent.cpp:333-367``).
+    """
+    Rs_j = jnp.asarray(Rs)
+    ts_j = jnp.asarray(ts)
+    if two_stage:
+        thr = angular_to_chordal_so3(rotation_threshold_rad)
+        rot = averaging.robust_single_rotation_averaging(
+            Rs_j, error_threshold=thr)
+        inl = rot.inlier_mask.astype(Rs_j.dtype)
+        t = averaging.single_translation_averaging(ts_j, mask=inl)
+        return (np.asarray(rot.R), np.asarray(t),
+                int(np.asarray(rot.inlier_mask).sum()))
+    from ..utils.lie import error_threshold_at_quantile
+    k = Rs_j.shape[0]
+    res = averaging.robust_single_pose_averaging(
+        Rs_j, ts_j,
+        kappa=jnp.full(k, 1.82, Rs_j.dtype),
+        tau=jnp.full(k, 0.01, Rs_j.dtype),
+        error_threshold=error_threshold_at_quantile(0.9, 3))
+    return (np.asarray(res.R), np.asarray(res.t),
+            int(np.asarray(res.inlier_mask).sum()))
+
+
+def distributed_initialization(
+    part: Partition,
+    meta: GraphMeta,
+    graph: MultiAgentGraph,
+    params: AgentParams,
+    dtype=jnp.float64,
+    two_stage: bool = True,
+) -> jax.Array:
+    """Initial lifted state X0 [A, n_max, r, d+1] without any centralized
+    solve — the deployment initialization path.
+
+    Robot 0's local frame IS the global frame (``PGOAgent.cpp:182-186``);
+    remaining robots align by BFS from robot 0.  A robot prefers the
+    initialized neighbor sharing the most edges and falls back to others
+    when GNC finds fewer than ``params.robust_init_min_inliers`` inliers
+    (the message-driven retry of ``PGOAgent.cpp:396-400``); if every
+    neighbor fails, the largest candidate set is used unweighted (with a
+    warning) so the solve can proceed — RBCD itself corrects moderate
+    misalignment.
+    """
+    A = part.num_robots
+    d = part.meas.d
+    min_inliers = max(1, params.robust_init_min_inliers)
+
+    T_local = local_initialization(part, params, dtype)
+    T_global = np.array(T_local)
+
+    # Robot adjacency weighted by shared-edge counts.
+    r1 = np.asarray(part.meas.r1)
+    r2 = np.asarray(part.meas.r2)
+    n_shared = np.zeros((A, A), np.int64)
+    for k in np.nonzero(r1 != r2)[0]:
+        n_shared[r1[k], r2[k]] += 1
+        n_shared[r2[k], r1[k]] += 1
+
+    initialized = {0}
+    while len(initialized) < A:
+        # Next robot: most shared edges into the initialized set (BFS-ish,
+        # best-connected first — the robots the reference would reach first).
+        frontier = [
+            (int(n_shared[b, list(initialized)].sum()), b)
+            for b in range(A) if b not in initialized
+        ]
+        weight, b = max(frontier)
+        if weight == 0:
+            raise ValueError(
+                f"robot {b} shares no edges with the initialized component; "
+                "the robot-level pose graph is disconnected")
+        neighbors = sorted((a for a in initialized if n_shared[b, a] > 0),
+                           key=lambda a: -n_shared[b, a])
+        best = None  # (num_inliers, R, t)
+        for a in neighbors:
+            Rs, ts = _alignment_candidates(part, T_local, T_global, b, a)
+            R, t, ninl = robust_frame_alignment(Rs, ts, two_stage=two_stage)
+            if best is None or ninl > best[0]:
+                best = (ninl, R, t)
+            if ninl >= min_inliers:
+                break
+        ninl, R, t = best
+        if 0 < ninl < min_inliers:
+            # Fewer inliers than requested but a usable robust estimate —
+            # the reference accepts any non-empty inlier set
+            # (PGOAgent.cpp:396-400 only aborts on zero).
+            warnings.warn(
+                f"[dist_init] robot {b}: robust alignment found only "
+                f"{ninl} inlier(s) (< {min_inliers}); using them")
+        elif ninl == 0:
+            # Every neighbor's GNC rejected everything.  Unweighted
+            # averaging over the best-connected neighbor's candidates keeps
+            # the solve going (RBCD corrects moderate misalignment), but the
+            # estimate may be poisoned by outliers — warn loudly.
+            a = neighbors[0]
+            Rs, ts = _alignment_candidates(part, T_local, T_global, b, a)
+            R, t = averaging.single_pose_averaging(jnp.asarray(Rs), jnp.asarray(ts))
+            R, t = np.asarray(R), np.asarray(t)
+            warnings.warn(
+                f"[dist_init] robot {b}: robust alignment found NO inliers "
+                f"against any initialized neighbor; falling back to "
+                f"unweighted averaging over {len(Rs)} candidates")
+        # T_global_pose = T_align . T_local_pose for the whole trajectory
+        # (initializeInGlobalFrame, PGOAgent.cpp:402-419).
+        n_b = int(part.n[b])
+        Rl = T_local[b, :n_b, :, :d]
+        tl = T_local[b, :n_b, :, d]
+        T_global[b, :n_b, :, :d] = np.einsum("ab,nbc->nac", R, Rl)
+        T_global[b, :n_b, :, d] = tl @ R.T + t
+        initialized.add(b)
+
+    # Lift: X = YLift . T per pose (PGOAgent.cpp:415), batched.
+    ylift = lifting_matrix(meta, dtype)
+    flat = jnp.asarray(T_global.reshape(-1, d, d + 1), dtype)
+    X0 = lift(flat, ylift).reshape(A, part.n_max, meta.rank, d + 1)
+    return X0 * jnp.asarray(graph.pose_mask, dtype)[:, :, None, None]

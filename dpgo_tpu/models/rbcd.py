@@ -355,6 +355,15 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
     and the aux state collapses (V = Y = X, gamma = alpha = 0) — so it
     compiles as a plain round plus aux reset, with no wasted solve.
     """
+    if params.acceleration and state.V is None:
+        raise ValueError(
+            "params.acceleration is set but the state has no V sequence — "
+            "build the state with init_state(..., params=params)")
+    if (params.robust.cost_type != RobustCostType.L2
+            and not params.robust_opt_warm_start and state.X_init is None):
+        raise ValueError(
+            "robust_opt_warm_start=False requires the state to carry the "
+            "initial guess — build it with init_state(..., params=params)")
     accel = params.acceleration and state.V is not None
     if accel and params.schedule == Schedule.ASYNC:
         # The reference forbids this combination (assert at PGOAgent.cpp:863):
@@ -633,6 +642,22 @@ def run_rbcd(
                       weights=global_weights(state.weights, graph, num_meas))
 
 
+def initial_state_for(init: str, part: Partition, meta: GraphMeta,
+                      graph: MultiAgentGraph, params: AgentParams,
+                      dtype) -> jax.Array:
+    """Initial lifted state by policy: ``"chordal"`` = centralized chordal
+    init (the reference demo's, ``MultiRobotExample.cpp:158-165``);
+    ``"distributed"`` = per-agent local init + robust inter-robot frame
+    alignment, no centralized solve (the deployment path,
+    ``PGOAgent.cpp:250-432``)."""
+    if init == "chordal":
+        return centralized_chordal_init(part, meta, graph, dtype)
+    if init == "distributed":
+        from .dist_init import distributed_initialization
+        return distributed_initialization(part, meta, graph, params, dtype)
+    raise ValueError(f"unknown init policy {init!r}")
+
+
 def solve_rbcd(
     meas: Measurements,
     num_robots: int,
@@ -642,6 +667,7 @@ def solve_rbcd(
     eval_every: int = 1,
     dtype=jnp.float64,
     part: Partition | None = None,
+    init: str = "chordal",
 ) -> RBCDResult:
     """Distributed solve on one device with centralized monitoring."""
     params = params or AgentParams(d=meas.d, r=5, num_robots=num_robots)
@@ -649,7 +675,7 @@ def solve_rbcd(
 
     part = part or partition_contiguous(meas, num_robots)
     graph, meta = build_graph(part, params.r, dtype)
-    X0 = centralized_chordal_init(part, meta, graph, dtype)
+    X0 = initial_state_for(init, part, meta, graph, params, dtype)
     state = init_state(graph, meta, X0, params=params)
     step = lambda s, uw, rs: rbcd_step(s, graph, meta, params,
                                        update_weights=uw, restart=rs)

@@ -119,6 +119,7 @@ def solve_rbcd_sharded(
     eval_every: int = 1,
     dtype=jnp.float64,
     part: Partition | None = None,
+    init: str = "chordal",
 ) -> rbcd.RBCDResult:
     """Distributed solve over a device mesh — the deployment path of the
     framework (``models.rbcd.solve_rbcd`` is the single-device debug path).
@@ -130,7 +131,7 @@ def solve_rbcd_sharded(
 
     part = part or partition_contiguous(meas, num_robots)
     graph, meta = rbcd.build_graph(part, params.r, dtype)
-    X0 = centralized_chordal_init(part, meta, graph, dtype)
+    X0 = rbcd.initial_state_for(init, part, meta, graph, params, dtype)
     state = init_state(graph, meta, X0, params=params)
     state, graph = shard_problem(mesh, state, graph)
 

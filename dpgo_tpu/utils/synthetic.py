@@ -62,14 +62,17 @@ def make_measurements(rng, n, d=3, num_lc=5, rot_noise=0.0, trans_noise=0.0,
         R, t = relative_measurement(Rs, ts, i, j, rng, rot_noise, trans_noise, d)
         Rm.append(R)
         tm.append(t)
-    # Gross outliers: random rotation + large random translation.
-    for _ in range(outlier_lc):
+    # Gross outliers: random rotation + large random translation.  Keep
+    # them off the odometry chain (j > i + 1) — a consecutive-index edge
+    # would be classified as trusted odometry and never GNC-reweighted.
+    while outlier_lc > 0:
         i, j = sorted(rng.choice(n, 2, replace=False))
-        if j == i:
+        if j <= i + 1:
             continue
         edges.append((int(i), int(j)))
         Rm.append(random_rotation(rng, d))
         tm.append(5.0 * rng.standard_normal(d))
+        outlier_lc -= 1
     m = len(edges)
     e = np.asarray(edges)
     meas = Measurements(
