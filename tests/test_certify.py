@@ -1,0 +1,147 @@
+"""M6 tests: dual certificate + Riemannian staircase (beyond-reference;
+scoped from the T-RO 2021 paper per SURVEY.md section 7, M6 — the reference
+repo contains no certification code to mirror, so these tests validate
+against first principles: dense eigensolves and a constructed suboptimal
+critical point)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dpgo_tpu.config import SolverParams
+from dpgo_tpu.models import certify, local_pgo
+from dpgo_tpu.ops import manifold, quadratic, solver
+from dpgo_tpu.types import Measurements, edge_set_from_measurements
+from synthetic import make_measurements, trajectory_error
+
+
+def dense_certificate(X, edges):
+    """Assemble S explicitly by applying the operator to basis vectors."""
+    n, _, dh = X.shape
+    lam = certify.dual_blocks(X, edges)
+    m = n * dh
+    eye = jnp.eye(m).reshape(m, n, dh).transpose(1, 0, 2)  # [n, m, d+1]
+    S_cols = certify.certificate_matvec(eye, edges, lam)
+    return np.asarray(S_cols.transpose(1, 0, 2).reshape(m, m))
+
+
+def test_certificate_operator_matches_dense_eig(rng):
+    meas, _ = make_measurements(rng, n=10, d=3, num_lc=5,
+                                rot_noise=0.05, trans_noise=0.05)
+    res = local_pgo.solve_local(meas, rank=5, grad_norm_tol=1e-9,
+                                max_iters=500)
+    edges = edge_set_from_measurements(meas, dtype=jnp.float64)
+    S = dense_certificate(res.X, edges)
+    assert np.allclose(S, S.T, atol=1e-9)
+    lam_dense = float(np.linalg.eigvalsh(S)[0])
+    cert = certify.certify_solution(res.X, edges)
+    assert abs(cert.lambda_min - lam_dense) < 1e-6 * max(1.0, abs(lam_dense))
+    # Gauge: global-translation directions are in S's nullspace.
+    v = np.zeros((10, 4)); v[:, 3] = 1.0
+    assert np.abs(S @ v.reshape(-1)).max() < 1e-9
+
+
+def test_optimal_solution_certifies(rng):
+    meas, _ = make_measurements(rng, n=20, d=3, num_lc=8,
+                                rot_noise=0.05, trans_noise=0.05)
+    res = local_pgo.solve_local(meas, rank=5, grad_norm_tol=1e-9, max_iters=500)
+    edges = edge_set_from_measurements(meas, dtype=jnp.float64)
+    cert = certify.certify_solution(res.X, edges)
+    assert cert.stationarity_gap < 1e-6
+    assert cert.certified
+    assert cert.lambda_min > -1e-6 * cert.sigma
+
+
+def _winding_cycle(n=12, kappa=10.0, tau=1.0):
+    """SE(2) cycle graph whose measurements are all identity — the global
+    optimum is the all-identity trajectory (cost 0), but the 'winding'
+    configuration R_k = rot(2 pi k / n) is a rank-2 critical point (a
+    genuine local minimum for n > 4): the classic suboptimal critical point
+    of angular synchronization on a cycle."""
+    edges = [(k, (k + 1) % n) for k in range(n)]
+    m = len(edges)
+    e = np.asarray(edges)
+    meas = Measurements(
+        d=2, num_poses=n,
+        r1=np.zeros(m, np.int32), p1=e[:, 0].astype(np.int64),
+        r2=np.zeros(m, np.int32), p2=e[:, 1].astype(np.int64),
+        R=np.tile(np.eye(2), (m, 1, 1)), t=np.zeros((m, 2)),
+        kappa=np.full(m, kappa), tau=np.full(m, tau),
+        weight=np.ones(m), is_known_inlier=np.zeros(m, bool),
+    )
+    th = 2 * np.pi * np.arange(n) / n
+    Rw = np.stack([np.stack([np.cos(th), -np.sin(th)], -1),
+                   np.stack([np.sin(th), np.cos(th)], -1)], -2)  # [n, 2, 2]
+    Xw = np.concatenate([Rw, np.zeros((n, 2, 1))], axis=-1)  # rank 2 = d
+    return meas, jnp.asarray(Xw)
+
+
+def test_winding_local_minimum_fails_certificate_and_staircase_escapes():
+    meas, Xw = _winding_cycle()
+    edges = edge_set_from_measurements(meas, dtype=jnp.float64)
+    n = meas.num_poses
+    params = SolverParams(initial_radius=1e1, max_inner_iters=50)
+    problem = local_pgo.make_problem(edges, n, params.precond_shift)
+
+    # The winding configuration is critical at rank 2: RTR does not move.
+    out = solver.rtr_solve(problem, Xw, params, max_iters=200,
+                           grad_norm_tol=1e-9)
+    assert float(out.grad_norm) < 1e-9
+    f_wind = float(out.f)
+    assert f_wind > 1.0  # far from the global optimum (cost 0)
+
+    # The certificate must detect suboptimality...
+    cert = certify.certify_solution(out.X, edges)
+    assert not cert.certified
+    assert cert.lambda_min < -1e-3
+
+    # ...and climbing the staircase must reach the certified global optimum
+    # (cost 0).  Each escape strictly decreases the cost; this instance
+    # passes through a SECOND suboptimal critical point at rank 3 (cost
+    # exactly half the winding cost) before certifying at rank 4.
+    X = out.X
+    costs = [f_wind]
+    for _ in range(3):
+        X = certify.escape_rank(X, cert.direction, edges)
+        out = solver.rtr_solve(problem, X, params, max_iters=400,
+                               grad_norm_tol=1e-9)
+        X = out.X
+        costs.append(float(out.f))
+        assert costs[-1] < costs[-2]
+        cert = certify.certify_solution(X, edges)
+        if cert.certified:
+            break
+    assert cert.certified
+    assert costs[-1] < 1e-9
+
+
+def test_solve_staircase_end_to_end(rng):
+    meas, (Rs, ts) = make_measurements(rng, n=24, d=3, num_lc=10,
+                                       rot_noise=0.05, trans_noise=0.05)
+    res = certify.solve_staircase(meas, grad_norm_tol=1e-8)
+    assert res.certificate.certified
+    assert res.rank <= 6
+    # Certified solution equals the plain high-rank solve's optimum.
+    ref = local_pgo.solve_local(meas, rank=5, grad_norm_tol=1e-8, max_iters=500)
+    assert res.cost <= ref.cost * (1 + 1e-8) + 1e-12
+
+
+def test_staircase_rounding_handles_rotated_basis(rng):
+    # After an escape the solution may leave the initial lifted subspace;
+    # rounding must still recover a valid SE(d) trajectory.
+    meas, Xw = _winding_cycle()
+    edges = edge_set_from_measurements(meas, dtype=jnp.float64)
+    params = SolverParams(initial_radius=1e1, max_inner_iters=50)
+    problem = local_pgo.make_problem(edges, meas.num_poses, params.precond_shift)
+    out = solver.rtr_solve(problem, Xw, params, max_iters=100, grad_norm_tol=1e-9)
+    cert = certify.certify_solution(out.X, edges)
+    X3 = certify.escape_rank(out.X, cert.direction, edges)
+    out3 = solver.rtr_solve(problem, X3, params, max_iters=300, grad_norm_tol=1e-9)
+    ylift = certify._recover_rounding_basis(out3.X, 2)
+    T = local_pgo.round_solution(out3.X, ylift)
+    R = np.asarray(T[..., :2])
+    RtR = np.einsum("nab,nac->nbc", R, R)
+    assert np.allclose(RtR, np.eye(2), atol=1e-8)
+    assert np.allclose(np.linalg.det(R), 1.0, atol=1e-8)
