@@ -1,0 +1,110 @@
+"""Round-trip tests for the CSV logger / checkpoint subsystem.
+
+Mirrors the reference's use of ``PGOLogger`` (write at ``PGOAgent.cpp:583-603``,
+load for warm restart via ``PGOLogger.cpp:83-225``).
+"""
+
+import numpy as np
+import pytest
+
+from dpgo_tpu.types import Measurements
+from dpgo_tpu.utils import logger
+from dpgo_tpu.utils.lie import rotation2d
+from dpgo_tpu.utils.synthetic import make_measurements
+
+
+def random_rotations(rng, n):
+    A = rng.normal(size=(n, 3, 3))
+    U, _, Vt = np.linalg.svd(A)
+    R = U @ Vt
+    det = np.linalg.det(R)
+    U[:, :, -1] *= np.sign(det)[:, None]
+    return U @ Vt
+
+
+def test_trajectory_roundtrip_3d(tmp_path):
+    rng = np.random.default_rng(0)
+    n = 17
+    T = np.zeros((n, 3, 4))
+    T[:, :, :3] = random_rotations(rng, n)
+    T[:, :, 3] = rng.normal(size=(n, 3))
+    path = str(tmp_path / "trajectory.csv")
+    logger.log_trajectory(T, path)
+    with open(path) as f:
+        assert f.readline().strip() == logger.TRAJECTORY_HEADER
+    T2 = logger.load_trajectory(path)
+    np.testing.assert_allclose(T2, T, atol=1e-12)
+
+
+def test_trajectory_roundtrip_2d(tmp_path):
+    rng = np.random.default_rng(1)
+    n = 9
+    T = np.zeros((n, 2, 3))
+    T[:, :, :2] = rotation2d(rng.uniform(-np.pi, np.pi, size=n))
+    T[:, :, 2] = rng.normal(size=(n, 2))
+    path = str(tmp_path / "trajectory2d.csv")
+    logger.log_trajectory(T, path)
+    T2 = logger.load_trajectory(path, d=2)
+    np.testing.assert_allclose(T2, T, atol=1e-12)
+
+
+def test_measurements_roundtrip(tmp_path):
+    rng = np.random.default_rng(2)
+    meas, _ = make_measurements(rng, n=12, d=3, num_lc=6)
+    meas.weight[:] = rng.uniform(0, 1, size=len(meas))
+    meas.is_known_inlier[::3] = True
+    path = str(tmp_path / "measurements.csv")
+    logger.log_measurements(meas, path)
+    with open(path) as f:
+        assert f.readline().strip() == logger.MEASUREMENT_HEADER
+
+    out = logger.load_measurements(path)
+    np.testing.assert_array_equal(out.r1, meas.r1)
+    np.testing.assert_array_equal(out.p1, meas.p1)
+    np.testing.assert_array_equal(out.r2, meas.r2)
+    np.testing.assert_array_equal(out.p2, meas.p2)
+    np.testing.assert_allclose(out.R, meas.R, atol=1e-12)
+    np.testing.assert_allclose(out.t, meas.t, atol=1e-12)
+    np.testing.assert_allclose(out.kappa, meas.kappa, atol=1e-12)
+    np.testing.assert_allclose(out.tau, meas.tau, atol=1e-12)
+    np.testing.assert_allclose(out.weight, meas.weight, atol=1e-12)
+    np.testing.assert_array_equal(out.is_known_inlier, meas.is_known_inlier)
+
+    # load_weight=False resets GNC weights (PGOLogger.cpp:148, 217-218)
+    fresh = logger.load_measurements(path, load_weight=False)
+    np.testing.assert_array_equal(fresh.weight, np.ones(len(meas)))
+
+
+def test_measurements_roundtrip_2d(tmp_path):
+    rng = np.random.default_rng(3)
+    meas, _ = make_measurements(rng, n=10, d=2, num_lc=4)
+    path = str(tmp_path / "m2d.csv")
+    logger.log_measurements(meas, path)
+    out = logger.load_measurements(path, d=2)
+    np.testing.assert_allclose(out.R, meas.R, atol=1e-12)
+    np.testing.assert_allclose(out.t, meas.t, atol=1e-12)
+
+
+def test_matrix_roundtrip(tmp_path):
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(7, 5, 4))
+    path = str(tmp_path / "X.txt")
+    logger.save_matrix(X, path)
+    X2 = logger.load_matrix(path, shape=X.shape)
+    np.testing.assert_allclose(X2, X, atol=1e-14)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    rng = np.random.default_rng(5)
+    ckpt = logger.Checkpoint(
+        X=rng.normal(size=(3, 5, 10, 4)),
+        weights=rng.uniform(0, 1, size=(3, 20)),
+        mu=0.125,
+        iteration=42,
+    )
+    logger.save_checkpoint(ckpt, str(tmp_path / "ckpt"))
+    out = logger.load_checkpoint(str(tmp_path / "ckpt"))
+    np.testing.assert_allclose(out.X, ckpt.X)
+    np.testing.assert_allclose(out.weights, ckpt.weights)
+    assert out.mu == ckpt.mu
+    assert out.iteration == ckpt.iteration
