@@ -1,0 +1,642 @@
+"""Per-robot agent runtime with the reference's message-passing surface.
+
+The batched RBCD core (``dpgo_tpu.models.rbcd``) is the TPU-native way to run
+*all* agents on a chip/mesh.  This module is the complementary *deployment*
+shape: one ``PGOAgent`` object per robot — each on its own host/process, with
+any transport (ROS, gRPC, in-process calls) carrying the pose dictionaries —
+mirroring the reference's ``PGOAgent`` (``include/DPGO/PGOAgent.h:284-486``,
+``src/PGOAgent.cpp``) so a user of the reference finds the same surface:
+
+=========================================  ====================================
+reference (C++)                            here
+=========================================  ====================================
+``setPoseGraph``                           ``set_pose_graph``
+``setLiftingMatrix``/``getLiftingMatrix``  ``set_lifting_matrix``/``get_lifting_matrix``
+``getSharedPoseDict``                      ``get_shared_pose_dict``
+``updateNeighborPoses``                    ``update_neighbor_poses``
+``getAuxSharedPoseDict``                   ``get_aux_shared_pose_dict``
+``updateAuxNeighborPoses``                 ``update_aux_neighbor_poses``
+``getStatus``/``setNeighborStatus``        ``get_status``/``set_neighbor_status``
+``shouldTerminate``                        ``should_terminate``
+``setGlobalAnchor``                        ``set_global_anchor``
+``getTrajectoryInLocalFrame``              ``trajectory_in_local_frame``
+``getTrajectoryInGlobalFrame``             ``trajectory_in_global_frame``
+``iterate``                                ``iterate``
+``startOptimizationLoop``                  ``start_optimization_loop``
+``endOptimizationLoop``                    ``end_optimization_loop``
+``reset``                                  ``reset``
+=========================================  ====================================
+
+The compute inside ``iterate`` is the same jitted single-agent RTR step the
+batched core vmaps (``models.rbcd._agent_update``); per-agent shapes are
+static after ``set_pose_graph`` so each agent compiles its step once.  The
+async optimization loop (``start_optimization_loop``) is a host thread firing
+``iterate`` at ``Exp(rate)``-distributed intervals — the RA-L 2020
+Poisson-clock model of ``runOptimizationLoop`` (``PGOAgent.cpp:876-898``) —
+with a lock serializing iterate against concurrent pose updates (the
+reference's three mutexes, ``PGOAgent.h:589-597``, collapse to one because
+the jitted step consumes a consistent snapshot taken under the lock).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import AgentParams, ROptAlg, RobustCostType
+from . import robust as robust_mod
+from .types import EdgeSet, Measurements
+from .utils.lie import angular_to_chordal_so3, lifting_matrix as make_lifting_matrix
+from .ops import chordal, manifold, quadratic
+from .models.rbcd import _agent_update, _edge_residuals
+from .models.dist_init import _se, _se_inv, robust_frame_alignment
+from .models.local_pgo import lift, round_solution
+
+PoseID = tuple[int, int]  # (robot_id, pose_index) — reference DPGO_types.h:64
+PoseDict = dict  # PoseID -> np.ndarray [r, d+1]
+
+
+class AgentState(enum.Enum):
+    """Agent lifecycle (reference ``PGOAgentState``, ``PGOAgent.h:46-54``)."""
+
+    WAIT_FOR_DATA = 0
+    WAIT_FOR_INITIALIZATION = 1
+    INITIALIZED = 2
+
+
+@dataclasses.dataclass
+class PGOAgentStatus:
+    """Gossiped observability struct (reference ``PGOAgent.h:163-207``)."""
+
+    robot_id: int
+    state: AgentState = AgentState.WAIT_FOR_DATA
+    instance_number: int = 0
+    iteration_number: int = 0
+    ready_to_terminate: bool = False
+    relative_change: float = float("inf")
+
+
+class PGOAgent:
+    """One robot's PGO runtime; the caller supplies the transport."""
+
+    def __init__(self, robot_id: int, params: AgentParams):
+        self.robot_id = int(robot_id)
+        self.params = params
+        self.d = params.d
+        self.r = params.r
+        self.num_robots = params.num_robots
+
+        self._lock = threading.RLock()
+        self._status = PGOAgentStatus(robot_id=self.robot_id)
+        self._neighbor_status: dict[int, PGOAgentStatus] = {}
+
+        self._ylift: np.ndarray | None = None
+        if self.robot_id == 0:
+            # Robot 0 generates the deterministic shared lifting matrix
+            # (PGOAgent.cpp:46, fixedStiefelVariable DPGO_utils.cpp:502-507)
+            # and its local frame is the global frame (PGOAgent.cpp:182-186).
+            self.set_lifting_matrix(
+                np.asarray(make_lifting_matrix(self.r, self.d, jnp.float64)))
+
+        self._clear_problem()
+
+        # Async loop (startOptimizationLoop, PGOAgent.cpp:861-916)
+        self._loop_thread: threading.Thread | None = None
+        self._end_loop = threading.Event()
+
+    # -- problem ingestion --------------------------------------------------
+
+    def _clear_problem(self):
+        self.n = 0
+        self._meas: Measurements | None = None
+        self._edges: EdgeSet | None = None
+        self._is_shared: np.ndarray | None = None   # [E] bool
+        self._shared_other: np.ndarray | None = None  # [E] neighbor robot (or -1)
+        self._nbr_slot: dict[PoseID, int] = {}      # remote PoseID -> buffer slot
+        self._slot_pose: list[PoseID] = []
+        self._public: list[int] = []                # local public pose indices
+        self.X: np.ndarray | None = None            # [n, r, d+1] lifted
+        self._T_local: np.ndarray | None = None     # [n, d, d+1] own frame
+        self._X_init: np.ndarray | None = None
+        self._weights: np.ndarray | None = None     # [E]
+        self._shared_key_to_edge: dict = {}         # ((r1,p1),(r2,p2)) -> row
+        self._mu = self.params.robust.gnc_init_mu
+        self._num_weight_updates = 0
+        self._neighbor_poses: dict[PoseID, np.ndarray] = {}
+        self._aux_neighbor_poses: dict[PoseID, np.ndarray] = {}
+        self._global_anchor: np.ndarray | None = None
+        # Nesterov sequences (PGOAgent.cpp:1054-1091)
+        self._V: np.ndarray | None = None
+        self._Y: np.ndarray | None = None
+        self._gamma = 0.0
+        self._alpha = 0.0
+        self._step_fn = None
+        self._status.state = AgentState.WAIT_FOR_DATA
+        self._status.iteration_number = 0
+        self._status.ready_to_terminate = False
+        self._status.relative_change = float("inf")
+
+    def set_lifting_matrix(self, ylift: np.ndarray) -> None:
+        """Install the shared lifting matrix (reference ``setLiftingMatrix``,
+        broadcast from robot 0, ``MultiRobotExample.cpp:139-146``)."""
+        ylift = np.asarray(ylift, np.float64)
+        assert ylift.shape == (self.r, self.d), ylift.shape
+        self._ylift = ylift
+
+    def get_lifting_matrix(self) -> np.ndarray:
+        assert self._ylift is not None, "lifting matrix not set"
+        return self._ylift
+
+    def set_pose_graph(self, odometry: Measurements,
+                       private_loop_closures: Measurements,
+                       shared_loop_closures: Measurements) -> None:
+        """Ingest this robot's measurements (reference ``setPoseGraph``,
+        ``PGOAgent.cpp:126-195`` + ``addOdometry``/``add*LoopClosure``
+        ``:197-248``) and run local initialization in the robot's own frame.
+        """
+        with self._lock:
+            me = self.robot_id
+            all_meas = Measurements.concatenate(
+                [odometry, private_loop_closures, shared_loop_closures])
+            n = 0
+            for k in range(len(all_meas)):
+                if int(all_meas.r1[k]) == me:
+                    n = max(n, int(all_meas.p1[k]) + 1)
+                if int(all_meas.r2[k]) == me:
+                    n = max(n, int(all_meas.p2[k]) + 1)
+            self.n = n
+            self._meas = all_meas
+
+            E = len(all_meas)
+            is_shared = np.zeros(E, bool)
+            shared_other = np.full(E, -1, np.int64)
+            ti = np.zeros(E, np.int64)
+            hi = np.zeros(E, np.int64)
+            pub: dict[int, None] = {}
+            self._nbr_slot = {}
+            self._slot_pose = []
+            for k in range(E):
+                a, p = int(all_meas.r1[k]), int(all_meas.p1[k])
+                b, q = int(all_meas.r2[k]), int(all_meas.p2[k])
+                if a == me and b == me:
+                    ti[k], hi[k] = p, q
+                    continue
+                is_shared[k] = True
+                if a == me:
+                    shared_other[k] = b
+                    pub.setdefault(p)
+                    ti[k] = p
+                    hi[k] = n + self._slot(b, q)
+                else:
+                    shared_other[k] = a
+                    pub.setdefault(q)
+                    hi[k] = q
+                    ti[k] = n + self._slot(a, p)
+            self._public = sorted(pub)
+            self._is_shared = is_shared
+            self._shared_other = shared_other
+            self._shared_key_to_edge = {
+                ((int(all_meas.r1[k]), int(all_meas.p1[k])),
+                 (int(all_meas.r2[k]), int(all_meas.p2[k]))): k
+                for k in np.nonzero(is_shared)[0]}
+
+            is_lc = np.arange(E) >= len(odometry)
+            from .types import edge_set_from_measurements
+            self._edges = edge_set_from_measurements(
+                all_meas, tail_index=ti, head_index=hi, is_lc=is_lc,
+                dtype=jnp.float64)
+            self._weights = np.asarray(all_meas.weight, np.float64).copy()
+            self._mu = self.params.robust.gnc_init_mu
+
+            # Local init in own frame (localInitialization, PGOAgent.cpp:947-962)
+            priv = ~is_shared
+            sub = all_meas.select(priv)
+            sub = dataclasses.replace(sub, num_poses=n,
+                                      r1=np.zeros(len(sub), np.int32),
+                                      r2=np.zeros(len(sub), np.int32))
+            sub_edges = edge_set_from_measurements(sub, dtype=jnp.float64)
+            if self.params.robust.cost_type == RobustCostType.L2:
+                T0 = chordal.chordal_initialization(sub_edges, n)
+            else:
+                T0 = chordal.odometry_from_edges(sub_edges, n)
+            self._T_local = np.asarray(T0)
+
+            if self.robot_id == 0:
+                self._lift_and_initialize(self._T_local)
+            else:
+                self._status.state = AgentState.WAIT_FOR_INITIALIZATION
+
+    def _slot(self, robot: int, pose: int) -> int:
+        key = (robot, pose)
+        if key not in self._nbr_slot:
+            self._nbr_slot[key] = len(self._slot_pose)
+            self._slot_pose.append(key)
+        return self._nbr_slot[key]
+
+    def _lift_and_initialize(self, T_global_frame: np.ndarray) -> None:
+        """X = YLift . T per pose (PGOAgent.cpp:183, 415), enter INITIALIZED."""
+        assert self._ylift is not None, "lifting matrix required before init"
+        X = np.asarray(lift(jnp.asarray(T_global_frame), jnp.asarray(self._ylift)))
+        self.X = X
+        self._X_init = X.copy()
+        self._V = X.copy()
+        self._Y = X.copy()
+        self._gamma = 0.0
+        self._alpha = 0.0
+        self._status.state = AgentState.INITIALIZED
+        self._build_step()
+
+    def _build_step(self):
+        params = self.params
+
+        @jax.jit
+        def step(X_local, z, weights):
+            edges = self._edges._replace(weight=weights)
+            return _agent_update(X_local, z, edges, params)
+
+        self._step_fn = step
+
+    # -- pose sharing (the message vocabulary, SURVEY.md section 2.4) -------
+
+    def get_shared_pose_dict(self) -> PoseDict:
+        """Public poses of X (reference ``getSharedPoseDict``,
+        ``PGOAgent.cpp:95-105``)."""
+        with self._lock:
+            if self.X is None:
+                return {}
+            return {(self.robot_id, p): self.X[p].copy() for p in self._public}
+
+    def get_aux_shared_pose_dict(self) -> PoseDict:
+        """Public poses of the Nesterov aux sequence Y
+        (``getAuxSharedPoseDict``, ``PGOAgent.cpp:107-118``)."""
+        with self._lock:
+            if self._Y is None:
+                return {}
+            return {(self.robot_id, p): self._Y[p].copy() for p in self._public}
+
+    def update_neighbor_poses(self, neighbor_id: int, pose_dict: PoseDict) -> None:
+        """Receive a neighbor's public poses (``updateNeighborPoses``,
+        ``PGOAgent.cpp:434-458``).  The first message from an INITIALIZED
+        neighbor triggers robust frame alignment (``PGOAgent.cpp:369-432``).
+        """
+        with self._lock:
+            for key, block in pose_dict.items():
+                if key in self._nbr_slot:
+                    self._neighbor_poses[key] = np.asarray(block, np.float64)
+            if (self._status.state == AgentState.WAIT_FOR_INITIALIZATION
+                    and self._neighbor_is_initialized(neighbor_id)):
+                self._try_initialize_in_global_frame(neighbor_id)
+
+    def update_aux_neighbor_poses(self, neighbor_id: int, pose_dict: PoseDict) -> None:
+        """(``updateAuxNeighborPoses``, ``PGOAgent.cpp:460-479``)."""
+        with self._lock:
+            for key, block in pose_dict.items():
+                if key in self._nbr_slot:
+                    self._aux_neighbor_poses[key] = np.asarray(block, np.float64)
+
+    def _neighbor_is_initialized(self, neighbor_id: int) -> bool:
+        st = self._neighbor_status.get(neighbor_id)
+        if st is not None:
+            return st.state == AgentState.INITIALIZED
+        # Without gossiped status, receiving poses implies the sender is
+        # initialized (the reference transport only publishes after init).
+        return True
+
+    def _try_initialize_in_global_frame(self, neighbor_id: int) -> None:
+        """Robust frame alignment against ``neighbor_id``
+        (``initializeInGlobalFrame`` + two-stage GNC averaging,
+        ``PGOAgent.cpp:250-331``, ``369-432``).  Abort-and-retry on an empty
+        inlier set (``:396-400``): state stays WAIT_FOR_INITIALIZATION and the
+        next pose message tries again."""
+        if self._meas is None or self._ylift is None:
+            # Lifting-matrix broadcast has not arrived yet; defer — the next
+            # pose message retries (same contract as the empty-inlier abort).
+            return
+        me, d = self.robot_id, self.d
+        m = self._meas
+        Rs, ts = [], []
+        for k in np.nonzero(self._shared_other == neighbor_id)[0]:
+            a, p = int(m.r1[k]), int(m.p1[k])
+            b, q = int(m.r2[k]), int(m.p2[k])
+            dT = _se(np.asarray(m.R[k]), np.asarray(m.t[k]), d)
+            if a == me:  # outgoing me -> neighbor; frame1 = my p
+                key = (b, q)
+                if key not in self._neighbor_poses:
+                    continue
+                T_f1_f2 = dT
+                p_mine = p
+            else:        # incoming neighbor -> me; frame1 = my q
+                key = (a, p)
+                if key not in self._neighbor_poses:
+                    continue
+                T_f1_f2 = _se_inv(dT, d)
+                p_mine = q
+            # Round the neighbor's lifted public pose to SE(d) via YLift^T
+            # (computeNeighborTransform, PGOAgent.cpp:250-288).
+            Tn = np.asarray(round_solution(
+                jnp.asarray(self._neighbor_poses[key])[None],
+                jnp.asarray(self._ylift)))[0]
+            T_w2_f2 = _se(Tn[:, :d], Tn[:, d], d)
+            T_w1_f1 = _se(self._T_local[p_mine, :, :d],
+                          self._T_local[p_mine, :, d], d)
+            T = T_w2_f2 @ _se_inv(T_f1_f2, d) @ _se_inv(T_w1_f1, d)
+            Rs.append(T[:d, :d])
+            ts.append(T[:d, d])
+        if not Rs:
+            return
+        R, t, ninl = robust_frame_alignment(np.stack(Rs), np.stack(ts))
+        if ninl == 0:
+            return  # abort; retry on the next message (PGOAgent.cpp:396-400)
+        Rl = self._T_local[:, :, :d]
+        tl = self._T_local[:, :, d]
+        T_global = np.zeros_like(self._T_local)
+        T_global[:, :, :d] = np.einsum("ab,nbc->nac", R, Rl)
+        T_global[:, :, d] = tl @ R.T + t
+        self._lift_and_initialize(T_global)
+
+    # -- status gossip ------------------------------------------------------
+
+    def get_status(self) -> PGOAgentStatus:
+        with self._lock:
+            return dataclasses.replace(self._status)
+
+    def set_neighbor_status(self, status: PGOAgentStatus) -> None:
+        """(``setNeighborStatus``, ``PGOAgent.h:383-388``)."""
+        with self._lock:
+            self._neighbor_status[status.robot_id] = dataclasses.replace(status)
+
+    def should_terminate(self) -> bool:
+        """Team consensus (``shouldTerminate``, ``PGOAgent.cpp:1007-1031``):
+        every robot INITIALIZED on this instance and ready to terminate."""
+        with self._lock:
+            statuses = [self._status] + [
+                self._neighbor_status[k] for k in sorted(self._neighbor_status)]
+            if len(statuses) < self.num_robots:
+                return False
+            for st in statuses:
+                if (st.state != AgentState.INITIALIZED
+                        or st.instance_number != self._status.instance_number
+                        or not st.ready_to_terminate):
+                    return False
+            return True
+
+    # -- anchors & trajectories --------------------------------------------
+
+    def set_global_anchor(self, anchor: np.ndarray) -> None:
+        """Shared gauge for rounding (``setGlobalAnchor``,
+        ``PGOAgent.cpp:1001-1005``): robot 0's first pose block of X."""
+        with self._lock:
+            anchor = np.asarray(anchor, np.float64)
+            assert anchor.shape == (self.r, self.d + 1)
+            self._global_anchor = anchor
+
+    def get_global_anchor(self) -> np.ndarray | None:
+        with self._lock:
+            if self.robot_id == 0 and self.X is not None:
+                return self.X[0].copy()
+            return self._global_anchor
+
+    def trajectory_in_local_frame(self) -> np.ndarray:
+        """Rounded trajectory relative to this robot's first pose
+        (``getTrajectoryInLocalFrame``, ``PGOAgent.cpp:481-498``)."""
+        with self._lock:
+            T = self._round(self.X)
+            return _express_in_frame(T, T[0])
+
+    def trajectory_in_global_frame(self) -> np.ndarray:
+        """Rounded trajectory in the anchor's frame
+        (``getTrajectoryInGlobalFrame``, ``PGOAgent.cpp:500-519``)."""
+        with self._lock:
+            assert self.X is not None, "agent not initialized"
+            anchor = self.get_global_anchor()
+            assert anchor is not None, "global anchor not set"
+            Ta = np.asarray(round_solution(
+                jnp.asarray(anchor)[None], jnp.asarray(self._ylift)))[0]
+            return _express_in_frame(self._round(self.X), Ta)
+
+    def _round(self, X: np.ndarray) -> np.ndarray:
+        assert X is not None, "agent not initialized"
+        return np.asarray(round_solution(jnp.asarray(X), jnp.asarray(self._ylift)))
+
+    # -- GNC weights --------------------------------------------------------
+
+    def _update_loop_closure_weights(self) -> None:
+        """Recompute robust weights from current residuals
+        (``updateLoopClosuresWeights``, ``PGOAgent.cpp:1181-1245``).
+
+        Ownership (``:1201-1206``): for a shared edge, the LOWER robot id
+        computes the weight; the other endpoint receives it via
+        ``get_shared_weight_dict``/``update_shared_weights`` (the
+        ``mPublishWeightsRequested`` path consumed by dpgo_ros).
+        """
+        z = self._neighbor_buffer()
+        if z is None:
+            return
+        edges = self._edges._replace(weight=jnp.asarray(self._weights))
+        res = np.asarray(_edge_residuals(jnp.asarray(self.X), z, edges))
+        w_new = np.asarray(robust_mod.weight(
+            jnp.asarray(res), self.params.robust, self._mu))
+        own = (~self._is_shared) | (self._shared_other > self.robot_id)
+        upd = (np.asarray(edges.is_lc) > 0) & (np.asarray(edges.fixed_weight) == 0) & own
+        self._weights = np.where(upd, w_new, self._weights)
+        self._mu = float(robust_mod.gnc_update_mu(
+            jnp.asarray(self._mu), self.params.robust))
+        if not self.params.robust_opt_warm_start and self._X_init is not None:
+            self.X = self._X_init.copy()  # PGOAgent.cpp:657-662
+        # initializeAcceleration after a weight update (PGOAgent.cpp:1054-1063)
+        if self.params.acceleration:
+            self._V = self.X.copy()
+            self._gamma = 0.0
+            self._alpha = 0.0
+
+    def get_shared_weight_dict(self) -> dict:
+        """Weights of owned shared edges, keyed ((r1,p1),(r2,p2))."""
+        with self._lock:
+            out = {}
+            m = self._meas
+            for k in np.nonzero(self._is_shared &
+                                (self._shared_other > self.robot_id))[0]:
+                key = ((int(m.r1[k]), int(m.p1[k])), (int(m.r2[k]), int(m.p2[k])))
+                out[key] = float(self._weights[k])
+            return out
+
+    def update_shared_weights(self, weight_dict: dict) -> None:
+        """Receive weights for shared edges owned by a lower-id robot."""
+        with self._lock:
+            m = self._meas
+            for key, w in weight_dict.items():
+                k = self._shared_key_to_edge.get(key)
+                if k is not None and not bool(m.is_known_inlier[k]):
+                    self._weights[k] = float(w)
+
+    # -- the RBCD step ------------------------------------------------------
+
+    def _neighbor_buffer(self, aux: bool = False) -> jax.Array | None:
+        """Stack cached neighbor poses into the buffer tail; None when any
+        needed pose is missing (constructGMatrix failure -> skip update,
+        ``PGOAgent.cpp:1122-1128``)."""
+        cache = self._aux_neighbor_poses if aux else self._neighbor_poses
+        if aux:
+            # Aux poses fall back to regular ones for neighbors that have not
+            # published Y yet (first accelerated round).
+            cache = {**self._neighbor_poses, **cache}
+        s = len(self._slot_pose)
+        z = np.zeros((s, self.r, self.d + 1))
+        for slot, key in enumerate(self._slot_pose):
+            blk = cache.get(key)
+            if blk is None:
+                return None
+            z[slot] = blk
+        return jnp.asarray(z)
+
+    def iterate(self, do_optimization: bool = True) -> bool:
+        """One RBCD iteration (reference ``iterate``, ``PGOAgent.cpp:642-718``).
+
+        Returns True when an optimization step was actually taken.  With
+        acceleration, non-optimizing iterations still advance the momentum
+        bookkeeping (X <- Y), as ``updateX(false, true)`` does
+        (``PGOAgent.cpp:1094-1098``).
+        """
+        with self._lock:
+            if self._status.state != AgentState.INITIALIZED:
+                return False
+            params = self.params
+            self._status.iteration_number += 1
+            robust_on = params.robust.cost_type != RobustCostType.L2
+            if robust_on and \
+                    self._status.iteration_number % params.robust_opt_inner_iters == 0 and \
+                    (params.robust_opt_num_weight_updates <= 0 or
+                     self._num_weight_updates < params.robust_opt_num_weight_updates):
+                self._update_loop_closure_weights()
+                self._num_weight_updates += 1
+
+            accel = params.acceleration
+            restart = accel and params.restart_interval > 0 and \
+                self._status.iteration_number % params.restart_interval == 0
+            X_prev = self.X.copy()
+
+            if accel and restart:
+                # restartNesterovAcceleration (PGOAgent.cpp:1040-1052)
+                self._V = self.X.copy()
+                self._Y = self.X.copy()
+                self._gamma = 0.0
+                self._alpha = 0.0
+                accel = False
+
+            if accel:
+                N = self.num_robots
+                self._gamma = (1.0 + np.sqrt(1.0 + 4.0 * (N * self._gamma) ** 2)) \
+                    / (2.0 * N)
+                self._alpha = 1.0 / (self._gamma * N)
+                Y = np.asarray(manifold.project(jnp.asarray(
+                    (1.0 - self._alpha) * self.X + self._alpha * self._V)))
+                self._Y = Y
+                start = Y
+                z = self._neighbor_buffer(aux=True)
+            else:
+                start = self.X
+                z = self._neighbor_buffer()
+
+            stepped = False
+            if do_optimization and z is not None and self._step_fn is not None:
+                X_new, _gn = self._step_fn(jnp.asarray(start), z,
+                                           jnp.asarray(self._weights))
+                self.X = np.asarray(X_new)
+                stepped = True
+            elif accel:
+                self.X = self._Y.copy()  # updateX(false, true)
+
+            if accel:
+                self._V = np.asarray(manifold.project(jnp.asarray(
+                    self._V + self._gamma * (self.X - self._Y))))
+
+            rel = float(np.sqrt(np.sum((self.X - X_prev) ** 2) / max(self.n, 1)))
+            self._status.relative_change = rel
+            ready = stepped and rel <= params.rel_change_tol
+            if robust_on and params.robust.cost_type == RobustCostType.GNC_TLS:
+                lc = (np.asarray(self._edges.is_lc) > 0) & \
+                    (np.asarray(self._edges.fixed_weight) == 0)
+                if lc.any():
+                    conv = np.asarray(robust_mod.is_weight_converged(
+                        jnp.asarray(self._weights)))[lc]
+                    ready = ready and conv.mean() >= \
+                        params.robust_opt_min_convergence_ratio
+            self._status.ready_to_terminate = bool(ready)
+            return stepped
+
+    # -- async runtime ------------------------------------------------------
+
+    def start_optimization_loop(self, rate_hz: float = 10.0,
+                                seed: int | None = None) -> None:
+        """Spawn the Poisson-clock optimization thread
+        (``startOptimizationLoop``, ``PGOAgent.cpp:861-898``): sleep
+        ``Exp(rate)`` then ``iterate(True)`` until stopped.  Acceleration is
+        rejected in async mode as in the reference (assert ``:863``)."""
+        if self.params.acceleration:
+            raise ValueError("acceleration is not supported in async mode")
+        if self._loop_thread is not None and self._loop_thread.is_alive():
+            return
+        self._end_loop.clear()
+        rng = np.random.default_rng(self.robot_id if seed is None else seed)
+
+        def run():
+            while not self._end_loop.is_set():
+                self._end_loop.wait(float(rng.exponential(1.0 / rate_hz)))
+                if self._end_loop.is_set():
+                    break
+                self.iterate(True)
+
+        self._loop_thread = threading.Thread(
+            target=run, name=f"pgo-agent-{self.robot_id}", daemon=True)
+        self._loop_thread.start()
+
+    def end_optimization_loop(self) -> None:
+        """Stop and join (``endOptimizationLoop``, ``PGOAgent.cpp:900-916``)."""
+        if self._loop_thread is None:
+            return
+        self._end_loop.set()
+        self._loop_thread.join()
+        self._loop_thread = None
+
+    def is_optimization_running(self) -> bool:
+        return self._loop_thread is not None and self._loop_thread.is_alive()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def reset(self) -> None:
+        """Roll to the next problem instance keeping the lifting matrix
+        (``reset``, ``PGOAgent.cpp:583-640``)."""
+        # Join the loop thread BEFORE taking the lock: the thread's iterate()
+        # needs the lock, so joining under it would deadlock.
+        self.end_optimization_loop()
+        with self._lock:
+            instance = self._status.instance_number + 1
+            self._clear_problem()
+            self._status.instance_number = instance
+            self._neighbor_status.clear()
+
+    # -- diagnostics --------------------------------------------------------
+
+    def local_cost(self) -> float | None:
+        """f(X) against cached neighbor poses (None while any are missing)."""
+        with self._lock:
+            z = self._neighbor_buffer()
+            if z is None or self.X is None:
+                return None
+            buf = jnp.concatenate([jnp.asarray(self.X), z], axis=0)
+            edges = self._edges._replace(weight=jnp.asarray(self._weights))
+            return float(quadratic.cost(buf, edges))
+
+
+def _express_in_frame(T: np.ndarray, T_frame: np.ndarray) -> np.ndarray:
+    """Apply ``T_frame^-1`` to every pose of ``T`` ([n, d, d+1])."""
+    d = T.shape[1]
+    R0, t0 = T_frame[:, :d], T_frame[:, d]
+    R = np.einsum("ba,nbc->nac", R0, T[:, :, :d])
+    t = np.einsum("ba,nb->na", R0, T[:, :, d] - t0)
+    return np.concatenate([R, t[:, :, None]], axis=-1)

@@ -82,6 +82,20 @@ def partition_contiguous(meas: Measurements, num_robots: int) -> Partition:
                      global_index=global_index, meas_global=meas)
 
 
+def agent_measurements(part: Partition, robot_id: int):
+    """One robot's (odometry, private_loop_closures, shared_loop_closures),
+    robot-locally indexed — the three arguments of ``PGOAgent::setPoseGraph``
+    (reference ``PGOAgent.cpp:126``), as split by the example drivers
+    (``MultiRobotExample.cpp:92-121``)."""
+    cls = part.classify()
+    m = part.meas
+    mine = (m.r1 == robot_id) | (m.r2 == robot_id)
+    odometry = m.select(mine & (cls == 0))
+    private_lc = m.select(mine & (cls == 1))
+    shared_lc = m.select(mine & (cls == 2))
+    return odometry, private_lc, shared_lc
+
+
 def partition_by_keys(meas: Measurements) -> Partition:
     """Partition using the robot ids already encoded in the measurement keys
     (multi-robot g2o files; ``MultiRobotCSLAMComparison.cpp:75-101``).

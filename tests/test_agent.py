@@ -1,0 +1,289 @@
+"""Tests for the per-robot ``PGOAgent`` message-passing runtime.
+
+Mirrors the reference's test pyramid for the agent layer:
+``testConstruction.cpp`` (constructor invariants), ``testLineGraph.cpp`` /
+``testTriangleGraph.cpp`` (tiny-graph iterate), and
+``testOptimizationThread.cpp`` (async thread lifecycle + solve-while-running),
+plus an in-process multi-agent consensus solve playing the network the way
+``examples/MultiRobotExample.cpp`` does.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dpgo_tpu.agent import AgentState, PGOAgent, PGOAgentStatus
+from dpgo_tpu.config import AgentParams, RobustCostParams, RobustCostType
+from dpgo_tpu.utils.partition import agent_measurements, partition_contiguous
+from dpgo_tpu.utils.synthetic import make_measurements
+
+
+def make_agents(num_robots, n=12, num_lc=6, seed=0, d=3, r=5, **kw):
+    rng = np.random.default_rng(seed)
+    meas, T_true = make_measurements(rng, n=n, d=d, num_lc=num_lc,
+                                     rot_noise=0.005, trans_noise=0.005)
+    part = partition_contiguous(meas, num_robots)
+    params = AgentParams(d=d, r=r, num_robots=num_robots, **kw)
+    agents = [PGOAgent(a, params) for a in range(num_robots)]
+    # Lifting-matrix broadcast from robot 0 (MultiRobotExample.cpp:139-146).
+    for ag in agents[1:]:
+        ag.set_lifting_matrix(agents[0].get_lifting_matrix())
+    for ag in agents:
+        ag.set_pose_graph(*agent_measurements(part, ag.robot_id))
+    return agents, part, T_true
+
+
+def exchange(agents, aux=False):
+    """Play the network: all-to-all public pose push (the in-process loop of
+    MultiRobotExample.cpp:186-213)."""
+    dicts = [ag.get_shared_pose_dict() for ag in agents]
+    for src in agents:
+        for dst in agents:
+            if src is not dst:
+                dst.update_neighbor_poses(src.robot_id, dicts[src.robot_id])
+    if aux:
+        dicts = [ag.get_aux_shared_pose_dict() for ag in agents]
+        for src in agents:
+            for dst in agents:
+                if src is not dst:
+                    dst.update_aux_neighbor_poses(src.robot_id,
+                                                  dicts[src.robot_id])
+    for src in agents:
+        st = src.get_status()
+        for dst in agents:
+            if src is not dst:
+                dst.set_neighbor_status(st)
+
+
+def broadcast_anchor(agents):
+    anchor = agents[0].get_global_anchor()
+    for ag in agents:
+        ag.set_global_anchor(anchor)
+
+
+def team_error(agents, part, T_true):
+    """Max pose error of the assembled global trajectory vs ground truth
+    (gauge-aligned at pose 0)."""
+    from dpgo_tpu.utils.synthetic import trajectory_error
+
+    broadcast_anchor(agents)
+    Rs, ts = T_true
+    N, d = Rs.shape[0], Rs.shape[1]
+    T = np.zeros((N, d, d + 1))
+    for a, ag in enumerate(agents):
+        blk = ag.trajectory_in_global_frame()
+        ids = part.global_index[a][part.global_index[a] >= 0]
+        T[ids] = blk
+    return trajectory_error(T, Rs, ts)
+
+
+def test_construction():
+    params = AgentParams(d=3, r=5, num_robots=2)
+    ag = PGOAgent(0, params)
+    assert ag.get_status().state == AgentState.WAIT_FOR_DATA
+    assert ag.get_lifting_matrix().shape == (5, 3)
+    ag1 = PGOAgent(1, params)
+    with pytest.raises(AssertionError):
+        ag1.get_lifting_matrix()  # only robot 0 self-generates
+
+
+def test_single_robot_iterate_converges():
+    agents, part, T_true = make_agents(1, n=8, num_lc=4)
+    (ag,) = agents
+    assert ag.get_status().state == AgentState.INITIALIZED
+    for _ in range(10):
+        ag.iterate(True)
+    assert team_error(agents, part, T_true) < 1e-1
+
+
+def test_distributed_initialization_and_consensus_solve():
+    agents, part, T_true = make_agents(3, n=18, num_lc=12)
+    # Robots 1, 2 wait for a pose message from an initialized neighbor.
+    assert agents[0].get_status().state == AgentState.INITIALIZED
+    assert agents[1].get_status().state == AgentState.WAIT_FOR_INITIALIZATION
+
+    for it in range(120):
+        exchange(agents)
+        for ag in agents:
+            ag.iterate(True)
+        if all(ag.should_terminate() for ag in agents):
+            break
+    assert all(ag.get_status().state == AgentState.INITIALIZED
+               for ag in agents)
+    assert team_error(agents, part, T_true) < 1e-1
+
+
+def test_accelerated_solve():
+    """Accelerated sync RBCD with the reference driver's sequencing
+    (MultiRobotExample.cpp:175-217): non-selected agents iterate(false)
+    [momentum bookkeeping], aux poses are exchanged, then the selected agent
+    optimizes against the fresh aux poses."""
+    agents, part, T_true = make_agents(2, n=12, num_lc=8, acceleration=True)
+    for it in range(60):
+        sel = it % len(agents)
+        for a, ag in enumerate(agents):
+            if a != sel:
+                ag.iterate(False)
+        exchange(agents, aux=True)
+        agents[sel].iterate(True)
+    assert team_error(agents, part, T_true) < 1e-1
+
+
+def test_robust_solve_rejects_outliers():
+    rng = np.random.default_rng(3)
+    meas, T_true = make_measurements(rng, n=16, d=3, num_lc=10,
+                                     rot_noise=0.005, trans_noise=0.005,
+                                     outlier_lc=4)
+    part = partition_contiguous(meas, 2)
+    # The injected outliers are the last 4 rows; record their robot-local keys.
+    pm = part.meas
+    outlier_keys = {
+        (int(pm.r1[k]), int(pm.p1[k]), int(pm.r2[k]), int(pm.p2[k]))
+        for k in range(len(pm) - 4, len(pm))}
+    params = AgentParams(
+        d=3, r=5, num_robots=2,
+        robust=RobustCostParams(cost_type=RobustCostType.GNC_TLS),
+        robust_opt_inner_iters=10)
+    agents = [PGOAgent(a, params) for a in range(2)]
+    agents[1].set_lifting_matrix(agents[0].get_lifting_matrix())
+    for ag in agents:
+        ag.set_pose_graph(*agent_measurements(part, ag.robot_id))
+    for it in range(120):
+        exchange(agents)
+        for ag in agents:
+            ag.iterate(True)
+        # Weight ownership: lower id computes, higher id receives.
+        agents[1].update_shared_weights(agents[0].get_shared_weight_dict())
+    assert team_error(agents, part, T_true) < 2e-1
+    # GNC must have driven the injected outlier edges' weights to ~0.
+    m0 = agents[0]._meas
+    out_w = [agents[0]._weights[k] for k in range(len(m0))
+             if (int(m0.r1[k]), int(m0.p1[k]), int(m0.r2[k]), int(m0.p2[k]))
+             in outlier_keys]
+    assert out_w and max(out_w) < 0.2
+
+
+def test_weight_dict_ownership():
+    agents, part, _ = make_agents(
+        2, n=12, num_lc=8,
+        robust=RobustCostParams(cost_type=RobustCostType.GNC_TLS))
+    exchange(agents)
+    w0 = agents[0].get_shared_weight_dict()
+    w1 = agents[1].get_shared_weight_dict()
+    assert len(w0) > 0          # robot 0 owns all its shared edges (1 > 0)
+    assert len(w1) == 0         # robot 1 owns none
+    agents[1].update_shared_weights({k: 0.25 for k in w0})
+    # the received weights land on robot 1's copies of those edges
+    m = agents[1]._meas
+    got = [agents[1]._weights[k] for k in np.nonzero(agents[1]._is_shared)[0]]
+    assert np.allclose(got, 0.25)
+
+
+def test_thread_lifecycle():
+    """Start/stop cycles (testOptimizationThread.cpp:10-27)."""
+    agents, _, _ = make_agents(1, n=8, num_lc=4)
+    (ag,) = agents
+    for _ in range(3):
+        ag.start_optimization_loop(rate_hz=50.0)
+        assert ag.is_optimization_running()
+        time.sleep(0.05)
+        ag.end_optimization_loop()
+        assert not ag.is_optimization_running()
+    assert ag.get_status().iteration_number > 0
+
+
+def test_async_solve_while_running():
+    """Concurrent pose exchange while the loop runs
+    (testOptimizationThread.cpp:29-89)."""
+    agents, part, T_true = make_agents(2, n=12, num_lc=8)
+    exchange(agents)
+    for ag in agents:
+        ag.start_optimization_loop(rate_hz=100.0)
+    deadline = time.time() + 3.0
+    while time.time() < deadline:
+        exchange(agents)
+        time.sleep(0.01)
+    for ag in agents:
+        ag.end_optimization_loop()
+    assert team_error(agents, part, T_true) < 1e-1
+
+
+def test_async_rejects_acceleration():
+    agents, _, _ = make_agents(1, n=8, num_lc=4, acceleration=True)
+    with pytest.raises(ValueError):
+        agents[0].start_optimization_loop()
+
+
+def test_reset_while_loop_running_does_not_deadlock():
+    """reset() must join the loop thread without holding the agent lock."""
+    agents, _, _ = make_agents(1, n=8, num_lc=4)
+    (ag,) = agents
+    ag.start_optimization_loop(rate_hz=200.0)
+    time.sleep(0.1)
+    done = []
+
+    def do_reset():
+        ag.reset()
+        done.append(True)
+
+    t = threading.Thread(target=do_reset, daemon=True)
+    t.start()
+    t.join(timeout=10.0)
+    assert done, "reset() deadlocked against the optimization loop"
+    assert not ag.is_optimization_running()
+
+
+def test_weight_update_cap_honored():
+    """robust_opt_num_weight_updates bounds GNC updates as in the batched
+    core (models/rbcd.py)."""
+    agents, _, _ = make_agents(
+        1, n=8, num_lc=4,
+        robust=RobustCostParams(cost_type=RobustCostType.GNC_TLS),
+        robust_opt_inner_iters=2, robust_opt_num_weight_updates=3)
+    (ag,) = agents
+    for _ in range(20):
+        ag.iterate(True)
+    assert ag._num_weight_updates == 3
+
+
+def test_pose_message_before_lifting_matrix_defers():
+    """A pose message arriving before the lifting-matrix broadcast must not
+    crash; initialization happens once the matrix arrives."""
+    rng = np.random.default_rng(0)
+    meas, _ = make_measurements(rng, n=12, d=3, num_lc=8,
+                                rot_noise=0.005, trans_noise=0.005)
+    part = partition_contiguous(meas, 2)
+    params = AgentParams(d=3, r=5, num_robots=2)
+    a0 = PGOAgent(0, params)
+    a1 = PGOAgent(1, params)  # no lifting matrix yet
+    a0.set_pose_graph(*agent_measurements(part, 0))
+    a1.set_pose_graph(*agent_measurements(part, 1))
+    a1.update_neighbor_poses(0, a0.get_shared_pose_dict())  # must not raise
+    assert a1.get_status().state == AgentState.WAIT_FOR_INITIALIZATION
+    a1.set_lifting_matrix(a0.get_lifting_matrix())
+    a1.update_neighbor_poses(0, a0.get_shared_pose_dict())
+    assert a1.get_status().state == AgentState.INITIALIZED
+
+
+def test_reset_rolls_instance():
+    agents, part, _ = make_agents(1, n=8, num_lc=4)
+    (ag,) = agents
+    ylift = ag.get_lifting_matrix()
+    ag.reset()
+    st = ag.get_status()
+    assert st.state == AgentState.WAIT_FOR_DATA
+    assert st.instance_number == 1
+    # Lifting matrix survives reset (PGOAgent.cpp:605-610).
+    np.testing.assert_array_equal(ag.get_lifting_matrix(), ylift)
+    ag.set_pose_graph(*agent_measurements(part, 0))
+    assert ag.get_status().state == AgentState.INITIALIZED
+
+
+def test_missing_neighbor_poses_skips_update():
+    agents, _, _ = make_agents(2, n=12, num_lc=8)
+    ag = agents[0]
+    X_before = ag.X.copy()
+    assert not ag.iterate(True)  # no neighbor poses cached yet -> skip
+    np.testing.assert_array_equal(ag.X, X_before)
