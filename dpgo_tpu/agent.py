@@ -48,10 +48,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .config import AgentParams, ROptAlg, RobustCostType
+from .config import AgentParams, RobustCostType
 from . import robust as robust_mod
 from .types import EdgeSet, Measurements
-from .utils.lie import angular_to_chordal_so3, lifting_matrix as make_lifting_matrix
+from .utils.lie import lifting_matrix as make_lifting_matrix
 from .ops import chordal, manifold, quadratic
 from .models.rbcd import _agent_update, _edge_residuals
 from .models.dist_init import _se, _se_inv, robust_frame_alignment
@@ -117,6 +117,7 @@ class PGOAgent:
         self._edges: EdgeSet | None = None
         self._is_shared: np.ndarray | None = None   # [E] bool
         self._shared_other: np.ndarray | None = None  # [E] neighbor robot (or -1)
+        self._lc_upd: np.ndarray | None = None      # [E] LC & not known-inlier
         self._nbr_slot: dict[PoseID, int] = {}      # remote PoseID -> buffer slot
         self._slot_pose: list[PoseID] = []
         self._public: list[int] = []                # local public pose indices
@@ -160,6 +161,12 @@ class PGOAgent:
         ``:197-248``) and run local initialization in the robot's own frame.
         """
         with self._lock:
+            if self._status.state != AgentState.WAIT_FOR_DATA:
+                # The reference requires WAIT_FOR_DATA here (assert at
+                # PGOAgent.cpp:128); re-ingestion on a live agent clears the
+                # previous problem first so no stale state (X, neighbor
+                # caches, aux sequences) survives into the new graph.
+                self._clear_problem()
             me = self.robot_id
             all_meas = Measurements.concatenate(
                 [odometry, private_loop_closures, shared_loop_closures])
@@ -210,6 +217,8 @@ class PGOAgent:
             self._edges = edge_set_from_measurements(
                 all_meas, tail_index=ti, head_index=hi, is_lc=is_lc,
                 dtype=jnp.float64)
+            # Static masks hoisted out of the iterate() hot path.
+            self._lc_upd = is_lc & ~np.asarray(all_meas.is_known_inlier, bool)
             self._weights = np.asarray(all_meas.weight, np.float64).copy()
             self._mu = self.params.robust.gnc_init_mu
 
@@ -425,7 +434,7 @@ class PGOAgent:
 
     # -- GNC weights --------------------------------------------------------
 
-    def _update_loop_closure_weights(self) -> None:
+    def _update_loop_closure_weights(self) -> bool:
         """Recompute robust weights from current residuals
         (``updateLoopClosuresWeights``, ``PGOAgent.cpp:1181-1245``).
 
@@ -433,16 +442,20 @@ class PGOAgent:
         computes the weight; the other endpoint receives it via
         ``get_shared_weight_dict``/``update_shared_weights`` (the
         ``mPublishWeightsRequested`` path consumed by dpgo_ros).
+
+        Returns False (without consuming the weight-update budget or
+        annealing mu) when neighbor poses are missing so no residual can be
+        evaluated yet.
         """
         z = self._neighbor_buffer()
         if z is None:
-            return
+            return False
         edges = self._edges._replace(weight=jnp.asarray(self._weights))
         res = np.asarray(_edge_residuals(jnp.asarray(self.X), z, edges))
         w_new = np.asarray(robust_mod.weight(
             jnp.asarray(res), self.params.robust, self._mu))
         own = (~self._is_shared) | (self._shared_other > self.robot_id)
-        upd = (np.asarray(edges.is_lc) > 0) & (np.asarray(edges.fixed_weight) == 0) & own
+        upd = self._lc_upd & own
         self._weights = np.where(upd, w_new, self._weights)
         self._mu = float(robust_mod.gnc_update_mu(
             jnp.asarray(self._mu), self.params.robust))
@@ -453,10 +466,15 @@ class PGOAgent:
             self._V = self.X.copy()
             self._gamma = 0.0
             self._alpha = 0.0
+        return True
 
     def get_shared_weight_dict(self) -> dict:
-        """Weights of owned shared edges, keyed ((r1,p1),(r2,p2))."""
+        """Weights of owned shared edges, keyed ((r1,p1),(r2,p2)).
+
+        Empty before ``set_pose_graph`` (a transport may poll any time)."""
         with self._lock:
+            if self._is_shared is None:
+                return {}
             out = {}
             m = self._meas
             for k in np.nonzero(self._is_shared &
@@ -512,8 +530,8 @@ class PGOAgent:
                     self._status.iteration_number % params.robust_opt_inner_iters == 0 and \
                     (params.robust_opt_num_weight_updates <= 0 or
                      self._num_weight_updates < params.robust_opt_num_weight_updates):
-                self._update_loop_closure_weights()
-                self._num_weight_updates += 1
+                if self._update_loop_closure_weights():
+                    self._num_weight_updates += 1
 
             accel = params.acceleration
             restart = accel and params.restart_interval > 0 and \
@@ -559,11 +577,10 @@ class PGOAgent:
             self._status.relative_change = rel
             ready = stepped and rel <= params.rel_change_tol
             if robust_on and params.robust.cost_type == RobustCostType.GNC_TLS:
-                lc = (np.asarray(self._edges.is_lc) > 0) & \
-                    (np.asarray(self._edges.fixed_weight) == 0)
+                lc = self._lc_upd
                 if lc.any():
-                    conv = np.asarray(robust_mod.is_weight_converged(
-                        jnp.asarray(self._weights)))[lc]
+                    w = self._weights[lc]
+                    conv = (w < 1e-4) | (w > 1.0 - 1e-4)  # is_weight_converged
                     ready = ready and conv.mean() >= \
                         params.robust_opt_min_convergence_ratio
             self._status.ready_to_terminate = bool(ready)
