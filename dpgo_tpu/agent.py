@@ -163,10 +163,14 @@ class PGOAgent:
         with self._lock:
             if self._status.state != AgentState.WAIT_FOR_DATA:
                 # The reference requires WAIT_FOR_DATA here (assert at
-                # PGOAgent.cpp:128); re-ingestion on a live agent clears the
-                # previous problem first so no stale state (X, neighbor
-                # caches, aux sequences) survives into the new graph.
+                # PGOAgent.cpp:128); re-ingestion on a live agent rolls to a
+                # new problem instance like reset() so no stale state (X,
+                # neighbor caches, aux sequences, gossiped statuses of the
+                # previous instance) survives into the new graph.
+                instance = self._status.instance_number + 1
                 self._clear_problem()
+                self._status.instance_number = instance
+                self._neighbor_status.clear()
             me = self.robot_id
             all_meas = Measurements.concatenate(
                 [odometry, private_loop_closures, shared_loop_closures])
@@ -579,8 +583,8 @@ class PGOAgent:
             if robust_on and params.robust.cost_type == RobustCostType.GNC_TLS:
                 lc = self._lc_upd
                 if lc.any():
-                    w = self._weights[lc]
-                    conv = (w < 1e-4) | (w > 1.0 - 1e-4)  # is_weight_converged
+                    conv = np.asarray(robust_mod.is_weight_converged(
+                        self._weights[lc]))
                     ready = ready and conv.mean() >= \
                         params.robust_opt_min_convergence_ratio
             self._status.ready_to_terminate = bool(ready)

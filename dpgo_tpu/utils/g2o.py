@@ -35,8 +35,28 @@ def key_to_robot_keyframe(key):
     return robot.astype(np.int32), index.astype(np.int64)
 
 
-def read_g2o(path: str) -> Measurements:
+def read_g2o(path: str, backend: str = "auto") -> Measurements:
     """Parse a .g2o file into a ``Measurements`` batch.
+
+    ``backend``: ``"auto"`` uses the native (C++) loader when available —
+    the framework's IO layer is native like the reference's
+    (``native/g2o_parser.cpp``) — and falls back to the pure-Python parser;
+    ``"native"`` / ``"python"`` force one side (native raises when the
+    library can't be built).
+    """
+    if backend not in ("auto", "native", "python"):
+        raise ValueError(f"unknown backend {backend!r}")
+    if backend != "python":
+        from . import native_io
+        if backend == "native":
+            return native_io.read_g2o_native(path)
+        if native_io.native_available():
+            return native_io.read_g2o_native(path)
+    return read_g2o_python(path)
+
+
+def read_g2o_python(path: str) -> Measurements:
+    """Pure-Python (vectorized numpy) g2o parser — the portable fallback.
 
     Supports ``EDGE_SE2`` and ``EDGE_SE3:QUAT``; ``VERTEX_*`` lines only
     contribute to the pose count, as in the reference (which ignores vertex
@@ -74,6 +94,12 @@ def read_g2o(path: str) -> Measurements:
                     se3_rows.append(vals)
             elif tag.startswith("VERTEX"):
                 num_vertices += 1
+            elif tag == "FIX":
+                # Standard g2o gauge anchor (present in ais2klinik.g2o).  The
+                # reference would assert on it (DPGO_utils.cpp:201) but the
+                # framework fixes gauge via the global anchor, so the line is
+                # deliberately accepted and ignored.
+                continue
             else:
                 raise ValueError(f"Unrecognized g2o token: {tag!r}")
 

@@ -1,0 +1,130 @@
+"""ctypes binding to the native (C++) dataset loader.
+
+The reference's IO layer is C++ (``read_g2o_file``,
+``src/DPGO_utils.cpp:78-212``); this framework keeps IO native too —
+``native/g2o_parser.cpp`` tokenizes the file in place and returns
+struct-of-arrays buffers that become the numpy arrays of ``Measurements``
+with one copy.  The library auto-builds on first use (``make -C native``)
+and callers fall back to the pure-Python parser when no C++ toolchain is
+available (``dpgo_tpu.utils.g2o.read_g2o`` handles the dispatch).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import warnings
+
+import numpy as np
+
+from ..types import Measurements
+from .g2o import key_to_robot_keyframe
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libdpgo_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+class _DpgoG2O(ctypes.Structure):
+    _fields_ = [
+        ("d", ctypes.c_int32),
+        ("m", ctypes.c_int64),
+        ("num_vertices", ctypes.c_int64),
+        ("key1", ctypes.POINTER(ctypes.c_uint64)),
+        ("key2", ctypes.POINTER(ctypes.c_uint64)),
+        ("R", ctypes.POINTER(ctypes.c_double)),
+        ("t", ctypes.POINTER(ctypes.c_double)),
+        ("kappa", ctypes.POINTER(ctypes.c_double)),
+        ("tau", ctypes.POINTER(ctypes.c_double)),
+        ("error", ctypes.c_char * 256),
+    ]
+
+
+def _build_library() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except (subprocess.SubprocessError, OSError) as e:
+        warnings.warn(f"[native_io] build failed ({e}); "
+                      "falling back to the Python parser")
+        return False
+
+
+def load_library():
+    """The loaded native library, building it on first use; None when
+    unavailable (no toolchain / build failure) — callers must fall back."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and not _build_library():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            warnings.warn(f"[native_io] load failed ({e})")
+            _load_failed = True
+            return None
+        lib.dpgo_g2o_read.argtypes = [ctypes.c_char_p,
+                                      ctypes.POINTER(_DpgoG2O)]
+        lib.dpgo_g2o_read.restype = ctypes.c_int
+        lib.dpgo_g2o_free.argtypes = [ctypes.POINTER(_DpgoG2O)]
+        lib.dpgo_g2o_free.restype = None
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load_library() is not None
+
+
+def read_g2o_native(path: str) -> Measurements:
+    """Parse a .g2o file through the native loader.
+
+    Raises ``RuntimeError`` when the library is unavailable or the file is
+    malformed (same failure surface as the Python parser's ValueError).
+    """
+    lib = load_library()
+    if lib is None:
+        raise RuntimeError("native g2o loader unavailable")
+
+    out = _DpgoG2O()
+    rc = lib.dpgo_g2o_read(os.fspath(path).encode(), ctypes.byref(out))
+    if rc != 0:
+        err = out.error.decode(errors="replace")
+        if rc == 1:  # IO error — out buffers are empty, nothing to free
+            raise RuntimeError(f"native g2o read failed: {err}")
+        lib.dpgo_g2o_free(ctypes.byref(out))
+        raise ValueError(f"native g2o parse failed: {err}")
+
+    try:
+        m, d = int(out.m), int(out.d)
+        as_np = np.ctypeslib.as_array
+        key1 = as_np(out.key1, (m,)).copy()
+        key2 = as_np(out.key2, (m,)).copy()
+        R = as_np(out.R, (m, d, d)).copy()
+        t = as_np(out.t, (m, d)).copy()
+        kappa = as_np(out.kappa, (m,)).copy()
+        tau = as_np(out.tau, (m,)).copy()
+        num_vertices = int(out.num_vertices)
+    finally:
+        lib.dpgo_g2o_free(ctypes.byref(out))
+
+    r1, p1 = key_to_robot_keyframe(key1)
+    r2, p2 = key_to_robot_keyframe(key2)
+    num_poses = max(num_vertices, int(max(p1.max(), p2.max())) + 1)
+    return Measurements(
+        d=d, num_poses=num_poses,
+        r1=r1, p1=p1, r2=r2, p2=p2,
+        R=R, t=t, kappa=kappa, tau=tau,
+        weight=np.ones(m),
+        is_known_inlier=np.zeros(m, dtype=bool),
+    )

@@ -1,0 +1,27 @@
+"""Shared setup for the example drivers."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# Make `dpgo_tpu` importable when an example runs as a script from anywhere.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def setup_jax(force_x64_on_cpu: bool = True):
+    """Pin the JAX platform and precision for an example run.
+
+    The image's ``sitecustomize`` force-registers the TPU-tunnel platform and
+    ignores the ``JAX_PLATFORMS`` env var, so ``DPGO_PLATFORM=cpu`` is honored
+    here in code.  On a CPU-only backend float64 is enabled for tight numerics
+    (on TPU the tunnel compiler requires the default f32/f64-off config).
+    Returns the configured ``jax`` module.
+    """
+    import jax
+
+    if os.environ.get("DPGO_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["DPGO_PLATFORM"])
+    if force_x64_on_cpu and all(d.platform == "cpu" for d in jax.devices()):
+        jax.config.update("jax_enable_x64", True)
+    return jax

@@ -24,7 +24,8 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import setup_jax  # noqa: E402
 
 
 def main() -> None:
@@ -45,13 +46,9 @@ def main() -> None:
                     help="float32 state (TPU-native dtype; default float64)")
     args = ap.parse_args()
 
-    import jax
-    # The image's sitecustomize overrides JAX_PLATFORMS; pin in code instead.
-    if os.environ.get("DPGO_PLATFORM"):
-        jax.config.update("jax_platforms", os.environ["DPGO_PLATFORM"])
-    if all(d.platform == "cpu" for d in jax.devices()) and not args.f32:
-        jax.config.update("jax_enable_x64", True)
+    setup_jax(force_x64_on_cpu=not args.f32)
     import jax.numpy as jnp
+    import numpy as np
 
     from dpgo_tpu.config import AgentParams, RobustCostParams, RobustCostType, Schedule
     from dpgo_tpu.models import rbcd
@@ -74,23 +71,8 @@ def main() -> None:
             cost_type=RobustCostType.GNC_TLS if args.robust
             else RobustCostType.L2),
     )
-    if args.robust and params.acceleration:
-        # Reference demo keeps acceleration; GNC weight updates restart the
-        # aux sequences automatically (models/rbcd.py handles it).
-        pass
 
     part = partition_contiguous(meas, args.num_robots)
-    graph, meta = rbcd.build_graph(part, args.rank, dtype)
-
-    # --- Communication accounting (model of MultiRobotExample.cpp's byte
-    # counters; 8 bytes per double as in the reference's Matrix payloads).
-    BYTES = 8
-    r, d = args.rank, meas.d
-    total_bytes = 0
-    # Lifting-matrix broadcast from robot 0 (MultiRobotExample.cpp:139-146).
-    total_bytes += (args.num_robots - 1) * r * d * BYTES
-    import numpy as np
-    nbr_slots = np.asarray(jnp.sum(graph.nbr_mask, axis=1)).astype(int)  # [A]
 
     t0 = time.perf_counter()
     result = rbcd.solve_rbcd(
@@ -98,17 +80,38 @@ def main() -> None:
         grad_norm_tol=args.grad_norm_tol, dtype=dtype, part=part)
     dt = time.perf_counter() - t0
 
+    # --- Communication accounting (model of MultiRobotExample.cpp's byte
+    # counters; 8 bytes per double as in the reference's Matrix payloads).
+    # Per-robot neighbor-slot counts = distinct remote (robot, pose) pairs
+    # referenced by shared edges (host-side, from the partition alone).
+    cls = part.classify()
+    nbr_slots = np.zeros(args.num_robots, int)
+    shared = np.nonzero(cls == 2)[0]
+    m = part.meas
+    for a in range(args.num_robots):
+        remote = set()
+        for k in shared:
+            if int(m.r1[k]) == a:
+                remote.add((int(m.r2[k]), int(m.p2[k])))
+            elif int(m.r2[k]) == a:
+                remote.add((int(m.r1[k]), int(m.p1[k])))
+        nbr_slots[a] = len(remote)
+
+    BYTES = 8
+    r, d = args.rank, meas.d
     pose_msg = r * (d + 1) * BYTES  # one lifted pose block
     aux_factor = 2 if params.acceleration else 1  # aux poses Y exchanged too
-    for it in range(result.iterations):
-        if params.schedule == Schedule.GREEDY:
-            # One selected receiver per round (the reference's model).
-            recv = int(nbr_slots.max())
-        else:
-            recv = int(nbr_slots.sum())
-        total_bytes += recv * pose_msg * aux_factor
-        # Global anchor broadcast each round (MultiRobotExample.cpp:258-263).
-        total_bytes += (args.num_robots - 1) * pose_msg
+    # One selected receiver per round in the reference's greedy model; every
+    # agent receives each round under jacobi/async.
+    recv = int(nbr_slots.max()) if params.schedule == Schedule.GREEDY \
+        else int(nbr_slots.sum())
+    total_bytes = (
+        # Lifting-matrix broadcast from robot 0 (MultiRobotExample.cpp:139-146).
+        (args.num_robots - 1) * r * d * BYTES
+        + result.iterations * (
+            recv * pose_msg * aux_factor
+            # Global anchor broadcast each round (MultiRobotExample.cpp:258-263).
+            + (args.num_robots - 1) * pose_msg))
 
     for it, (f, gn) in enumerate(zip(result.cost_history,
                                      result.grad_norm_history)):

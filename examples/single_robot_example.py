@@ -16,7 +16,8 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import setup_jax  # noqa: E402
 
 
 def main() -> None:
@@ -30,13 +31,7 @@ def main() -> None:
     ap.add_argument("--log-dir", default=None)
     args = ap.parse_args()
 
-    import jax
-    # The image's sitecustomize overrides JAX_PLATFORMS; pin in code instead.
-    if os.environ.get("DPGO_PLATFORM"):
-        jax.config.update("jax_platforms", os.environ["DPGO_PLATFORM"])
-    if all(d.platform == "cpu" for d in jax.devices()):
-        jax.config.update("jax_enable_x64", True)
-    import jax.numpy as jnp
+    setup_jax()
     import numpy as np
 
     from dpgo_tpu.config import SolverParams
