@@ -8,6 +8,27 @@ an XLA-compiled ``lax.while_loop``, sparse connection-Laplacian products are
 edge-list segment-sums, and neighbor pose exchange is an ICI/DCN collective.
 """
 
+import os as _os
+
+import jax as _jax
+
+# On TPU, float32 matmuls/einsums default to bfloat16 MXU passes (~1e-2
+# relative error).  PGO is a high-accuracy optimization: chordal init,
+# Stiefel projections/retractions, and the tCG model values all sit on
+# matmuls, and bf16 error is enough to push iterates visibly off the
+# manifold (the reference runs in full float64 throughout — Eigen/ROPTLIB).
+# Full-f32 accumulation is required for the 1e-6 suboptimality targets
+# (SURVEY.md section 7, hard part #3); its MXU cost is negligible for the
+# small (r x d) pose blocks this framework multiplies.  A precision the
+# user already chose — via JAX_DEFAULT_MATMUL_PRECISION or an explicit
+# jax.config.update before this import — is left untouched;
+# DPGO_TPU_MATMUL_PRECISION in {default, float32, highest} overrides both.
+_forced = _os.environ.get("DPGO_TPU_MATMUL_PRECISION") or None  # "" = unset
+_user_set = ("JAX_DEFAULT_MATMUL_PRECISION" in _os.environ
+             or _jax.config.jax_default_matmul_precision is not None)
+if _forced is not None or not _user_set:
+    _jax.config.update("jax_default_matmul_precision", _forced or "highest")
+
 from .config import (
     AgentParams,
     RobustCostParams,

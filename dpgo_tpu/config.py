@@ -82,8 +82,9 @@ class SolverParams:
     # tCG convergence: ||r|| <= ||r0|| * min(kappa, ||r0||^theta)
     tcg_kappa: float = 0.1
     tcg_theta: float = 1.0
-    # Riemannian gradient descent stepsize (reference uses a preconditioned
-    # fixed step, QuadraticOptimizer.cpp:124-149)
+    # Riemannian gradient descent stepsize (reference gradientDescent:
+    # fixed step, preconditioning present but commented out,
+    # QuadraticOptimizer.cpp:124-149)
     rgd_stepsize: float = 1e-3
     # Tikhonov shift used when factoring the block-Jacobi preconditioner,
     # matching the reference's Q + 0.1 I CHOLMOD factorization

@@ -43,7 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import AgentParams, RobustCostType, Schedule
+from ..config import AgentParams, ROptAlg, RobustCostType, Schedule
 from .. import robust
 from ..types import EdgeSet, Measurements, edge_set_from_measurements
 from ..utils.lie import lifting_matrix as _lifting_matrix
@@ -267,13 +267,23 @@ def _agent_local_problem(z, edges, chol, n_max):
 
 
 def _agent_update(X_local, z, edges, params: AgentParams):
-    """One local RTR step for a single agent (vmapped over A).
+    """One local solver step for a single agent (vmapped over A).
 
+    Dispatches RTR vs RGD per ``params.solver.algorithm``, the reference's
+    ``QuadraticOptimizer::optimize`` branch (``QuadraticOptimizer.cpp:42-47``).
     Returns the updated block and the block gradient norm at the *starting*
     point — the greedy selection metric (``MultiRobotExample.cpp:242-256``)
-    — which the solver computes anyway.
+    — which the RTR solver computes anyway.
     """
     n_max = X_local.shape[0]
+    if params.solver.algorithm == ROptAlg.RGD:
+        # Fixed-step projected gradient + retraction, preconditioning off
+        # (reference ``gradientDescent``, QuadraticOptimizer.cpp:124-149) —
+        # no preconditioner to factor on this path.
+        buf = jnp.concatenate([X_local, z], axis=0)
+        g = manifold.rgrad(X_local, quadratic.egrad(buf, edges, n_out=n_max))
+        gn0 = manifold.norm(g)
+        return manifold.retract(X_local, -params.solver.rgd_stepsize * g), gn0
     blocks = quadratic.diag_blocks(edges, n_max + z.shape[0], n_out=n_max)
     chol = quadratic.precond_factors(blocks, params.solver.precond_shift)
     problem = _agent_local_problem(z, edges, chol, n_max)

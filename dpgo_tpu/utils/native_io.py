@@ -47,6 +47,11 @@ class _DpgoG2O(ctypes.Structure):
 
 
 def _build_library() -> bool:
+    if not os.path.isdir(_NATIVE_DIR):
+        # Installed package without the native/ source tree (pip install
+        # ships only dpgo_tpu/*): the Python parser is the supported path —
+        # fall back silently rather than warning on every import.
+        return False
     try:
         subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True,
                        capture_output=True, timeout=120)

@@ -88,14 +88,18 @@ def main() -> None:
     nbr_slots = np.zeros(args.num_robots, int)
     shared = np.nonzero(cls == 2)[0]
     m = part.meas
-    for a in range(args.num_robots):
-        remote = set()
-        for k in shared:
-            if int(m.r1[k]) == a:
-                remote.add((int(m.r2[k]), int(m.p2[k])))
-            elif int(m.r2[k]) == a:
-                remote.add((int(m.r1[k]), int(m.p1[k])))
-        nbr_slots[a] = len(remote)
+    # One vectorized pass: for each shared edge, each endpoint robot
+    # references the remote (robot, pose) pair; count distinct pairs per
+    # referencing robot.
+    if shared.size:
+        ref_robot = np.concatenate([m.r1[shared], m.r2[shared]])
+        remote = np.stack([
+            np.concatenate([m.r2[shared], m.r1[shared]]),
+            np.concatenate([m.p2[shared], m.p1[shared]]),
+        ], axis=1)
+        triples = np.unique(np.column_stack([ref_robot, remote]), axis=0)
+        robots, counts = np.unique(triples[:, 0], return_counts=True)
+        nbr_slots[robots] = counts
 
     BYTES = 8
     r, d = args.rank, meas.d

@@ -20,6 +20,7 @@
 
 #include <cctype>
 #include <cmath>
+#include <exception>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -128,11 +129,17 @@ static uint64_t* dup_vec_u64(const std::vector<uint64_t>& v) {
   return p;
 }
 
-// Returns 0 on success; on failure returns nonzero with out->error set.
-int dpgo_g2o_read(const char* path, DpgoG2O* out) {
-  memset(out, 0, sizeof(*out));
+// Body of the reader; may throw (std::bad_alloc, std::length_error from
+// vector growth) — the extern "C" entry point catches everything so no
+// exception ever crosses the ctypes boundary.
+struct FileCloser {
+  FILE* f;
+  ~FileCloser() { if (f) fclose(f); }
+};
 
-  FILE* f = fopen(path, "rb");
+static int dpgo_g2o_read_impl(const char* path, DpgoG2O* out) {
+  FileCloser fc{fopen(path, "rb")};
+  FILE* f = fc.f;
   if (!f) {
     snprintf(out->error, sizeof(out->error), "cannot open %s", path);
     return 1;
@@ -140,13 +147,17 @@ int dpgo_g2o_read(const char* path, DpgoG2O* out) {
   fseek(f, 0, SEEK_END);
   long size = ftell(f);
   fseek(f, 0, SEEK_SET);
+  // ftell is -1 on error and a bogus huge value for directories; any real
+  // .g2o dataset is far below 16 GiB.
+  if (size < 0 || size > (1L << 34)) {
+    snprintf(out->error, sizeof(out->error), "cannot read %s (not a regular file?)", path);
+    return 1;
+  }
   std::vector<char> buf(size + 1);
   if (fread(buf.data(), 1, size, f) != (size_t)size) {
-    fclose(f);
     snprintf(out->error, sizeof(out->error), "short read on %s", path);
     return 1;
   }
-  fclose(f);
   buf[size] = '\0';
 
   Parsed ps;
@@ -256,6 +267,22 @@ int dpgo_g2o_read(const char* path, DpgoG2O* out) {
   out->kappa = dup_vec(ps.kappa);
   out->tau = dup_vec(ps.tau);
   return 0;
+}
+
+// Returns 0 on success; on failure returns nonzero with out->error set.
+// Never throws: a C++ exception escaping the C ABI would terminate() the
+// embedding (Python) process.
+int dpgo_g2o_read(const char* path, DpgoG2O* out) {
+  memset(out, 0, sizeof(*out));
+  try {
+    return dpgo_g2o_read_impl(path, out);
+  } catch (const std::exception& e) {
+    snprintf(out->error, sizeof(out->error), "native parser error: %s", e.what());
+    return 3;
+  } catch (...) {
+    snprintf(out->error, sizeof(out->error), "native parser error (unknown)");
+    return 3;
+  }
 }
 
 void dpgo_g2o_free(DpgoG2O* out) {

@@ -156,3 +156,51 @@ def test_rbcd_smallgrid_vs_centralized(data_dir):
     T = np.asarray(res.T)
     assert np.allclose(T[0, :, :3], np.eye(3), atol=1e-8)
     assert np.allclose(T[0, :, 3], 0.0, atol=1e-8)
+
+
+def test_rbcd_rgd_algorithm(rng):
+    """RGD dispatch (reference QuadraticOptimizer.cpp:42-47, 124-149): the
+    fixed-step gradient schedule also makes progress on a noisy graph, just
+    slower than RTR.  Start from odometry so the init is far from optimal."""
+    from dpgo_tpu.config import ROptAlg
+    from dpgo_tpu.ops import chordal
+    from dpgo_tpu.models.local_pgo import lift
+    from dpgo_tpu.types import edge_set_from_measurements
+
+    meas, _ = make_measurements(rng, n=12, d=3, num_lc=6,
+                                rot_noise=0.05, trans_noise=0.05)
+    params = AgentParams(
+        d=3, r=5, num_robots=3, schedule=Schedule.JACOBI,
+        rel_change_tol=1e-8,
+        solver=SolverParams(algorithm=ROptAlg.RGD, rgd_stepsize=1e-4))
+    part = partition_contiguous(meas, 3)
+    graph, meta = rbcd.build_graph(part, params.r, jnp.float64)
+    edges_g = edge_set_from_measurements(part.meas_global, dtype=jnp.float64)
+    T0 = chordal.odometry_from_edges(edges_g, part.meas_global.num_poses)
+    X0 = rbcd.scatter_to_agents(
+        lift(T0, rbcd.lifting_matrix(meta, jnp.float64)), graph)
+    state = rbcd.init_state(graph, meta, X0, params=params)
+    step = lambda s, uw, rs: rbcd.rbcd_step(s, graph, meta, params,
+                                            update_weights=uw, restart=rs)
+    res = rbcd.run_rbcd(state, graph, meta, step, part, max_iters=300,
+                        grad_norm_tol=1e-2, params=params)
+    assert res.cost_history[-1] < res.cost_history[0]
+    assert res.grad_norm_history[-1] < 0.5 * res.grad_norm_history[0]
+
+
+def test_package_sets_full_matmul_precision():
+    """Importing dpgo_tpu must raise the default matmul precision: TPU f32
+    matmuls otherwise run as bf16 MXU passes (~1e-2 error), which pushes
+    iterates off the manifold (retraction stops being a no-op at zero) and
+    breaks the 1e-6 suboptimality targets.  A user-chosen precision (either
+    env var) wins instead."""
+    import os
+
+    import jax
+
+    import dpgo_tpu  # noqa: F401  (import side effect under test)
+
+    expected = (os.environ.get("DPGO_TPU_MATMUL_PRECISION")  # "" = unset
+                or os.environ.get("JAX_DEFAULT_MATMUL_PRECISION")
+                or "highest")
+    assert jax.config.jax_default_matmul_precision == expected
