@@ -90,6 +90,18 @@ class SolverParams:
     # matching the reference's Q + 0.1 I CHOLMOD factorization
     # (QuadraticProblem.cpp:31-42)
     precond_shift: float = 0.1
+    # Run the truncated-CG subproblem as the single VMEM-resident Pallas
+    # kernel (``ops.pallas_tcg``).  None = auto: on when the backend is TPU
+    # and the graph carries the kernel's selection matrices; True forces it
+    # (interpreter mode off-TPU — slow, for testing); False disables.
+    pallas_tcg: bool | None = None
+    # Materialize each agent's buffer connection Laplacian and run
+    # cost/gradient/Hessian as dense matmuls (``quadratic.dense_q``).
+    # Opt-in: the dense products are HBM-bandwidth-bound reading the
+    # (mostly zero) [K, K] matrix and measure ~4x slower than the ELL edge
+    # path on sphere2500/8 on TPU v5e; the formulation is kept for parity
+    # testing and for parts with denser connectivity.
+    dense_quadratic: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

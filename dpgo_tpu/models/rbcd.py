@@ -78,6 +78,18 @@ class MultiAgentGraph(NamedTuple):
     nbr_pub: jax.Array  # [A, S_max] slot into that robot's public row
     nbr_mask: jax.Array  # [A, S_max]
     global_index: jax.Array  # [A, n_max] local -> global pose id (0 for pad)
+    # ELL incidence of local poses (gather-only gradient/Hessian path,
+    # ``ops.quadratic.egrad_ell``): slot e = endpoint i of edge e, slot
+    # E_max + e = endpoint j.  K = max local pose degree over the partition.
+    inc_slot: jax.Array  # [A, n_max, K] into the [gi | gj] concatenation
+    inc_mask: jax.Array  # [A, n_max, K]
+    # One-hot local-endpoint selection matrices + component-major edge data
+    # for the Pallas VMEM tCG kernel (``ops.pallas_tcg``); None when the
+    # selection matrices exceed the memory budget.
+    sel_i: jax.Array | None = None  # [A, E_max, n_max] f32 0/1
+    sel_j: jax.Array | None = None  # [A, E_max, n_max]
+    rot_c: jax.Array | None = None  # [A, d*d, E_max]
+    trn_c: jax.Array | None = None  # [A, d, E_max]
 
 
 class RBCDState(NamedTuple):
@@ -100,9 +112,22 @@ class RBCDState(NamedTuple):
     # Initial guess, kept only when the robust warm start is disabled: the
     # iterate resets to it on every weight update (PGOAgent.cpp:657-662).
     X_init: jax.Array | None  # [A, n_max, r, d+1] or None
+    # Block-Jacobi preconditioner factors [A, n_max, d+1, d+1].  Q's diagonal
+    # blocks depend only on the GNC weights, so the factorization is carried
+    # across rounds and refreshed only on weight-update rounds — the same
+    # schedule as the reference's CHOLMOD refactorization
+    # (constructQMatrix inside updateX only in robust mode,
+    # PGOAgent.cpp:1110-1112; QuadraticProblem::setQ factorizes, cpp:37-41).
+    chol: jax.Array | None = None
+    # Materialized per-agent connection Laplacian over the pose buffer,
+    # [A, (d+1)(n_max+s_max), (d+1)(n_max+s_max)] (``quadratic.dense_q``) —
+    # the dense-Q fast path; None when the buffers are too large to
+    # materialize (``use_dense_q``).  Same refresh schedule as ``chol``.
+    Qbuf: jax.Array | None = None
 
 
-def build_graph(part: Partition, rank: int, dtype=jnp.float32):
+def build_graph(part: Partition, rank: int, dtype=jnp.float32,
+                pallas_sel: bool | None = None):
     """Assemble padded per-agent arrays from a partitioned measurement set.
 
     Each shared measurement appears in both endpoint agents' edge lists with
@@ -186,6 +211,51 @@ def build_graph(part: Partition, rank: int, dtype=jnp.float32):
             nbr_pub[a, slot] = pub[b][q]
             nbr_mask[a, slot] = 1.0
 
+    # ELL incidence of local poses: which [gi | gj] slots accumulate into
+    # each pose (slot = edge index for endpoint i, e_max + edge index for
+    # endpoint j).  Pose-graph degree is small (~4-12), so K stays tiny.
+    inc: list[list[list[int]]] = [[[] for _ in range(n_max)] for _ in range(A)]
+    for a in range(A):
+        for idx, (i, j, _k) in enumerate(edge_rows[a]):
+            if i < n_max:
+                inc[a][i].append(idx)
+            if j < n_max:
+                inc[a][j].append(e_max + idx)
+    k_max = max(1, max((len(s) for rows in inc for s in rows), default=1))
+    inc_slot = np.zeros((A, n_max, k_max), np.int32)
+    inc_mask = np.zeros((A, n_max, k_max))
+    for a in range(A):
+        for v in range(n_max):
+            for c, slot in enumerate(inc[a][v]):
+                inc_slot[a, v, c] = slot
+                inc_mask[a, v, c] = 1.0
+
+    # One-hot selection matrices for the Pallas tCG kernel, bounded to a
+    # memory budget ([A, E, n] f32 x 2; beyond it the kernel is skipped and
+    # the XLA ELL path runs).  Skipped entirely (pallas_sel=None -> auto)
+    # off-TPU, where the kernel would only ever run in interpreter mode —
+    # force with pallas_sel=True for interpreter-mode testing.
+    if pallas_sel is None:
+        pallas_sel = jax.default_backend() == "tpu"
+    SEL_BUDGET_BYTES = 256 << 20
+    if pallas_sel and 2 * A * e_max * n_max * 4 <= SEL_BUDGET_BYTES:
+        sel_i = np.zeros((A, e_max, n_max), np.float32)
+        sel_j = np.zeros((A, e_max, n_max), np.float32)
+        for a in range(A):
+            for idx, (i, j, _k) in enumerate(edge_rows[a]):
+                if i < n_max:
+                    sel_i[a, idx, i] = 1.0
+                if j < n_max:
+                    sel_j[a, idx, j] = 1.0
+        rot_c = np.ascontiguousarray(
+            eR.transpose(0, 2, 3, 1).reshape(A, d * d, e_max))
+        trn_c = np.ascontiguousarray(et.transpose(0, 2, 1))
+        pallas_fields = dict(
+            sel_i=jnp.asarray(sel_i), sel_j=jnp.asarray(sel_j),
+            rot_c=jnp.asarray(rot_c, dtype), trn_c=jnp.asarray(trn_c, dtype))
+    else:
+        pallas_fields = dict(sel_i=None, sel_j=None, rot_c=None, trn_c=None)
+
     pose_mask = (np.arange(n_max)[None, :] < part.n[:, None]).astype(np.float64)
 
     edges = EdgeSet(
@@ -206,6 +276,9 @@ def build_graph(part: Partition, rank: int, dtype=jnp.float32):
         nbr_pub=jnp.asarray(nbr_pub),
         nbr_mask=jnp.asarray(nbr_mask, dtype),
         global_index=jnp.asarray(np.maximum(part.global_index, 0), jnp.int32),
+        inc_slot=jnp.asarray(inc_slot),
+        inc_mask=jnp.asarray(inc_mask, dtype),
+        **pallas_fields,
     )
     meta = GraphMeta(num_robots=A, n_max=n_max, e_max=e_max, s_max=s_max,
                      p_max=p_max, d=d, rank=rank)
@@ -251,13 +324,61 @@ def neighbor_buffer(Xpub: jax.Array, graph: MultiAgentGraph) -> jax.Array:
 # The jitted step
 # ---------------------------------------------------------------------------
 
-def _agent_local_problem(z, edges, chol, n_max):
-    """Solver closures for one agent given fixed neighbor buffer z."""
+def _agent_local_problem(z, edges, chol, n_max, inc=None, qbuf=None):
+    """Solver closures for one agent given fixed neighbor buffer z.
+
+    Three gradient/Hessian formulations, fastest applicable first:
+
+    * ``qbuf`` (materialized dense connection Laplacian over the pose
+      buffer, ``ops.quadratic.dense_q``): cost/gradient/Hessian-vector are
+      single MXU matmuls against precomputed ``Q`` and the per-round linear
+      term ``G = Z Q_nl`` — the reference's own ``f = 0.5 <Q, X^T X> +
+      <X, G>`` form (``QuadraticProblem.cpp:50-73``), dense on TPU.  The
+      RBCD default while per-agent buffers stay small enough to materialize.
+    * ``inc = (inc_slot, inc_mask)``: gather-only ELL edge path
+      (``ops.quadratic.egrad_ell``) — O(E) memory, any problem size.
+    * neither: scatter-add edge path (single-agent fallback).
+    """
 
     def buf(Xl):
         return jnp.concatenate([Xl, z], axis=0)
 
     n_buf = n_max + z.shape[0]
+    if qbuf is not None:
+        k = z.shape[-1]  # d + 1
+        nl = n_max * k
+        Qll = qbuf[:nl, :nl]
+        Qnl = qbuf[nl:, :nl]
+        Qnn = qbuf[nl:, nl:]
+        Zm = quadratic.to_mat(z)
+        G = Zm @ Qnl                       # [r, (d+1) n_max], fixed per round
+        const = 0.5 * jnp.sum((Zm @ Qnn) * Zm)
+
+        def cost_d(Xl):
+            Xm = quadratic.to_mat(Xl)
+            return 0.5 * jnp.sum((Xm @ Qll) * Xm) + jnp.sum(Xm * G) + const
+
+        def egrad_d(Xl):
+            Xm = quadratic.to_mat(Xl)
+            return quadratic.from_mat(Xm @ Qll + G, n_max)
+
+        def ehess_d(Xl, V):
+            return quadratic.from_mat(quadratic.to_mat(V) @ Qll, n_max)
+
+        return solver.Problem(
+            cost=cost_d, egrad=egrad_d, ehess=ehess_d,
+            precond=lambda Xl, V: quadratic.precond_apply(chol, V),
+        )
+    if inc is not None:
+        inc_slot, inc_mask = inc
+        return solver.Problem(
+            cost=lambda Xl: quadratic.cost(buf(Xl), edges),
+            egrad=lambda Xl: quadratic.egrad_ell(buf(Xl), edges,
+                                                 inc_slot, inc_mask),
+            ehess=lambda Xl, V: quadratic.hessvec_ell(V, edges, inc_slot,
+                                                      inc_mask, n_buf=n_buf),
+            precond=lambda Xl, V: quadratic.precond_apply(chol, V),
+        )
     return solver.Problem(
         cost=lambda Xl: quadratic.cost(buf(Xl), edges),
         egrad=lambda Xl: quadratic.egrad(buf(Xl), edges, n_out=n_max),
@@ -266,11 +387,97 @@ def _agent_local_problem(z, edges, chol, n_max):
     )
 
 
-def _agent_update(X_local, z, edges, params: AgentParams):
+def precond_chol(graph_edges: EdgeSet, n_max: int, s_max: int,
+                 params: AgentParams) -> jax.Array:
+    """Block-Jacobi preconditioner factors for all agents [A, n_max, k, k]."""
+
+    def one(e):
+        blocks = quadratic.diag_blocks(e, n_max + s_max, n_out=n_max)
+        return quadratic.precond_factors(blocks, params.solver.precond_shift)
+
+    return jax.vmap(one)(graph_edges)
+
+
+#: Dense-Q memory budget: the [A, K, K] buffer Laplacians (K = (d+1)
+#: (n_max + s_max)) must fit comfortably beside the rest of the problem.
+#: 1 GiB covers sphere2500/8 (51 MB f32) through city10000/8 (~900 MB f32
+#: at the margin).
+DENSE_Q_BUDGET_BYTES = 1 << 30
+
+
+def use_dense_q(meta: GraphMeta, params: AgentParams | None = None,
+                itemsize: int = 4) -> bool:
+    """Whether the (opt-in) materialized dense-Q formulation applies:
+    requested via ``SolverParams.dense_quadratic`` and within the memory
+    budget at the problem's actual ``itemsize`` (8 for float64 graphs)."""
+    if params is None or not params.solver.dense_quadratic:
+        return False
+    K = (meta.d + 1) * (meta.n_max + meta.s_max)
+    return meta.num_robots * K * K * itemsize <= DENSE_Q_BUDGET_BYTES
+
+
+#: Per-agent VMEM the Pallas tCG kernel may stage (selection matrices +
+#: loop vectors must fit beside double-buffering headroom on a ~16 MiB
+#: VMEM core).
+PALLAS_TCG_VMEM_BUDGET_BYTES = 10 << 20
+
+
+def _pallas_vmem_ok(meta: GraphMeta) -> bool:
+    """Estimate of the kernel's per-agent VMEM: the two [E, n] selection
+    matrices dominate; edge components and ~12 [r(d+1), n] loop vectors
+    ride along."""
+    rk = meta.rank * (meta.d + 1)
+    sel = 2 * meta.e_max * meta.n_max
+    vecs = 12 * rk * meta.n_max + (2 * meta.d * meta.d + 4) * meta.e_max
+    return (sel + vecs) * 4 <= PALLAS_TCG_VMEM_BUDGET_BYTES
+
+
+def _formulation(meta: GraphMeta, params: AgentParams | None, graph,
+                 itemsize: int = 4) -> str:
+    """Resolve which tCG/problem formulation a round will run, in priority
+    order: explicitly forced Pallas kernel, explicit dense-Q opt-in, Pallas
+    auto (TPU backend), ELL edge path.  Shared by ``init_state`` (which
+    materializes Qbuf only when "dense" wins — never wasted) and
+    ``_rbcd_round`` dispatch."""
+    if params is None:
+        return "ell"
+    rtr = params.solver.algorithm == ROptAlg.RTR
+    pallas_ok = rtr and graph.sel_i is not None and _pallas_vmem_ok(meta)
+    if params.solver.pallas_tcg is True:
+        if not pallas_ok:
+            # An explicit force that cannot be honored must not silently
+            # downgrade — the caller believes the kernel is being covered.
+            reason = "algorithm is not RTR" if not rtr else (
+                "the graph was built without selection matrices "
+                "(build_graph(pallas_sel=True))" if graph.sel_i is None
+                else "the per-agent problem exceeds the kernel's VMEM budget")
+            raise ValueError(f"pallas_tcg=True cannot run: {reason}")
+        return "pallas"
+    if rtr and use_dense_q(meta, params, itemsize):
+        return "dense"
+    if params.solver.pallas_tcg is None and pallas_ok \
+            and jax.default_backend() == "tpu":
+        return "pallas"
+    return "ell"
+
+
+def dense_q_all(graph_edges: EdgeSet, meta: GraphMeta) -> jax.Array:
+    """Buffer Laplacians for all agents [A, K, K] (``quadratic.dense_q``)."""
+    return jax.vmap(lambda e: quadratic.dense_q(e, meta.n_max + meta.s_max))(
+        graph_edges)
+
+
+def _agent_update(X_local, z, edges, params: AgentParams, chol=None, inc=None,
+                  qbuf=None, pallas=None):
     """One local solver step for a single agent (vmapped over A).
 
     Dispatches RTR vs RGD per ``params.solver.algorithm``, the reference's
     ``QuadraticOptimizer::optimize`` branch (``QuadraticOptimizer.cpp:42-47``).
+    ``chol`` carries precomputed preconditioner factors (recomputed here when
+    omitted — the single-shot path of ``agent.PGOAgent``); ``inc``/``qbuf``
+    select the ELL / dense-Q problem formulations (``_agent_local_problem``);
+    ``pallas = (sel_i, sel_j, rot_c, trn_c, interpret)`` swaps the tCG
+    subproblem for the VMEM Pallas kernel (``ops.pallas_tcg``).
     Returns the updated block and the block gradient norm at the *starting*
     point — the greedy selection metric (``MultiRobotExample.cpp:242-256``)
     — which the RTR solver computes anyway.
@@ -284,10 +491,43 @@ def _agent_update(X_local, z, edges, params: AgentParams):
         g = manifold.rgrad(X_local, quadratic.egrad(buf, edges, n_out=n_max))
         gn0 = manifold.norm(g)
         return manifold.retract(X_local, -params.solver.rgd_stepsize * g), gn0
-    blocks = quadratic.diag_blocks(edges, n_max + z.shape[0], n_out=n_max)
-    chol = quadratic.precond_factors(blocks, params.solver.precond_shift)
-    problem = _agent_local_problem(z, edges, chol, n_max)
-    out = solver.rtr_single_step(problem, X_local, params.solver)
+    if chol is None:
+        blocks = quadratic.diag_blocks(edges, n_max + z.shape[0], n_out=n_max)
+        chol = quadratic.precond_factors(blocks, params.solver.precond_shift)
+    problem = _agent_local_problem(z, edges, chol, n_max, inc=inc, qbuf=qbuf)
+    tcg_fn = None
+    if pallas is not None:
+        from ..ops import pallas_tcg as ptcg
+
+        sel_i, sel_j, rot_c, trn_c, interpret = pallas
+        d = trn_c.shape[0]
+        k = d + 1
+        r = X_local.shape[-2]
+        w = edges.mask * edges.weight
+        wk = (w * edges.kappa).astype(jnp.float32)[None]
+        wt = (w * edges.tau).astype(jnp.float32)[None]
+        Lc = chol.transpose(1, 2, 0).reshape(k * k, n_max)
+
+        def tcg_fn(Xl, g, eg, radius):
+            Y, GY = Xl[..., :d], eg[..., :d]
+            M = jnp.einsum("nab,nac->nbc", Y, GY)
+            S = 0.5 * (M + jnp.swapaxes(M, -1, -2))
+            Sc = S.transpose(1, 2, 0).reshape(d * d, n_max)
+            eta_c, heta_c, stats = ptcg.tcg_call(
+                sel_i, sel_j, rot_c, trn_c, wk, wt,
+                ptcg.comp_major(Xl.astype(jnp.float32)), Sc.astype(jnp.float32),
+                Lc.astype(jnp.float32), ptcg.comp_major(g.astype(jnp.float32)),
+                jnp.reshape(radius, (1, 1)).astype(jnp.float32),
+                r=r, d=d, max_iters=params.solver.max_inner_iters,
+                kappa=params.solver.tcg_kappa, theta=params.solver.tcg_theta,
+                interpret=interpret)
+            return solver.TCGResult(
+                eta=ptcg.comp_minor(eta_c, r, k).astype(Xl.dtype),
+                heta=ptcg.comp_minor(heta_c, r, k).astype(Xl.dtype),
+                iters=stats[0, 0].astype(jnp.int32),
+                hit_boundary=stats[0, 1] > 0)
+
+    out = solver.rtr_single_step(problem, X_local, params.solver, tcg_fn)
     return out.X, out.grad_norm_init
 
 
@@ -401,6 +641,8 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
 
     # --- GNC weight update (before the pose update, reference iterate()
     # PGOAgent.cpp:654-668) ---
+    chol = state.chol
+    qbuf = state.Qbuf
     if update_weights:
         edges_r = graph.edges._replace(weight=weights)
         weights = _gnc_update_weights(X, Z, edges_r, mu, params)
@@ -416,6 +658,18 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
             gamma = jnp.zeros_like(gamma)
             alpha = jnp.zeros_like(alpha)
     edges = graph.edges._replace(weight=weights)
+    if update_weights:
+        # Reweighted Q -> refactor the block-Jacobi preconditioner (and the
+        # materialized dense Q), the reference's constructQMatrix + CHOLMOD
+        # refactorization schedule (PGOAgent.cpp:1110-1112).
+        chol = precond_chol(edges, meta.n_max, meta.s_max, params)
+        if qbuf is not None:
+            qbuf = dense_q_all(edges, meta)
+    elif chol is None:
+        # State built without solver params (init_state(params=None)):
+        # factor from the live edge weights and THIS round's solver config
+        # so a custom precond_shift is always honored.
+        chol = precond_chol(edges, meta.n_max, meta.s_max, params)
 
     # --- Acceleration bookkeeping (PGOAgent.cpp:1065-1091) ---
     if accel and not restart:
@@ -428,8 +682,28 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
     else:
         start, Zuse = X, Z
 
-    X_upd, gn0 = jax.vmap(lambda x, z, e: _agent_update(x, z, e, params))(
-        start, Zuse, edges)
+    # tCG formulation resolution (see ``_formulation``): forced Pallas >
+    # explicit dense-Q > Pallas auto (TPU) > ELL edge path.
+    form = _formulation(meta, params, graph, itemsize=X.dtype.itemsize)
+    if form == "pallas":
+        interp = jax.default_backend() != "tpu"
+        # inc rides along so the outer cost/egrad/acceptance evaluations use
+        # the gather-only ELL path; only the tCG subproblem hits the kernel.
+        X_upd, gn0 = jax.vmap(
+            lambda x, z, e, c, s, m, si, sj, rc, tc: _agent_update(
+                x, z, e, params, c, inc=(s, m),
+                pallas=(si, sj, rc, tc, interp)))(
+            start, Zuse, edges, chol, graph.inc_slot, graph.inc_mask,
+            graph.sel_i, graph.sel_j, graph.rot_c, graph.trn_c)
+    elif form == "dense" and qbuf is not None:
+        X_upd, gn0 = jax.vmap(
+            lambda x, z, e, c, q: _agent_update(x, z, e, params, c, qbuf=q))(
+            start, Zuse, edges, chol, qbuf)
+    else:
+        X_upd, gn0 = jax.vmap(
+            lambda x, z, e, c, s, m: _agent_update(x, z, e, params, c,
+                                                   inc=(s, m)))(
+            start, Zuse, edges, chol, graph.inc_slot, graph.inc_mask)
 
     schedule = params.schedule
     split = jax.vmap(lambda k: jax.random.split(k, 2))(state.key)  # [A, 2, 2]
@@ -478,7 +752,7 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
                      iteration=state.iteration + 1, key=key,
                      rel_change=rel, ready=ready,
                      V=V, gamma=gamma, alpha=alpha, mu=mu,
-                     X_init=state.X_init)
+                     X_init=state.X_init, chol=chol, Qbuf=qbuf)
 
 
 #: Jitted RBCD round. Single-device over all agents with the default
@@ -497,6 +771,15 @@ def init_state(graph: MultiAgentGraph, meta: GraphMeta, X0: jax.Array,
     dtype = X0.dtype
     accel = params is not None and params.acceleration
     mu0 = params.robust.gnc_init_mu if params is not None else 1e-4
+    # Preconditioner factors are baked only when the solver params are
+    # known; otherwise the round factors from its live params (the shift
+    # must match what the solver was configured with).
+    chol0 = precond_chol(graph.edges, meta.n_max, meta.s_max, params) \
+        if params is not None else None
+    qbuf0 = dense_q_all(graph.edges, meta) \
+        if _formulation(meta, params, graph,
+                        itemsize=jnp.dtype(dtype).itemsize) == "dense" \
+        else None
     return RBCDState(
         X=X0,
         weights=graph.edges.weight,
@@ -511,7 +794,25 @@ def init_state(graph: MultiAgentGraph, meta: GraphMeta, X0: jax.Array,
         X_init=X0 if (params is not None
                       and params.robust.cost_type != RobustCostType.L2
                       and not params.robust_opt_warm_start) else None,
+        chol=chol0,
+        Qbuf=qbuf0,
     )
+
+
+def refresh_problem(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
+                    params: AgentParams) -> RBCDState:
+    """Recompute the carried problem factors (preconditioner Cholesky, and
+    the dense Q when that formulation is active) from ``state.weights``.
+
+    Required after setting weights externally — e.g. resuming a mid-GNC
+    solve from a checkpoint via ``state._replace(weights=...)`` — because
+    ``_rbcd_round`` otherwise refreshes them only on weight-update rounds
+    and would optimize against the stale (unweighted) problem until the
+    next GNC update fires."""
+    edges = graph.edges._replace(weight=state.weights)
+    chol = precond_chol(edges, meta.n_max, meta.s_max, params)
+    qbuf = dense_q_all(edges, meta) if state.Qbuf is not None else None
+    return state._replace(chol=chol, Qbuf=qbuf)
 
 
 def centralized_chordal_init(part: Partition, meta: GraphMeta, graph: MultiAgentGraph,

@@ -72,21 +72,56 @@ def egrad(Xbuf: jax.Array, edges: EdgeSet, n_out: int | None = None) -> jax.Arra
     """
     N = Xbuf.shape[0]
     dtype = Xbuf.dtype
+    # d/d X_j = [ wk * rR | wt * rt ];
+    # d/d X_i = [ -wk * rR R^T - wt * outer(rt, t) | -wt * rt ].
+    gi, gj = _edge_grad_terms(Xbuf, edges)
+    out = jnp.zeros((N,) + Xbuf.shape[1:], dtype)
+    out = out.at[edges.i].add(gi).at[edges.j].add(gj)
+    return out if n_out is None else out[:n_out]
+
+
+def _edge_grad_terms(Xbuf: jax.Array, edges: EdgeSet):
+    """Per-edge gradient contributions (gi to endpoint i, gj to endpoint j),
+    each [E, r, d+1] — the shared core of the scatter and gather paths."""
     rR, rt = _edge_terms(Xbuf, edges)
     w = edges.mask * edges.weight
     wk = (w * edges.kappa)[:, None, None]
     wt = (w * edges.tau)[:, None]
-
-    # d/d X_j: [ wk * rR | wt * rt ]
     gj = jnp.concatenate([wk * rR, (wt * rt)[..., None]], axis=-1)
-    # d/d X_i: [ -wk * rR R^T - wt * outer(rt, t) | -wt * rt ]
     giY = -(wk * rR) @ jnp.swapaxes(edges.R, -1, -2) \
         - (wt * rt)[..., None] * edges.t[:, None, :]
     gi = jnp.concatenate([giY, -(wt * rt)[..., None]], axis=-1)
+    return gi, gj
 
-    out = jnp.zeros((N,) + Xbuf.shape[1:], dtype)
-    out = out.at[edges.i].add(gi).at[edges.j].add(gj)
-    return out if n_out is None else out[:n_out]
+
+def egrad_ell(Xbuf: jax.Array, edges: EdgeSet, inc_slot: jax.Array,
+              inc_mask: jax.Array) -> jax.Array:
+    """Euclidean gradient via a padded per-pose incidence list (ELL layout):
+    gather-only, no scatter.
+
+    ``inc_slot: [n_out, K]`` indexes into the concatenation ``[gi | gj]``
+    (slot ``e`` for edges where the pose is endpoint i, ``E + e`` where it
+    is endpoint j); ``inc_mask: [n_out, K]`` zeroes padding.  Pose-graph
+    degrees are small and near-uniform (4-12 across the benchmark suite),
+    so the ELL padding waste is bounded while the summation becomes a dense
+    gather + masked reduction — on TPU this beats XLA's scatter-add
+    lowering of the ``egrad`` path, and it is the layout the tCG
+    Hessian-vector hot loop runs on.
+    """
+    gi, gj = _edge_grad_terms(Xbuf, edges)
+    g_both = jnp.concatenate([gi, gj], axis=0)  # [2E, r, d+1]
+    contrib = g_both[inc_slot]                  # [n_out, K, r, d+1]
+    return jnp.sum(contrib * inc_mask[:, :, None, None], axis=1)
+
+
+def hessvec_ell(Vlocal: jax.Array, edges: EdgeSet, inc_slot: jax.Array,
+                inc_mask: jax.Array, n_buf: int) -> jax.Array:
+    """Hessian-vector product on the ELL layout (see ``egrad_ell``);
+    the same linear map with neighbor slots zeroed."""
+    pad = jnp.zeros((n_buf - Vlocal.shape[0],) + Vlocal.shape[1:],
+                    Vlocal.dtype)
+    Vbuf = jnp.concatenate([Vlocal, pad], axis=0)
+    return egrad_ell(Vbuf, edges, inc_slot, inc_mask)
 
 
 def hessvec(Vlocal: jax.Array, edges: EdgeSet, n_buf: int) -> jax.Array:
@@ -99,6 +134,76 @@ def hessvec(Vlocal: jax.Array, edges: EdgeSet, n_buf: int) -> jax.Array:
     pad = jnp.zeros((n_buf - n_local,) + Vlocal.shape[1:], Vlocal.dtype)
     Vbuf = jnp.concatenate([Vlocal, pad], axis=0)
     return egrad(Vbuf, edges, n_out=n_local)
+
+
+def dense_q(edges: EdgeSet, n_buf: int) -> jax.Array:
+    """Materialized connection Laplacian Q over the pose buffer,
+    [(d+1) n_buf, (d+1) n_buf], pose-block-major.
+
+    The reference assembles exactly this sparse matrix
+    (``constructConnectionLaplacianSE``, ``DPGO_utils.cpp:214-286``;
+    shared-edge diagonal blocks, ``PGOAgent.cpp:744-777``) for Eigen sparse
+    products.  On TPU, for per-agent problems (a few hundred to a few
+    thousand poses) the *dense* form is the fast path: the tCG
+    Hessian-vector product becomes a single [r, (d+1)n] x [(d+1)n, (d+1)n]
+    MXU matmul instead of a latency-bound gather/compute/reduce chain.
+    Built by scatter-add once at setup and on GNC weight updates — never in
+    the solver loop.
+
+    Per SE(d) edge e = (i -> j) with T = [R_e | t_e] embedded as the
+    (d+1) x (d+1) block [[R, t], [0, 1]] and Omega = diag(w kappa I_d,
+    w tau):
+
+        Q[ii] += T Omega T^T   Q[ij] -= T Omega
+        Q[ji] -= Omega T^T     Q[jj] += Omega
+    """
+    E, d = edges.t.shape
+    dtype = edges.t.dtype
+    k = d + 1
+    w = edges.mask * edges.weight
+    wk = w * edges.kappa
+    wt = w * edges.tau
+
+    # T Omega = [[wk R, wt t], [0, wt]]  (k x k per edge)
+    TOm = jnp.zeros((E, k, k), dtype)
+    TOm = TOm.at[:, :d, :d].set(wk[:, None, None] * edges.R)
+    TOm = TOm.at[:, :d, d].set(wt[:, None] * edges.t)
+    TOm = TOm.at[:, d, d].set(wt)
+    # T Omega T^T = [[wk I + wt t t^T, wt t], [wt t^T, wt]]
+    Bii = jnp.zeros((E, k, k), dtype)
+    Bii = Bii.at[:, :d, :d].set(
+        wk[:, None, None] * jnp.eye(d, dtype=dtype)
+        + wt[:, None, None] * edges.t[:, :, None] * edges.t[:, None, :])
+    Bii = Bii.at[:, :d, d].set(wt[:, None] * edges.t)
+    Bii = Bii.at[:, d, :d].set(wt[:, None] * edges.t)
+    Bii = Bii.at[:, d, d].set(wt)
+    # Omega
+    om_diag = jnp.concatenate([jnp.tile(wk[:, None], (1, d)), wt[:, None]],
+                              axis=-1)
+    Bjj = om_diag[:, :, None] * jnp.eye(k, dtype=dtype)
+
+    Q = jnp.zeros((n_buf, k, n_buf, k), dtype)
+    Q = Q.at[edges.i, :, edges.i, :].add(Bii)
+    Q = Q.at[edges.i, :, edges.j, :].add(-TOm)
+    Q = Q.at[edges.j, :, edges.i, :].add(-jnp.swapaxes(TOm, -1, -2))
+    Q = Q.at[edges.j, :, edges.j, :].add(Bjj)
+    return Q.reshape(n_buf * k, n_buf * k)
+
+
+def to_mat(X: jax.Array) -> jax.Array:
+    """Pose blocks [..., n, r, d+1] -> stacked matrix [..., r, (d+1) n]
+    (the reference's trajectory layout, ``PGOAgent.h:222``)."""
+    n, r, k = X.shape[-3:]
+    Xt = jnp.swapaxes(X, -3, -2)  # [..., r, n, d+1]
+    return Xt.reshape(X.shape[:-3] + (r, n * k))
+
+
+def from_mat(Xm: jax.Array, n: int) -> jax.Array:
+    """Inverse of ``to_mat``: [..., r, (d+1) n] -> [..., n, r, d+1]."""
+    r = Xm.shape[-2]
+    k = Xm.shape[-1] // n
+    Xt = Xm.reshape(Xm.shape[:-2] + (r, n, k))
+    return jnp.swapaxes(Xt, -3, -2)
 
 
 def diag_blocks(edges: EdgeSet, n_buf: int, n_out: int | None = None) -> jax.Array:
@@ -144,17 +249,25 @@ def precond_factors(blocks: jax.Array, shift: float) -> jax.Array:
 
     The shift mirrors the reference's regularized factorization of
     Q + 0.1 I (``QuadraticProblem.cpp:37-42``) and guarantees SPD blocks.
+    Unrolled fixed-size Cholesky (``ops.smallmat``): XLA's generic batched
+    ``jnp.linalg.cholesky`` on [n, 4, 4] blocks is loop-lowered on TPU and
+    profiled ~100x slower than the scalar-unrolled form.
     """
+    from .smallmat import cholesky_small
+
     dh = blocks.shape[-1]
-    return jnp.linalg.cholesky(blocks + shift * jnp.eye(dh, dtype=blocks.dtype))
+    return cholesky_small(blocks + shift * jnp.eye(dh, dtype=blocks.dtype))
 
 
 def precond_apply(chol: jax.Array, V: jax.Array) -> jax.Array:
     """Solve V_pose (B_pose + shift I)^{-1} per pose.
 
     V: [n, r, d+1], chol: [n, d+1, d+1] lower.  Because each block is
-    symmetric, right-division is a standard cho_solve on V^T.
+    symmetric, right-division is a cho_solve on V^T (unrolled small-k
+    substitution, ``ops.smallmat``).
     """
+    from .smallmat import cho_solve_small
+
     Vt = jnp.swapaxes(V, -1, -2)  # [n, d+1, r]
-    sol = jax.scipy.linalg.cho_solve((chol, True), Vt)
+    sol = cho_solve_small(chol, Vt)
     return jnp.swapaxes(sol, -1, -2)

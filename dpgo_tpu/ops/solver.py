@@ -143,16 +143,22 @@ class RTRState(NamedTuple):
     done: jax.Array
 
 
-def _rtr_attempt(problem: Problem, X, fX, g, eg, radius, params: SolverParams):
+def _rtr_attempt(problem: Problem, X, fX, g, eg, radius, params: SolverParams,
+                 tcg_fn=None):
     """One tCG solve + acceptance test at the given radius.
 
     ``g`` is the Riemannian gradient, ``eg`` the Euclidean gradient at X.
+    ``tcg_fn(X, g, eg, radius) -> TCGResult`` overrides the inner solver
+    (the Pallas VMEM-resident kernel, ``ops.pallas_tcg``).
     Returns (X_new, f_new, accepted, hit_boundary, rho).
     """
-    hvp = lambda V: manifold.ehess_to_rhess(X, eg, problem.ehess(X, V), V)
-    pre = lambda V: manifold.tangent_project(X, problem.precond(X, V))
-    res = truncated_cg(X, g, hvp, pre, radius, params.max_inner_iters,
-                       params.tcg_kappa, params.tcg_theta)
+    if tcg_fn is not None:
+        res = tcg_fn(X, g, eg, radius)
+    else:
+        hvp = lambda V: manifold.ehess_to_rhess(X, eg, problem.ehess(X, V), V)
+        pre = lambda V: manifold.tangent_project(X, problem.precond(X, V))
+        res = truncated_cg(X, g, hvp, pre, radius, params.max_inner_iters,
+                           params.tcg_kappa, params.tcg_theta)
     X_prop = manifold.retract(X, res.eta)
     f_prop = problem.cost(X_prop)
     model_decrease = -(manifold.inner(g, res.eta) + 0.5 * manifold.inner(res.eta, res.heta))
@@ -210,7 +216,7 @@ def rtr_solve(problem: Problem, X0: jax.Array, params: SolverParams,
 
 
 def rtr_single_step(problem: Problem, X0: jax.Array,
-                    params: SolverParams) -> RTRState:
+                    params: SolverParams, tcg_fn=None) -> RTRState:
     """The RBCD per-iteration local update: one accepted RTR step.
 
     Mirrors the reference's Max_Iteration == 1 path
@@ -230,7 +236,8 @@ def rtr_single_step(problem: Problem, X0: jax.Array,
         return (s.iters < params.max_rejections) & ~s.done
 
     def body(s: RTRState):
-        X_new, f_new, accept, _, _ = _rtr_attempt(problem, s.X, s.f, g, eg, s.radius, params)
+        X_new, f_new, accept, _, _ = _rtr_attempt(problem, s.X, s.f, g, eg,
+                                                  s.radius, params, tcg_fn)
         return RTRState(X=X_new, radius=jnp.where(accept, s.radius, s.radius / 4.0),
                         f=f_new, grad_norm=s.grad_norm, grad_norm_init=s.grad_norm_init,
                         iters=s.iters + 1, accepted=accept, done=accept)

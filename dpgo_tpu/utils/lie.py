@@ -115,9 +115,23 @@ def project_to_rotation(M: jax.Array) -> jax.Array:
 def project_to_stiefel(M: jax.Array) -> jax.Array:
     """Project [..., r, d] matrices (r >= d) onto the Stiefel manifold St(r, d).
 
-    Thin-SVD polar factor, the equivalent of reference
-    ``projectToStiefelManifold`` (``DPGO_utils.cpp:494-500``).
+    The polar factor ``M (M^T M)^{-1/2}``, the equivalent of reference
+    ``projectToStiefelManifold`` (``DPGO_utils.cpp:494-500``, thin-SVD
+    ``U V^T`` there).  Computed by the closed-form Newton-Schulz kernel:
+    XLA's batched SVD on TPU is a generic one-sided-Jacobi loop that costs
+    milliseconds on the [A*n, r, d] batches of the RBCD hot path, while the
+    fixed-size iteration is a handful of d x d matmuls.  Robust to
+    condition(M) ~1e5-1e6 (see ``smallmat.polar_orthonormalize``); for
+    potentially rank-deficient inputs use ``project_to_stiefel_svd``.
     """
+    from ..ops.smallmat import polar_orthonormalize
+
+    return polar_orthonormalize(M)
+
+
+def project_to_stiefel_svd(M: jax.Array) -> jax.Array:
+    """SVD form of ``project_to_stiefel`` (robust at any conditioning;
+    slow on TPU — cold paths only)."""
     U, _, Vh = jnp.linalg.svd(M, full_matrices=False)
     return U @ Vh
 

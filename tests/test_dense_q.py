@@ -1,0 +1,111 @@
+"""Dense-Q local problem formulation vs the edge-list reference path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dpgo_tpu.config import AgentParams, Schedule
+from dpgo_tpu.models import rbcd
+from dpgo_tpu.ops import quadratic
+from dpgo_tpu.utils.partition import partition_contiguous
+from synthetic import make_measurements
+
+
+def _setup(rng, n=24, A=4):
+    meas, _ = make_measurements(rng, n=n, d=3, num_lc=n // 2,
+                                rot_noise=0.05, trans_noise=0.05)
+    part = partition_contiguous(meas, A)
+    graph, meta = rbcd.build_graph(part, rank=5, dtype=jnp.float64)
+    Xa = jnp.asarray(np.random.default_rng(7).standard_normal(
+        (A, meta.n_max, 5, 4)))
+    Z = rbcd.neighbor_buffer(rbcd.public_table(Xa, graph), graph)
+    return graph, meta, Xa, Z
+
+
+def test_to_from_mat_roundtrip(rng):
+    X = jnp.asarray(rng.standard_normal((6, 5, 4)))
+    assert np.allclose(quadratic.from_mat(quadratic.to_mat(X), 6), X)
+
+
+def test_dense_q_problem_matches_edges(rng):
+    graph, meta, Xa, Z = _setup(rng)
+    qbuf = rbcd.dense_q_all(graph.edges, meta)
+    params = AgentParams(d=3, r=5, num_robots=4)
+    chol = rbcd.precond_chol(graph.edges, meta.n_max, meta.s_max, params)
+    for a in range(4):
+        e = jax.tree.map(lambda x: x[a], graph.edges)
+        pd = rbcd._agent_local_problem(Z[a], e, chol[a], meta.n_max,
+                                       qbuf=qbuf[a])
+        pe = rbcd._agent_local_problem(Z[a], e, chol[a], meta.n_max,
+                                       inc=(graph.inc_slot[a],
+                                            graph.inc_mask[a]))
+        x = Xa[a]
+        # Cost including the constant neighbor-neighbor-free term matches
+        # the edge-sum cost exactly.
+        assert np.allclose(pd.cost(x), pe.cost(x), atol=1e-9)
+        assert np.allclose(pd.egrad(x), pe.egrad(x), atol=1e-9)
+        V = jnp.asarray(np.random.default_rng(a).standard_normal(x.shape))
+        assert np.allclose(pd.ehess(x, V), pe.ehess(x, V), atol=1e-9)
+
+
+def test_rbcd_dense_matches_ell_rounds(rng):
+    """Full RBCD rounds agree (to fp tolerance) whether the dense-Q or the
+    ELL path runs."""
+    from dpgo_tpu.config import SolverParams
+
+    meas, _ = make_measurements(rng, n=20, d=3, num_lc=10,
+                                rot_noise=0.05, trans_noise=0.05)
+    params = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.JACOBI,
+                         solver=SolverParams(dense_quadratic=True))
+    part = partition_contiguous(meas, 4)
+    graph, meta = rbcd.build_graph(part, params.r, jnp.float64)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float64)
+    assert rbcd.use_dense_q(meta, params)
+    s_dense = rbcd.init_state(graph, meta, X0, params=params)
+    assert s_dense.Qbuf is not None
+    s_ell = s_dense._replace(Qbuf=None)
+    for _ in range(5):
+        s_dense = rbcd.rbcd_step(s_dense, graph, meta, params)
+        s_ell = rbcd.rbcd_step(s_ell, graph, meta, params)
+    assert np.allclose(s_dense.X, s_ell.X, atol=1e-7)
+
+
+def test_use_dense_q_budget():
+    from dpgo_tpu.config import SolverParams
+
+    meta_small = rbcd.GraphMeta(num_robots=8, n_max=316, e_max=675,
+                                s_max=100, p_max=100, d=3, rank=5)
+    on = AgentParams(d=3, r=5, num_robots=8,
+                     solver=SolverParams(dense_quadratic=True))
+    assert rbcd.use_dense_q(meta_small, on)
+    assert not rbcd.use_dense_q(meta_small, AgentParams(d=3, r=5,
+                                                        num_robots=8))
+    assert not rbcd.use_dense_q(meta_small, None)
+    meta_huge = rbcd.GraphMeta(num_robots=64, n_max=100000, e_max=300000,
+                               s_max=1000, p_max=1000, d=3, rank=5)
+    assert not rbcd.use_dense_q(meta_huge, on)
+
+
+def test_refresh_problem_rebakes_factors(rng):
+    """Externally injected weights (checkpoint resume) must be honored by
+    the carried problem factors via refresh_problem."""
+    from dpgo_tpu.config import SolverParams
+
+    meas, _ = make_measurements(rng, n=16, d=3, num_lc=8,
+                                rot_noise=0.05, trans_noise=0.05)
+    params = AgentParams(d=3, r=5, num_robots=2,
+                         solver=SolverParams(dense_quadratic=True))
+    part = partition_contiguous(meas, 2)
+    graph, meta = rbcd.build_graph(part, params.r, jnp.float64)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float64)
+    state = rbcd.init_state(graph, meta, X0, params=params)
+    w_new = state.weights * 0.25
+    stale = state._replace(weights=w_new)
+    fresh = rbcd.refresh_problem(stale, graph, meta, params)
+    # Stale factors are unchanged; refreshed ones match a from-scratch bake.
+    edges_w = graph.edges._replace(weight=w_new)
+    chol_ref = rbcd.precond_chol(edges_w, meta.n_max, meta.s_max, params)
+    qbuf_ref = rbcd.dense_q_all(edges_w, meta)
+    assert not np.allclose(stale.chol, chol_ref)
+    assert np.allclose(fresh.chol, chol_ref)
+    assert np.allclose(fresh.Qbuf, qbuf_ref)

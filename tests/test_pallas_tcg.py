@@ -1,0 +1,130 @@
+"""Pallas VMEM tCG kernel vs the XLA truncated_cg (interpreter mode)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpgo_tpu.config import AgentParams, Schedule, SolverParams
+from dpgo_tpu.models import rbcd
+from dpgo_tpu.ops import manifold, solver
+from dpgo_tpu.ops import pallas_tcg as ptcg
+from dpgo_tpu.utils.partition import partition_contiguous
+from synthetic import make_measurements
+
+
+def _setup(rng, n=24, A=4, rank=5, num_lc=12):
+    meas, _ = make_measurements(rng, n=n, d=3, num_lc=num_lc,
+                                rot_noise=0.05, trans_noise=0.05)
+    part = partition_contiguous(meas, A)
+    graph, meta = rbcd.build_graph(part, rank, jnp.float32, pallas_sel=True)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float32)
+    return graph, meta, X0
+
+
+@pytest.mark.parametrize("radius", [0.05, 1.0, 100.0])
+def test_kernel_matches_xla_tcg(rng, radius):
+    graph, meta, X0 = _setup(rng)
+    params = AgentParams(d=3, r=5, num_robots=4)
+    Z = rbcd.neighbor_buffer(rbcd.public_table(X0, graph), graph)
+    chol = rbcd.precond_chol(graph.edges, meta.n_max, meta.s_max, params)
+    d, k, r = meta.d, meta.d + 1, meta.rank
+
+    for a in range(2):
+        e = jax.tree.map(lambda t: t[a], graph.edges)
+        x, z = X0[a], Z[a]
+        prob = rbcd._agent_local_problem(
+            z, e, chol[a], meta.n_max,
+            inc=(graph.inc_slot[a], graph.inc_mask[a]))
+        eg = prob.egrad(x)
+        g = manifold.rgrad(x, eg)
+        rad = jnp.asarray(radius, jnp.float32)
+
+        hvp = lambda V: manifold.ehess_to_rhess(x, eg, prob.ehess(x, V), V)
+        pre = lambda V: manifold.tangent_project(x, prob.precond(x, V))
+        ref = solver.truncated_cg(x, g, hvp, pre, rad, 10, 0.1, 1.0)
+
+        w = e.mask * e.weight
+        wk = (w * e.kappa)[None]
+        wt = (w * e.tau)[None]
+        Y, GY = x[..., :d], eg[..., :d]
+        M = jnp.einsum("nab,nac->nbc", Y, GY)
+        S = 0.5 * (M + jnp.swapaxes(M, -1, -2))
+        Sc = S.transpose(1, 2, 0).reshape(d * d, meta.n_max)
+        Lc = chol[a].transpose(1, 2, 0).reshape(k * k, meta.n_max)
+        eta_c, heta_c, stats = ptcg.tcg_call(
+            graph.sel_i[a], graph.sel_j[a], graph.rot_c[a], graph.trn_c[a],
+            wk, wt, ptcg.comp_major(x), Sc, Lc, ptcg.comp_major(g),
+            rad.reshape(1, 1), r=r, d=d, max_iters=10, kappa=0.1, theta=1.0,
+            interpret=True)
+
+        assert np.allclose(ptcg.comp_minor(eta_c, r, k), ref.eta, atol=1e-5)
+        assert np.allclose(ptcg.comp_minor(heta_c, r, k), ref.heta, atol=1e-4)
+        assert int(stats[0, 0]) == int(ref.iters)
+        assert bool(stats[0, 1] > 0) == bool(ref.hit_boundary)
+
+
+def test_rounds_match_ell_path(rng):
+    """Full RBCD rounds through the Pallas tCG (forced, interpreter mode)
+    track the ELL path to float32 tolerance."""
+    graph, meta, X0 = _setup(rng)
+    pp = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.JACOBI,
+                     solver=SolverParams(pallas_tcg=True))
+    pe = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.JACOBI,
+                     solver=SolverParams(pallas_tcg=False))
+    sp = rbcd.init_state(graph, meta, X0, params=pp)
+    se = rbcd.init_state(graph, meta, X0, params=pe)
+    for _ in range(3):
+        sp = rbcd.rbcd_step(sp, graph, meta, pp)
+        se = rbcd.rbcd_step(se, graph, meta, pe)
+    assert np.allclose(sp.X, se.X, atol=1e-5)
+
+
+def test_sel_matrices_respect_budget(rng):
+    graph, meta, _ = _setup(rng)
+    assert graph.sel_i is not None  # tiny problem: always built
+    # One-hot rows select exactly the local endpoint of each (real) edge.
+    a = 0
+    i = np.asarray(graph.edges.i[a])
+    mask = np.asarray(graph.edges.mask[a])
+    sel_i = np.asarray(graph.sel_i[a])
+    for e_idx in range(len(i)):
+        row = sel_i[e_idx]
+        if mask[e_idx] > 0 and i[e_idx] < meta.n_max:
+            assert row.sum() == 1.0 and row[i[e_idx]] == 1.0
+        else:
+            assert row.sum() == 0.0
+
+
+def test_rounds_match_ell_path_se2(rng):
+    """The kernel is generic over (r, d): SE(2) rounds must also track the
+    ELL path."""
+    meas, _ = make_measurements(rng, n=16, d=2, num_lc=6,
+                                rot_noise=0.03, trans_noise=0.03)
+    part = partition_contiguous(meas, 2)
+    graph, meta = rbcd.build_graph(part, 3, jnp.float32, pallas_sel=True)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float32)
+    pp = AgentParams(d=2, r=3, num_robots=2, schedule=Schedule.JACOBI,
+                     solver=SolverParams(pallas_tcg=True))
+    pe = AgentParams(d=2, r=3, num_robots=2, schedule=Schedule.JACOBI,
+                     solver=SolverParams(pallas_tcg=False))
+    sp = rbcd.init_state(graph, meta, X0, params=pp)
+    se = rbcd.init_state(graph, meta, X0, params=pe)
+    for _ in range(3):
+        sp = rbcd.rbcd_step(sp, graph, meta, pp)
+        se = rbcd.rbcd_step(se, graph, meta, pe)
+    assert np.allclose(sp.X, se.X, atol=1e-5)
+
+
+def test_forced_pallas_without_sel_raises(rng):
+    """pallas_tcg=True on a graph without selection matrices must raise,
+    not silently downgrade to another formulation."""
+    meas, _ = make_measurements(rng, n=16, d=3, num_lc=6)
+    part = partition_contiguous(meas, 2)
+    graph, meta = rbcd.build_graph(part, 5, jnp.float32, pallas_sel=False)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float32)
+    pp = AgentParams(d=3, r=5, num_robots=2,
+                     solver=SolverParams(pallas_tcg=True))
+    with pytest.raises(ValueError, match="selection matrices"):
+        state = rbcd.init_state(graph, meta, X0, params=pp)
+        rbcd.rbcd_step(state, graph, meta, pp)

@@ -1,0 +1,66 @@
+"""Closed-form small-matrix kernels vs LAPACK references."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpgo_tpu.ops import smallmat
+
+
+# Column counts are the SE(d) dimension d in {2, 3} throughout the framework
+# (St(r, d) blocks; the r = d local solve still has d <= 3 columns).
+@pytest.mark.parametrize("r,d", [(3, 3), (5, 3), (7, 3), (3, 2), (7, 2)])
+def test_polar_matches_svd(rng, r, d):
+    M = jnp.asarray(rng.standard_normal((64, r, d)))
+    U = smallmat.polar_orthonormalize(M)
+    # Orthonormal columns
+    G = jnp.swapaxes(U, -1, -2) @ U
+    assert np.allclose(G, np.eye(d), atol=1e-8)
+    # Matches the SVD polar factor
+    u, _, vt = np.linalg.svd(np.asarray(M), full_matrices=False)
+    assert np.allclose(U, u @ vt, atol=1e-7)
+
+
+def test_polar_skewed_spectrum(rng):
+    # Singular values spanning 1e-2 .. 1e2 (condition 1e4, far beyond any
+    # retraction argument): the trace normalization plus fixed
+    # Newton-Schulz iterations must still converge.
+    u, _, vt = np.linalg.svd(rng.standard_normal((32, 5, 3)),
+                             full_matrices=False)
+    sv = 10.0 ** rng.uniform(-2, 2, size=(32, 3))
+    M = jnp.asarray(u * sv[:, None, :] @ vt)
+    U = smallmat.polar_orthonormalize(M)
+    G = jnp.swapaxes(U, -1, -2) @ U
+    assert np.allclose(G, np.eye(3), atol=1e-6)
+    assert np.allclose(U, u @ vt, atol=1e-6)
+
+
+def test_polar_near_identity(rng):
+    # The common case: a tangent step off an orthonormal Y (retraction).
+    u, _, vt = np.linalg.svd(rng.standard_normal((16, 5, 3)),
+                             full_matrices=False)
+    Y = u @ vt
+    M = jnp.asarray(Y + 0.05 * rng.standard_normal(Y.shape))
+    U = smallmat.polar_orthonormalize(M)
+    uu, _, vvt = np.linalg.svd(np.asarray(M), full_matrices=False)
+    assert np.allclose(U, uu @ vvt, atol=1e-9)
+
+
+@pytest.mark.parametrize("k", [3, 4])
+def test_cholesky_small(rng, k):
+    B = rng.standard_normal((128, k, k))
+    A = jnp.asarray(B @ np.swapaxes(B, -1, -2) + 0.1 * np.eye(k))
+    L = smallmat.cholesky_small(A)
+    assert np.allclose(L @ jnp.swapaxes(L, -1, -2), A, atol=1e-9)
+    assert np.allclose(np.triu(np.asarray(L), 1), 0.0)
+
+
+@pytest.mark.parametrize("k,m", [(4, 5), (3, 7)])
+def test_cho_solve_small(rng, k, m):
+    B = rng.standard_normal((64, k, k))
+    A = jnp.asarray(B @ np.swapaxes(B, -1, -2) + 0.1 * np.eye(k))
+    rhs = jnp.asarray(rng.standard_normal((64, k, m)))
+    L = smallmat.cholesky_small(A)
+    X = smallmat.cho_solve_small(L, rhs)
+    assert np.allclose(A @ X, rhs, atol=1e-8)
