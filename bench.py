@@ -49,7 +49,7 @@ def build(dtype):
     part = partition_contiguous(meas, NUM_ROBOTS)
     graph, meta = rbcd.build_graph(part, RANK, dtype)
     X0 = rbcd.centralized_chordal_init(part, meta, graph, dtype)
-    state = rbcd.init_state(graph, meta, X0)
+    state = rbcd.init_state(graph, meta, X0, params=params)
     return state, graph, meta, params
 
 
@@ -68,16 +68,28 @@ def time_rounds(device, dtype, rounds):
     log(f"  [{device.platform}] compile+first round: "
         f"{time.perf_counter() - t0:.1f}s")
 
-    t0 = time.perf_counter()
-    for _ in range(rounds):
-        state = step(state)
-    # Device->host readback, NOT block_until_ready: on the tunneled TPU
-    # platform block_until_ready returns before execution finishes, which
-    # inflates throughput ~100x; the transfer cannot complete early.
-    Xh = np.asarray(state.X)
-    dt = time.perf_counter() - t0
-    assert bool(np.isfinite(Xh).all()), "non-finite state"
-    return rounds / dt
+    # Median of several trials: the tunneled TPU is a shared resource whose
+    # effective throughput fluctuates across minutes; the median is robust
+    # to a single interfered trial without reporting the lucky peak.
+    rates = []
+    state0 = state
+    for _ in range(3):
+        state = state0
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            state = step(state)
+        # Device->host readback, NOT block_until_ready: on this image's
+        # experimental tunneled TPU platform, block_until_ready empirically
+        # returns before execution finishes (measured: 100 chained rounds
+        # "complete" in 7 ms under block_until_ready vs 2.0 s with a
+        # readback, against an 18 ms single-round execution) — so timing
+        # must end with a transfer, which cannot complete early.
+        Xh = np.asarray(state.X)
+        dt = time.perf_counter() - t0
+        assert bool(np.isfinite(Xh).all()), "non-finite state"
+        rates.append(rounds / dt)
+        log(f"  [{device.platform}] trial: {rounds / dt:.1f} rounds/s")
+    return float(np.median(rates))
 
 
 def cpu_baseline_subprocess() -> float:

@@ -405,11 +405,13 @@ def precond_chol(graph_edges: EdgeSet, n_max: int, s_max: int,
 DENSE_Q_BUDGET_BYTES = 1 << 30
 
 
-def use_dense_q(meta: GraphMeta, params: AgentParams | None = None,
-                itemsize: int = 4) -> bool:
+def use_dense_q(meta: GraphMeta, params: AgentParams | None,
+                itemsize: int) -> bool:
     """Whether the (opt-in) materialized dense-Q formulation applies:
     requested via ``SolverParams.dense_quadratic`` and within the memory
-    budget at the problem's actual ``itemsize`` (8 for float64 graphs)."""
+    budget at the problem's actual ``itemsize`` (4 for float32 graphs, 8
+    for float64 — required so the predicate always agrees with what the
+    solver will actually dispatch)."""
     if params is None or not params.solver.dense_quadratic:
         return False
     K = (meta.d + 1) * (meta.n_max + meta.s_max)
@@ -527,7 +529,8 @@ def _agent_update(X_local, z, edges, params: AgentParams, chol=None, inc=None,
                 iters=stats[0, 0].astype(jnp.int32),
                 hit_boundary=stats[0, 1] > 0)
 
-    out = solver.rtr_single_step(problem, X_local, params.solver, tcg_fn)
+    out = solver.rtr_single_step(problem, X_local, params.solver, tcg_fn,
+                                 final_grad_norm=False)
     return out.X, out.grad_norm_init
 
 
@@ -658,17 +661,27 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
             gamma = jnp.zeros_like(gamma)
             alpha = jnp.zeros_like(alpha)
     edges = graph.edges._replace(weight=weights)
+    form = _formulation(meta, params, graph, itemsize=X.dtype.itemsize)
+    if form == "dense" and qbuf is None:
+        # Mirror the forced-Pallas behavior: an explicit opt-in that cannot
+        # run must not silently downgrade to another formulation.
+        raise ValueError(
+            "dense_quadratic=True but the state carries no Qbuf — build it "
+            "with init_state(..., params=...) using the same params, or "
+            "refresh_problem() after changing them")
     if update_weights:
         # Reweighted Q -> refactor the block-Jacobi preconditioner (and the
-        # materialized dense Q), the reference's constructQMatrix + CHOLMOD
-        # refactorization schedule (PGOAgent.cpp:1110-1112).
+        # materialized dense Q when that formulation is active), the
+        # reference's constructQMatrix + CHOLMOD refactorization schedule
+        # (PGOAgent.cpp:1110-1112).
         chol = precond_chol(edges, meta.n_max, meta.s_max, params)
-        if qbuf is not None:
-            qbuf = dense_q_all(edges, meta)
+        qbuf = dense_q_all(edges, meta) if form == "dense" else None
     elif chol is None:
         # State built without solver params (init_state(params=None)):
-        # factor from the live edge weights and THIS round's solver config
-        # so a custom precond_shift is always honored.
+        # factor from the live edge weights and THIS round's solver config.
+        # NOTE: factors baked by init_state follow the params given THERE —
+        # stepping with a different precond_shift than the state was built
+        # with requires refresh_problem(state, graph, meta, new_params).
         chol = precond_chol(edges, meta.n_max, meta.s_max, params)
 
     # --- Acceleration bookkeeping (PGOAgent.cpp:1065-1091) ---
@@ -682,9 +695,9 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
     else:
         start, Zuse = X, Z
 
-    # tCG formulation resolution (see ``_formulation``): forced Pallas >
-    # explicit dense-Q > Pallas auto (TPU) > ELL edge path.
-    form = _formulation(meta, params, graph, itemsize=X.dtype.itemsize)
+    # tCG formulation resolution (``form`` resolved above, before the
+    # factor refresh): forced Pallas > explicit dense-Q > Pallas auto (TPU)
+    # > ELL edge path.
     if form == "pallas":
         interp = jax.default_backend() != "tpu"
         # inc rides along so the outer cost/egrad/acceptance evaluations use
@@ -695,7 +708,7 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
                 pallas=(si, sj, rc, tc, interp)))(
             start, Zuse, edges, chol, graph.inc_slot, graph.inc_mask,
             graph.sel_i, graph.sel_j, graph.rot_c, graph.trn_c)
-    elif form == "dense" and qbuf is not None:
+    elif form == "dense":  # qbuf presence enforced above
         X_upd, gn0 = jax.vmap(
             lambda x, z, e, c, q: _agent_update(x, z, e, params, c, qbuf=q))(
             start, Zuse, edges, chol, qbuf)

@@ -216,7 +216,8 @@ def rtr_solve(problem: Problem, X0: jax.Array, params: SolverParams,
 
 
 def rtr_single_step(problem: Problem, X0: jax.Array,
-                    params: SolverParams, tcg_fn=None) -> RTRState:
+                    params: SolverParams, tcg_fn=None,
+                    final_grad_norm: bool = True) -> RTRState:
     """The RBCD per-iteration local update: one accepted RTR step.
 
     Mirrors the reference's Max_Iteration == 1 path
@@ -247,6 +248,11 @@ def rtr_single_step(problem: Problem, X0: jax.Array,
                     iters=jnp.array(0, jnp.int32),
                     accepted=jnp.array(False), done=below_tol)
     out = jax.lax.while_loop(cond, body, init)
+    if not final_grad_norm:
+        # Skip the post-step gradient evaluation (a full egrad whose only
+        # consumer is status reporting; the RBCD round never reads it —
+        # greedy selection uses grad_norm_init).
+        return out
     # Recompute the gradient norm at the final point for status reporting.
     gn1 = manifold.norm(manifold.rgrad(out.X, problem.egrad(out.X)))
     return out._replace(grad_norm=gn1)

@@ -60,14 +60,36 @@ def test_rbcd_dense_matches_ell_rounds(rng):
     part = partition_contiguous(meas, 4)
     graph, meta = rbcd.build_graph(part, params.r, jnp.float64)
     X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float64)
-    assert rbcd.use_dense_q(meta, params)
+    assert rbcd.use_dense_q(meta, params, itemsize=8)
+    params_ell = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.JACOBI)
     s_dense = rbcd.init_state(graph, meta, X0, params=params)
     assert s_dense.Qbuf is not None
-    s_ell = s_dense._replace(Qbuf=None)
+    s_ell = rbcd.init_state(graph, meta, X0, params=params_ell)
+    assert s_ell.Qbuf is None
     for _ in range(5):
         s_dense = rbcd.rbcd_step(s_dense, graph, meta, params)
-        s_ell = rbcd.rbcd_step(s_ell, graph, meta, params)
+        s_ell = rbcd.rbcd_step(s_ell, graph, meta, params_ell)
     assert np.allclose(s_dense.X, s_ell.X, atol=1e-7)
+
+
+def test_dense_opt_in_without_qbuf_raises(rng):
+    """dense_quadratic=True with a state lacking Qbuf raises instead of
+    silently running another formulation (mirrors the forced-Pallas
+    behavior)."""
+    from dpgo_tpu.config import SolverParams
+
+    meas, _ = make_measurements(rng, n=12, d=3, num_lc=4)
+    params_d = AgentParams(d=3, r=5, num_robots=2,
+                           solver=SolverParams(dense_quadratic=True))
+    params_e = AgentParams(d=3, r=5, num_robots=2)
+    part = partition_contiguous(meas, 2)
+    graph, meta = rbcd.build_graph(part, 5, jnp.float64)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float64)
+    state = rbcd.init_state(graph, meta, X0, params=params_e)  # no Qbuf
+    import pytest
+
+    with pytest.raises(ValueError, match="no Qbuf"):
+        rbcd.rbcd_step(state, graph, meta, params_d)
 
 
 def test_use_dense_q_budget():
@@ -77,13 +99,20 @@ def test_use_dense_q_budget():
                                 s_max=100, p_max=100, d=3, rank=5)
     on = AgentParams(d=3, r=5, num_robots=8,
                      solver=SolverParams(dense_quadratic=True))
-    assert rbcd.use_dense_q(meta_small, on)
+    assert rbcd.use_dense_q(meta_small, on, itemsize=4)
     assert not rbcd.use_dense_q(meta_small, AgentParams(d=3, r=5,
-                                                        num_robots=8))
-    assert not rbcd.use_dense_q(meta_small, None)
+                                                        num_robots=8),
+                                itemsize=4)
+    assert not rbcd.use_dense_q(meta_small, None, itemsize=4)
     meta_huge = rbcd.GraphMeta(num_robots=64, n_max=100000, e_max=300000,
                                s_max=1000, p_max=1000, d=3, rank=5)
-    assert not rbcd.use_dense_q(meta_huge, on)
+    assert not rbcd.use_dense_q(meta_huge, on, itemsize=4)
+    # The itemsize must reflect the problem dtype: a float64 graph doubles
+    # the footprint and can flip the verdict near the budget edge.
+    meta_edge = rbcd.GraphMeta(num_robots=8, n_max=1200, e_max=5000,
+                               s_max=50, p_max=50, d=3, rank=5)
+    assert rbcd.use_dense_q(meta_edge, on, itemsize=4)
+    assert not rbcd.use_dense_q(meta_edge, on, itemsize=8)
 
 
 def test_refresh_problem_rebakes_factors(rng):
