@@ -424,10 +424,22 @@ def use_dense_q(meta: GraphMeta, params: AgentParams | None,
 PALLAS_TCG_VMEM_BUDGET_BYTES = 10 << 20
 
 
+#: Empirical Mosaic compile ceiling for the tCG kernel on TPU v5e: shapes
+#: with e_max <= 883 / n_max <= 420 compile and run; e_max >= 1051 crashes
+#: the TPU compile helper (HTTP 500 from tpu_compile_helper, no diagnostic)
+#: regardless of d/r.  Gate strictly inside the verified-good region; larger
+#: problems run the XLA ELL path.  Revisit with newer libtpu/Mosaic.
+PALLAS_TCG_MAX_EDGES = 883
+PALLAS_TCG_MAX_POSES = 420
+
+
 def _pallas_vmem_ok(meta: GraphMeta) -> bool:
-    """Estimate of the kernel's per-agent VMEM: the two [E, n] selection
-    matrices dominate; edge components and ~12 [r(d+1), n] loop vectors
-    ride along."""
+    """Whether the kernel's per-agent working set fits: VMEM estimate (the
+    two [E, n] selection matrices dominate; edge components and ~12
+    [r(d+1), n] loop vectors ride along) plus the empirical Mosaic compile
+    ceiling."""
+    if meta.e_max > PALLAS_TCG_MAX_EDGES or meta.n_max > PALLAS_TCG_MAX_POSES:
+        return False
     rk = meta.rank * (meta.d + 1)
     sel = 2 * meta.e_max * meta.n_max
     vecs = 12 * rk * meta.n_max + (2 * meta.d * meta.d + 4) * meta.e_max
@@ -449,10 +461,19 @@ def _formulation(meta: GraphMeta, params: AgentParams | None, graph,
         if not pallas_ok:
             # An explicit force that cannot be honored must not silently
             # downgrade — the caller believes the kernel is being covered.
-            reason = "algorithm is not RTR" if not rtr else (
-                "the graph was built without selection matrices "
-                "(build_graph(pallas_sel=True))" if graph.sel_i is None
-                else "the per-agent problem exceeds the kernel's VMEM budget")
+            if not rtr:
+                reason = "algorithm is not RTR"
+            elif graph.sel_i is None:
+                reason = ("the graph was built without selection matrices "
+                          "(build_graph(pallas_sel=True))")
+            elif (meta.e_max > PALLAS_TCG_MAX_EDGES
+                  or meta.n_max > PALLAS_TCG_MAX_POSES):
+                reason = (f"the per-agent shapes (e_max={meta.e_max}, "
+                          f"n_max={meta.n_max}) exceed the empirical Mosaic "
+                          f"compile ceiling ({PALLAS_TCG_MAX_EDGES} edges / "
+                          f"{PALLAS_TCG_MAX_POSES} poses)")
+            else:
+                reason = "the per-agent problem exceeds the kernel's VMEM budget"
             raise ValueError(f"pallas_tcg=True cannot run: {reason}")
         return "pallas"
     if rtr and use_dense_q(meta, params, itemsize):
@@ -675,7 +696,11 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
         # reference's constructQMatrix + CHOLMOD refactorization schedule
         # (PGOAgent.cpp:1110-1112).
         chol = precond_chol(edges, meta.n_max, meta.s_max, params)
-        qbuf = dense_q_all(edges, meta) if form == "dense" else None
+        # Refresh the dense buffer when active, and keep (refreshed) a
+        # carried one even if this round's params resolve elsewhere — the
+        # caller may switch formulations between rounds.
+        qbuf = dense_q_all(edges, meta) \
+            if (form == "dense" or qbuf is not None) else None
     elif chol is None:
         # State built without solver params (init_state(params=None)):
         # factor from the live edge weights and THIS round's solver config.
@@ -824,7 +849,14 @@ def refresh_problem(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
     next GNC update fires."""
     edges = graph.edges._replace(weight=state.weights)
     chol = precond_chol(edges, meta.n_max, meta.s_max, params)
-    qbuf = dense_q_all(edges, meta) if state.Qbuf is not None else None
+    # Decide the dense buffer from the given params (like init_state does),
+    # not from its previous presence — this also (re)creates a missing Qbuf
+    # when the caller switched to a dense_quadratic configuration.
+    want_dense = _formulation(
+        meta, params, graph, itemsize=jnp.dtype(state.X.dtype).itemsize) \
+        == "dense"
+    qbuf = dense_q_all(edges, meta) if (want_dense or state.Qbuf is not None) \
+        else None
     return state._replace(chol=chol, Qbuf=qbuf)
 
 

@@ -129,3 +129,23 @@ def test_mesh_size_divisibility(rng):
     _, graph, meta, state = _setup(meas, 6, params)
     with pytest.raises(ValueError, match="multiple of mesh size"):
         shard_problem(make_mesh(4), state, graph)
+
+
+def test_sharded_64_agents_on_8_devices(rng):
+    """BASELINE config #5 scale: 64 agents over an 8-device mesh (8 agent
+    blocks per shard — the multi-slice layout, DCN being the same code
+    path as ICI in XLA collectives).  Three rounds must agree with the
+    single-device solver."""
+    meas, _ = make_measurements(rng, n=256, d=3, num_lc=80,
+                                rot_noise=0.01, trans_noise=0.01)
+    params = AgentParams(d=3, r=5, num_robots=64, schedule=Schedule.JACOBI)
+    _, graph, meta, state = _setup(meas, 64, params)
+
+    mesh = make_mesh(8)
+    sh_state, sh_graph = shard_problem(mesh, state, graph)
+    step = make_sharded_step(mesh, meta, params)
+    for _ in range(3):
+        state = rbcd.rbcd_step(state, graph, meta, params)
+        sh_state = step(sh_state, sh_graph)
+    np.testing.assert_allclose(np.asarray(sh_state.X), np.asarray(state.X),
+                               atol=1e-9)
