@@ -83,13 +83,17 @@ class MultiAgentGraph(NamedTuple):
     # E_max + e = endpoint j.  K = max local pose degree over the partition.
     inc_slot: jax.Array  # [A, n_max, K] into the [gi | gj] concatenation
     inc_mask: jax.Array  # [A, n_max, K]
-    # One-hot local-endpoint selection matrices + component-major edge data
-    # for the Pallas VMEM tCG kernel (``ops.pallas_tcg``); None when the
-    # selection matrices exceed the memory budget.
-    sel_i: jax.Array | None = None  # [A, E_max, n_max] f32 0/1
-    sel_j: jax.Array | None = None  # [A, E_max, n_max]
-    rot_c: jax.Array | None = None  # [A, d*d, E_max]
-    trn_c: jax.Array | None = None  # [A, d, E_max]
+    # One-hot endpoint selection matrices + component-major edge data for
+    # the Pallas VMEM solver kernels (``ops.pallas_tcg``); None when the
+    # selection matrices exceed the memory budget.  sel_* select local
+    # endpoints (zero rows for neighbor endpoints), seln_* the neighbor
+    # slots (zero rows for local endpoints).
+    sel_i: jax.Array | None = None   # [A, E_max, n_max] f32 0/1
+    sel_j: jax.Array | None = None   # [A, E_max, n_max]
+    seln_i: jax.Array | None = None  # [A, E_max, s_max]
+    seln_j: jax.Array | None = None  # [A, E_max, s_max]
+    rot_c: jax.Array | None = None   # [A, d*d, E_max]
+    trn_c: jax.Array | None = None   # [A, d, E_max]
 
 
 class RBCDState(NamedTuple):
@@ -238,23 +242,31 @@ def build_graph(part: Partition, rank: int, dtype=jnp.float32,
     if pallas_sel is None:
         pallas_sel = jax.default_backend() == "tpu"
     SEL_BUDGET_BYTES = 256 << 20
-    if pallas_sel and 2 * A * e_max * n_max * 4 <= SEL_BUDGET_BYTES:
+    if pallas_sel and 2 * A * e_max * (n_max + s_max) * 4 <= SEL_BUDGET_BYTES:
         sel_i = np.zeros((A, e_max, n_max), np.float32)
         sel_j = np.zeros((A, e_max, n_max), np.float32)
+        seln_i = np.zeros((A, e_max, s_max), np.float32)
+        seln_j = np.zeros((A, e_max, s_max), np.float32)
         for a in range(A):
             for idx, (i, j, _k) in enumerate(edge_rows[a]):
                 if i < n_max:
                     sel_i[a, idx, i] = 1.0
+                else:
+                    seln_i[a, idx, i - n_max] = 1.0
                 if j < n_max:
                     sel_j[a, idx, j] = 1.0
+                else:
+                    seln_j[a, idx, j - n_max] = 1.0
         rot_c = np.ascontiguousarray(
             eR.transpose(0, 2, 3, 1).reshape(A, d * d, e_max))
         trn_c = np.ascontiguousarray(et.transpose(0, 2, 1))
         pallas_fields = dict(
             sel_i=jnp.asarray(sel_i), sel_j=jnp.asarray(sel_j),
+            seln_i=jnp.asarray(seln_i), seln_j=jnp.asarray(seln_j),
             rot_c=jnp.asarray(rot_c, dtype), trn_c=jnp.asarray(trn_c, dtype))
     else:
-        pallas_fields = dict(sel_i=None, sel_j=None, rot_c=None, trn_c=None)
+        pallas_fields = dict(sel_i=None, sel_j=None, seln_i=None,
+                             seln_j=None, rot_c=None, trn_c=None)
 
     pose_mask = (np.arange(n_max)[None, :] < part.n[:, None]).astype(np.float64)
 
@@ -424,13 +436,15 @@ def use_dense_q(meta: GraphMeta, params: AgentParams | None,
 PALLAS_TCG_VMEM_BUDGET_BYTES = 10 << 20
 
 
-#: Empirical Mosaic compile ceiling for the tCG kernel on TPU v5e: shapes
-#: with e_max <= 883 / n_max <= 420 compile and run; e_max >= 1051 crashes
-#: the TPU compile helper (HTTP 500 from tpu_compile_helper, no diagnostic)
-#: regardless of d/r.  Gate strictly inside the verified-good region; larger
-#: problems run the XLA ELL path.  Revisit with newer libtpu/Mosaic.
-PALLAS_TCG_MAX_EDGES = 883
-PALLAS_TCG_MAX_POSES = 420
+#: Empirical Mosaic compile ceiling for the full-RTR kernel on TPU v5e:
+#: shapes with e_max <= 765 / n_max <= 358 compile and run; e_max = 883 /
+#: n_max = 420 crashes the TPU compile helper (HTTP 500 from
+#: tpu_compile_helper, no diagnostic) regardless of d/r.  Gate strictly
+#: inside the verified-good region; larger problems run the XLA ELL path.
+#: Revisit with newer libtpu/Mosaic (the lighter tCG-only kernel compiled
+#: up to e_max 883, so the ceiling tracks total kernel size).
+PALLAS_TCG_MAX_EDGES = 765
+PALLAS_TCG_MAX_POSES = 358
 
 
 def _pallas_vmem_ok(meta: GraphMeta) -> bool:
@@ -441,7 +455,7 @@ def _pallas_vmem_ok(meta: GraphMeta) -> bool:
     if meta.e_max > PALLAS_TCG_MAX_EDGES or meta.n_max > PALLAS_TCG_MAX_POSES:
         return False
     rk = meta.rank * (meta.d + 1)
-    sel = 2 * meta.e_max * meta.n_max
+    sel = 2 * meta.e_max * (meta.n_max + meta.s_max)
     vecs = 12 * rk * meta.n_max + (2 * meta.d * meta.d + 4) * meta.e_max
     return (sel + vecs) * 4 <= PALLAS_TCG_VMEM_BUDGET_BYTES
 
@@ -499,8 +513,9 @@ def _agent_update(X_local, z, edges, params: AgentParams, chol=None, inc=None,
     ``chol`` carries precomputed preconditioner factors (recomputed here when
     omitted — the single-shot path of ``agent.PGOAgent``); ``inc``/``qbuf``
     select the ELL / dense-Q problem formulations (``_agent_local_problem``);
-    ``pallas = (sel_i, sel_j, rot_c, trn_c, interpret)`` swaps the tCG
-    subproblem for the VMEM Pallas kernel (``ops.pallas_tcg``).
+    ``pallas = (sel_i, sel_j, seln_i, seln_j, rot_c, trn_c, interpret)``
+    runs the whole single-step RTR in the VMEM Pallas kernel
+    (``ops.pallas_tcg.rtr_call``).
     Returns the updated block and the block gradient norm at the *starting*
     point — the greedy selection metric (``MultiRobotExample.cpp:242-256``)
     — which the RTR solver computes anyway.
@@ -517,12 +532,10 @@ def _agent_update(X_local, z, edges, params: AgentParams, chol=None, inc=None,
     if chol is None:
         blocks = quadratic.diag_blocks(edges, n_max + z.shape[0], n_out=n_max)
         chol = quadratic.precond_factors(blocks, params.solver.precond_shift)
-    problem = _agent_local_problem(z, edges, chol, n_max, inc=inc, qbuf=qbuf)
-    tcg_fn = None
     if pallas is not None:
         from ..ops import pallas_tcg as ptcg
 
-        sel_i, sel_j, rot_c, trn_c, interpret = pallas
+        sel_i, sel_j, seln_i, seln_j, rot_c, trn_c, interpret = pallas
         d = trn_c.shape[0]
         k = d + 1
         r = X_local.shape[-2]
@@ -530,27 +543,35 @@ def _agent_update(X_local, z, edges, params: AgentParams, chol=None, inc=None,
         wk = (w * edges.kappa).astype(jnp.float32)[None]
         wt = (w * edges.tau).astype(jnp.float32)[None]
         Lc = chol.transpose(1, 2, 0).reshape(k * k, n_max)
-
-        def tcg_fn(Xl, g, eg, radius):
-            Y, GY = Xl[..., :d], eg[..., :d]
-            M = jnp.einsum("nab,nac->nbc", Y, GY)
-            S = 0.5 * (M + jnp.swapaxes(M, -1, -2))
-            Sc = S.transpose(1, 2, 0).reshape(d * d, n_max)
-            eta_c, heta_c, stats = ptcg.tcg_call(
-                sel_i, sel_j, rot_c, trn_c, wk, wt,
-                ptcg.comp_major(Xl.astype(jnp.float32)), Sc.astype(jnp.float32),
-                Lc.astype(jnp.float32), ptcg.comp_major(g.astype(jnp.float32)),
-                jnp.reshape(radius, (1, 1)).astype(jnp.float32),
-                r=r, d=d, max_iters=params.solver.max_inner_iters,
-                kappa=params.solver.tcg_kappa, theta=params.solver.tcg_theta,
-                interpret=interpret)
-            return solver.TCGResult(
-                eta=ptcg.comp_minor(eta_c, r, k).astype(Xl.dtype),
-                heta=ptcg.comp_minor(heta_c, r, k).astype(Xl.dtype),
-                iters=stats[0, 0].astype(jnp.int32),
-                hit_boundary=stats[0, 1] > 0)
-
-    out = solver.rtr_single_step(problem, X_local, params.solver, tcg_fn,
+        # Gradient at the start point (ELL path) -> the kernel runs the
+        # whole single-step RTR (tCG + retraction + acceptance + radius
+        # retries) in VMEM; the early-exit below the solver's gradient
+        # tolerance (QuadraticOptimizer.cpp:65-69) stays out here.
+        buf = jnp.concatenate([X_local, z], axis=0)
+        eg = quadratic.egrad_ell(buf, edges, inc[0], inc[1]) if inc is not None \
+            else quadratic.egrad(buf, edges, n_out=n_max)
+        g = manifold.rgrad(X_local, eg)
+        gn0 = manifold.norm(g)
+        Y, GY = X_local[..., :d], eg[..., :d]
+        M = jnp.einsum("nab,nac->nbc", Y, GY)
+        S = 0.5 * (M + jnp.swapaxes(M, -1, -2))
+        Sc = S.transpose(1, 2, 0).reshape(d * d, n_max)
+        X_out_c, stats = ptcg.rtr_call(
+            sel_i, sel_j, seln_i, seln_j, rot_c, trn_c, wk, wt,
+            ptcg.comp_major(X_local.astype(jnp.float32)),
+            ptcg.comp_major(z.astype(jnp.float32)),
+            Sc.astype(jnp.float32), Lc.astype(jnp.float32),
+            ptcg.comp_major(g.astype(jnp.float32)),
+            r=r, d=d, max_iters=params.solver.max_inner_iters,
+            kappa=params.solver.tcg_kappa, theta=params.solver.tcg_theta,
+            initial_radius=params.solver.initial_radius,
+            max_rejections=params.solver.max_rejections,
+            interpret=interpret)
+        X_new = ptcg.comp_minor(X_out_c, r, k).astype(X_local.dtype)
+        below_tol = gn0 < params.solver.grad_norm_tol
+        return jnp.where(below_tol, X_local, X_new), gn0
+    problem = _agent_local_problem(z, edges, chol, n_max, inc=inc, qbuf=qbuf)
+    out = solver.rtr_single_step(problem, X_local, params.solver, None,
                                  final_grad_norm=False)
     return out.X, out.grad_norm_init
 
@@ -725,14 +746,15 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
     # > ELL edge path.
     if form == "pallas":
         interp = jax.default_backend() != "tpu"
-        # inc rides along so the outer cost/egrad/acceptance evaluations use
-        # the gather-only ELL path; only the tCG subproblem hits the kernel.
+        # inc rides along for the start-point gradient (gather-only ELL);
+        # the full RTR step runs in the VMEM kernel.
         X_upd, gn0 = jax.vmap(
-            lambda x, z, e, c, s, m, si, sj, rc, tc: _agent_update(
+            lambda x, z, e, c, s, m, si, sj, sni, snj, rc, tc: _agent_update(
                 x, z, e, params, c, inc=(s, m),
-                pallas=(si, sj, rc, tc, interp)))(
+                pallas=(si, sj, sni, snj, rc, tc, interp)))(
             start, Zuse, edges, chol, graph.inc_slot, graph.inc_mask,
-            graph.sel_i, graph.sel_j, graph.rot_c, graph.trn_c)
+            graph.sel_i, graph.sel_j, graph.seln_i, graph.seln_j,
+            graph.rot_c, graph.trn_c)
     elif form == "dense":  # qbuf presence enforced above
         X_upd, gn0 = jax.vmap(
             lambda x, z, e, c, q: _agent_update(x, z, e, params, c, qbuf=q))(

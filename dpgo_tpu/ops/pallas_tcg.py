@@ -1,37 +1,50 @@
-"""Pallas TPU kernel: the whole preconditioned truncated-CG trust-region
-subproblem for one agent, resident in VMEM.
+"""Pallas TPU kernels: the RBCD local trust-region solve, VMEM-resident.
 
 This is the framework's hot loop — the replacement for ROPTLIB's
-``RTRNewton`` inner iteration (reference ``QuadraticOptimizer.cpp:76-90``)
-one level deeper than ``ops.solver.truncated_cg``: the XLA formulation runs
-each tCG iteration as a chain of ~30 small kernels (gathers, per-edge
-einsums, reductions) whose dispatch latency dominates at per-agent problem
-sizes (~25 KB of state, ~50 KB of edges).  Here the entire loop — Hessian-
-vector products, Riemannian corrections, block-Jacobi preconditioning,
-tangent projections, and the Steihaug-Toint logic — executes inside one
-kernel with every operand in VMEM:
+``RTRNewton`` (reference ``QuadraticOptimizer.cpp:76-116``) one level deeper
+than ``ops.solver``: the XLA formulation runs each truncated-CG iteration as
+a chain of ~30 small kernels (gathers, per-edge einsums, reductions) whose
+dispatch latency dominates at per-agent problem sizes (~25 KB of state,
+~50 KB of edges).  Here the solver executes inside a kernel with every
+operand in VMEM:
 
 * Pose gathers/scatters are one-hot matmuls: ``V_i = V @ Sel_i^T`` and
   ``H = g_i @ Sel_i + g_j @ Sel_j`` ride the MXU instead of lowering to
-  serialized scatter ops.  ``Sel_i/Sel_j [E, n]`` are 0/1 selection
-  matrices for the *local* endpoints of each edge (neighbor endpoints give
-  zero rows — exactly the "neighbors are constants" Hessian semantics of
-  ``quadratic.hessvec``).
+  serialized scatter ops.  ``sel_i/sel_j [E, n]`` select the *local*
+  endpoint of each edge (zero rows for neighbor endpoints — exactly the
+  "neighbors are constants" Hessian semantics of ``quadratic.hessvec``);
+  ``seln_i/seln_j [E, s]`` select the neighbor endpoints for cost
+  evaluation.
 * All per-edge and per-pose arithmetic is unrolled over the static
-  ``(r, d)`` components and runs on [E]- / [n]-shaped rows (component-major
-  layout, batch in lanes) — fully lane-parallel VPU work.
-* The d x d / (d+1) x (d+1) math (curvature correction, tangent projection,
-  preconditioner solves) is the same closed-form unrolled style as
+  ``(r, d)`` components on [E]- / [n]-shaped rows (component-major layout,
+  batch in lanes) — fully lane-parallel VPU work; the d x d / (d+1) x (d+1)
+  math (curvature correction, tangent projection, preconditioner solves,
+  Newton-Schulz retraction) is the same closed-form unrolled style as
   ``ops.smallmat``.
 
-Numerics match ``ops.solver.truncated_cg`` (same stopping rule, same
-epsilons); equivalence is asserted in tests/test_pallas_tcg.py, which runs
-the kernel in interpreter mode on CPU.
+Two kernels share the math:
+
+* ``tcg_call`` — the truncated-CG subproblem alone (used by tests as the
+  parity harness against ``ops.solver.truncated_cg``).
+* ``rtr_call`` — the full single-step RTR: the Steihaug-Toint solve plus
+  retraction, cost evaluation, acceptance test, and the
+  shrink-radius-until-accepted retry (reference
+  ``QuadraticOptimizer.cpp:92-110``), all in one kernel invocation per
+  round.
+
+Numerics match the XLA solver (same stopping rules, same epsilons);
+equivalence is asserted in tests/test_pallas_tcg.py, which runs the kernels
+in interpreter mode on CPU.
+
+Known limit: Mosaic's compile helper crashes (opaque HTTP 500) for
+per-agent shapes beyond ~900 edges / ~450 poses on the v5e toolchain; the
+dispatch gates on an empirical ceiling (``models.rbcd.PALLAS_TCG_MAX_*``).
 """
 
 from __future__ import annotations
 
 import functools
+from types import SimpleNamespace
 
 import jax
 import jax.numpy as jnp
@@ -41,31 +54,21 @@ from jax.experimental.pallas import tpu as pltpu
 HI = jax.lax.Precision.HIGHEST
 
 
-def _tcg_kernel(sel_i_ref, sel_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
-                x_ref, scorr_ref, chol_ref, g_ref, radius_ref,
-                eta_ref, heta_ref, stats_ref,
-                *, r: int, d: int, max_iters: int, kappa: float,
-                theta: float):
+def _build_math(sel_i, sel_j, rot, trn, wk, wt, X, S, L, *, r, d,
+                max_iters, kappa, theta):
+    """Closures over the loaded per-agent arrays (component-major layout).
+
+    ``X`` is the expansion point (fixed during a solve): tangent projection
+    and the Riemannian curvature correction are taken at ``X``; ``S =
+    sym(Y^T G_Y)`` per pose; ``L`` the preconditioner Cholesky components.
+    """
     k = d + 1
     rk = r * k
     f32 = jnp.float32
+    eps = jnp.asarray(1e-30, f32)
 
     def q(a, c):  # component row of pose-block entry (a, c)
         return a * k + c
-
-    sel_i = sel_i_ref[...]          # [E, n]
-    sel_j = sel_j_ref[...]
-    rot = rot_ref[...]              # [d*d, E] (row-major R components)
-    trn = trn_ref[...]              # [d, E]
-    wk = wk_ref[...][0]             # [E]
-    wt = wt_ref[...][0]
-    X = x_ref[...]                  # [rk, n]
-    S = scorr_ref[...]              # [d*d, n]  sym(Y^T G_Y) per pose
-    L = chol_ref[...]               # [k*k, n]  lower Cholesky components
-    g = g_ref[...]                  # [rk, n]
-    radius = radius_ref[0, 0]
-
-    eps = jnp.asarray(1e-30, f32)
 
     def dotT(V, Sel):  # [rk, n] x [E, n] -> [rk, E]   (gather)
         return jax.lax.dot_general(V, Sel, (((1,), (1,)), ((), ())),
@@ -81,21 +84,28 @@ def _tcg_kernel(sel_i_ref, sel_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
     def stack(rlist):
         return jnp.stack(rlist, axis=0)
 
+    R = rows(rot)
+    t = rows(trn)
+    Xr = rows(X)
+    Sr = rows(S)
+    Lr = rows(L)
+
+    def edge_residuals(Vi, Vj):
+        """Per-edge lifted residual components from gathered endpoints."""
+        rR = [[Vj[q(a, c)] - sum(Vi[q(a, b)] * R[b * d + c]
+                                 for b in range(d))
+               for c in range(d)] for a in range(r)]
+        rt = [Vj[q(a, d)] - Vi[q(a, d)] - sum(Vi[q(a, b)] * t[b]
+                                              for b in range(d))
+              for a in range(r)]
+        return rR, rt
+
     def hess_euclidean(V):
         """(V Q)_local on the buffer graph: per-edge residual forms of the
         tangent vector, one-hot scatter back (``quadratic.hessvec``)."""
         Vi = rows(dotT(V, sel_i))   # r*k rows of [E]
         Vj = rows(dotT(V, sel_j))
-        R = rows(rot)
-        t = rows(trn)
-        # rR[a][c] = Vj_Y[a,c] - sum_b Vi_Y[a,b] R[b,c]
-        rR = [[Vj[q(a, c)] - sum(Vi[q(a, b)] * R[b * d + c]
-                                 for b in range(d))
-               for c in range(d)] for a in range(r)]
-        # rt[a] = Vj_p[a] - Vi_p[a] - sum_b Vi_Y[a,b] t[b]
-        rt = [Vj[q(a, d)] - Vi[q(a, d)] - sum(Vi[q(a, b)] * t[b]
-                                              for b in range(d))
-              for a in range(r)]
+        rR, rt = edge_residuals(Vi, Vj)
         gj = [None] * rk
         gi = [None] * rk
         for a in range(r):
@@ -108,10 +118,6 @@ def _tcg_kernel(sel_i_ref, sel_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
             gj[q(a, d)] = wt * rt[a]
             gi[q(a, d)] = -wt * rt[a]
         return dot(stack(gi), sel_i) + dot(stack(gj), sel_j)
-
-    Xr = rows(X)
-    Sr = rows(S)
-    Lr = rows(L)
 
     def tangent_project(W):
         """W_Y - Y sym(Y^T W_Y) per pose; translation rows unchanged."""
@@ -168,67 +174,178 @@ def _tcg_kernel(sel_i_ref, sel_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
     def inner(U, V):
         return jnp.sum(U * V)
 
-    # --- Steihaug-Toint tCG (mirrors ops.solver.truncated_cg) ---
-    r0 = g
-    z0 = precond(r0)
-    rz0 = inner(r0, z0)
-    r0n = jnp.sqrt(inner(r0, r0))
-    # theta is static; Mosaic has no powf, so expand the common cases.
-    if theta == 1.0:
-        r0n_th = r0n
-    elif theta == 0.0:
-        r0n_th = jnp.ones_like(r0n)
-    else:
-        r0n_th = jnp.exp(theta * jnp.log(jnp.maximum(r0n, eps)))
-    target = r0n * jnp.minimum(kappa, r0n_th)
-    zero = jnp.zeros_like(g)
+    def tcg(g, radius):
+        """Steihaug-Toint truncated CG (mirrors ops.solver.truncated_cg).
+        Returns (eta, Heta, iters, hit_boundary)."""
+        r0 = g
+        z0 = precond(r0)
+        rz0 = inner(r0, z0)
+        r0n = jnp.sqrt(inner(r0, r0))
+        # theta is static; Mosaic has no powf, so expand the common cases.
+        if theta == 1.0:
+            r0n_th = r0n
+        elif theta == 0.0:
+            r0n_th = jnp.ones_like(r0n)
+        else:
+            r0n_th = jnp.exp(theta * jnp.log(jnp.maximum(r0n, eps)))
+        target = r0n * jnp.minimum(kappa, r0n_th)
+        zero = jnp.zeros_like(g)
 
-    def body(_, s):
-        kit, eta, Heta, rr, z, delta, rz, done, hit = s
-        Hd = hess_riemannian(delta)
-        d_Hd = inner(delta, Hd)
-        alpha = rz / jnp.where(jnp.abs(d_Hd) < eps, eps, d_Hd)
+        def body(s):
+            kit, eta, Heta, rr, z, delta, rz, done, hit = s
+            Hd = hess_riemannian(delta)
+            d_Hd = inner(delta, Hd)
+            alpha = rz / jnp.where(jnp.abs(d_Hd) < eps, eps, d_Hd)
 
-        e_e = inner(eta, eta)
-        e_d = inner(eta, delta)
-        d_d = inner(delta, delta)
-        e_e_next = e_e + 2.0 * alpha * e_d + alpha * alpha * d_d
+            e_e = inner(eta, eta)
+            e_d = inner(eta, delta)
+            d_d = inner(delta, delta)
+            e_e_next = e_e + 2.0 * alpha * e_d + alpha * alpha * d_d
 
-        crossing = (d_Hd <= 0) | (e_e_next >= radius * radius)
-        disc = jnp.maximum(e_d * e_d + d_d * (radius * radius - e_e), 0.0)
-        tau = (-e_d + jnp.sqrt(disc)) / jnp.where(d_d < eps, eps, d_d)
-        step = jnp.where(crossing, tau, alpha)
-        eta_n = eta + step * delta
-        Heta_n = Heta + step * Hd
+            crossing = (d_Hd <= 0) | (e_e_next >= radius * radius)
+            disc = jnp.maximum(e_d * e_d + d_d * (radius * radius - e_e),
+                               0.0)
+            tau = (-e_d + jnp.sqrt(disc)) / jnp.where(d_d < eps, eps, d_d)
+            step = jnp.where(crossing, tau, alpha)
+            eta_n = eta + step * delta
+            Heta_n = Heta + step * Hd
 
-        r_in = rr + alpha * Hd
-        z_in = precond(r_in)
-        rz_in = inner(r_in, z_in)
-        converged = jnp.sqrt(inner(r_in, r_in)) <= target
-        beta = rz_in / jnp.where(jnp.abs(rz) < eps, eps, rz)
-        delta_in = -z_in + beta * delta
+            r_in = rr + alpha * Hd
+            z_in = precond(r_in)
+            rz_in = inner(r_in, z_in)
+            converged = jnp.sqrt(inner(r_in, r_in)) <= target
+            beta = rz_in / jnp.where(jnp.abs(rz) < eps, eps, rz)
+            delta_in = -z_in + beta * delta
+            return (kit + 1.0, eta_n, Heta_n, r_in, z_in, delta_in, rz_in,
+                    done | crossing | converged, hit | crossing)
 
-        # Predicated update: finished lanes keep their state.
-        keep = done
-        eta_o = jnp.where(keep, eta, eta_n)
-        Heta_o = jnp.where(keep, Heta, Heta_n)
-        rr_o = jnp.where(keep, rr, r_in)
-        z_o = jnp.where(keep, z, z_in)
-        delta_o = jnp.where(keep, delta, delta_in)
-        rz_o = jnp.where(keep, rz, rz_in)
-        kit_o = jnp.where(keep, kit, kit + 1.0)
-        done_o = done | crossing | converged
-        hit_o = hit | (~keep & crossing)
-        return (kit_o, eta_o, Heta_o, rr_o, z_o, delta_o, rz_o, done_o,
-                hit_o)
+        def not_done(s):
+            kit, *_, done, _ = s
+            return (kit < max_iters) & ~done
 
-    init = (jnp.asarray(0.0, f32), zero, zero, r0, z0, -z0, rz0,
-            rz0 <= 0, jnp.asarray(False))
-    kit, eta, Heta, *_, hit = jax.lax.fori_loop(0, max_iters, body, init)
+        init = (jnp.asarray(0.0, f32), zero, zero, r0, z0, -z0, rz0,
+                rz0 <= 0, jnp.asarray(False))
+        kit, eta, Heta, *_, hit = jax.lax.while_loop(not_done, body, init)
+        return eta, Heta, kit, hit
 
+    def retract(V):
+        """R_X(V): per-pose Newton-Schulz polar of (Y + V_Y), translation
+        add (``manifold.retract`` / ``smallmat.polar_orthonormalize``)."""
+        Vr = rows(V)
+        M = [[Xr[q(a, c)] + Vr[q(a, c)] for c in range(d)]
+             for a in range(r)]
+        # A = M^T M  (d x d symmetric, components over [n])
+        A = [[sum(M[a][b] * M[a][c] for a in range(r)) for c in range(d)]
+             for b in range(d)]
+        s = sum(A[b][b] for b in range(d))
+        s = jnp.maximum(s, jnp.asarray(1e-37, f32))
+        An = stack([stack([A[b][c] / s for c in range(d)]) for b in range(d)])
+        one = jnp.ones_like(An[0, 0])
+        eye = stack([stack([one if b == c else jnp.zeros_like(one)
+                            for c in range(d)]) for b in range(d)])
+
+        def matmul3(P, Q):
+            return stack([stack([
+                sum(P[b, e] * Q[e, c] for e in range(d))
+                for c in range(d)]) for b in range(d)])
+
+        def sweep(_, YZ):
+            Y, Z = YZ
+            T = 0.5 * (3.0 * eye - matmul3(Z, Y))
+            return matmul3(Y, T), matmul3(T, Z)
+
+        _, Zc = jax.lax.fori_loop(0, 24, sweep, (An, eye))
+        inv_sqrt_s = jax.lax.rsqrt(s)
+        out = [None] * rk
+        for a in range(r):
+            for c in range(d):
+                out[q(a, c)] = sum(M[a][b] * Zc[b, c] for b in range(d)) \
+                    * inv_sqrt_s
+            out[q(a, d)] = Xr[q(a, d)] + Vr[q(a, d)]
+        return stack(out)
+
+    return SimpleNamespace(tcg=tcg, inner=inner, retract=retract,
+                           edge_residuals=edge_residuals, rows=rows,
+                           stack=stack, dotT=dotT, q=q)
+
+
+def _tcg_kernel(sel_i_ref, sel_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
+                x_ref, scorr_ref, chol_ref, g_ref, radius_ref,
+                eta_ref, heta_ref, stats_ref,
+                *, r: int, d: int, max_iters: int, kappa: float,
+                theta: float):
+    m = _build_math(sel_i_ref[...], sel_j_ref[...], rot_ref[...],
+                    trn_ref[...], wk_ref[...][0], wt_ref[...][0],
+                    x_ref[...], scorr_ref[...], chol_ref[...],
+                    r=r, d=d, max_iters=max_iters, kappa=kappa, theta=theta)
+    eta, Heta, kit, hit = m.tcg(g_ref[...], radius_ref[0, 0])
     eta_ref[...] = eta
     heta_ref[...] = Heta
-    stats_ref[...] = jnp.stack([kit, hit.astype(f32)]).reshape(1, 2)
+    stats_ref[...] = jnp.stack([kit, hit.astype(jnp.float32)]).reshape(1, 2)
+
+
+def _rtr_kernel(sel_i_ref, sel_j_ref, seln_i_ref, seln_j_ref, rot_ref,
+                trn_ref, wk_ref, wt_ref, x_ref, z_ref, scorr_ref, chol_ref,
+                g_ref, x_out_ref, stats_ref,
+                *, r: int, d: int, max_iters: int, kappa: float,
+                theta: float, initial_radius: float, max_rejections: int):
+    """Full single-step RTR (reference ``QuadraticOptimizer.cpp:92-110``):
+    repeat {tCG at current radius; retract; evaluate cost; accept when
+    rho > 0.1 and the cost does not increase; else radius /= 4} at most
+    ``max_rejections`` times; on total rejection X is returned unchanged."""
+    f32 = jnp.float32
+    X = x_ref[...]
+    Z = z_ref[...]
+    g = g_ref[...]
+    seln_i = seln_i_ref[...]
+    seln_j = seln_j_ref[...]
+    wk = wk_ref[...][0]
+    wt = wt_ref[...][0]
+    m = _build_math(sel_i_ref[...], sel_j_ref[...], rot_ref[...],
+                    trn_ref[...], wk, wt, X, scorr_ref[...], chol_ref[...],
+                    r=r, d=d, max_iters=max_iters, kappa=kappa, theta=theta)
+
+    def cost(V):
+        """f over the full buffer: local candidate V plus fixed neighbors Z
+        (``quadratic.cost`` semantics)."""
+        Vi = m.rows(m.dotT(V, sel_i_ref[...])
+                    + m.dotT(Z, seln_i))
+        Vj = m.rows(m.dotT(V, sel_j_ref[...])
+                    + m.dotT(Z, seln_j))
+        rR, rt = m.edge_residuals(Vi, Vj)
+        quad = wk * sum(rR[a][c] * rR[a][c]
+                        for a in range(r) for c in range(d)) \
+            + wt * sum(rt[a] * rt[a] for a in range(r))
+        return 0.5 * jnp.sum(quad)
+
+    f0 = cost(X)
+    eps = jnp.asarray(1e-30, f32)
+
+    def attempt_body(s):
+        k_att, radius, X_best, f_best, accepted = s
+        eta, Heta, _, _ = m.tcg(g, radius)
+        X_prop = m.retract(eta)
+        f_prop = cost(X_prop)
+        mdec = -(m.inner(g, eta) + 0.5 * m.inner(eta, Heta))
+        rho = (f0 - f_prop) / jnp.maximum(mdec, eps)
+        ok = (rho > 0.1) & (f_prop <= f0)
+        X_n = jnp.where(ok, X_prop, X_best)
+        f_n = jnp.where(ok, f_prop, f_best)
+        return (k_att + 1.0, jnp.where(ok, radius, radius / 4.0),
+                X_n, f_n, accepted | ok)
+
+    def attempt_cond(s):
+        k_att, _, _, _, accepted = s
+        return (k_att < max_rejections) & ~accepted
+
+    init = (jnp.asarray(0.0, f32), jnp.asarray(initial_radius, f32),
+            X, f0, jnp.asarray(False))
+    k_att, _, X_out, f_out, accepted = jax.lax.while_loop(
+        attempt_cond, attempt_body, init)
+
+    x_out_ref[...] = X_out
+    stats_ref[...] = jnp.stack(
+        [k_att, accepted.astype(f32), f0, f_out]).reshape(1, 4)
 
 
 def comp_major(X: jax.Array) -> jax.Array:
@@ -248,13 +365,12 @@ def comp_minor(Xc: jax.Array, r: int, k: int) -> jax.Array:
 def tcg_call(sel_i, sel_j, rot, trn, wk, wt, Xc, Sc, Lc, gc, radius,
              *, r: int, d: int, max_iters: int, kappa: float, theta: float,
              interpret: bool = False):
-    """Invoke the kernel for one agent (vmap adds the agent grid axis).
+    """Invoke the tCG kernel for one agent (vmap adds the agent grid axis).
 
     All tensor operands are component-major float32; ``radius`` is [1, 1].
     Returns (eta_c [rk, n], heta_c [rk, n], stats [1, 2] = (iters, hit)).
     """
     rk, n = Xc.shape
-    E = sel_i.shape[0]
     kern = functools.partial(_tcg_kernel, r=r, d=d, max_iters=max_iters,
                              kappa=kappa, theta=theta)
     vspec = pl.BlockSpec(memory_space=pltpu.VMEM)
@@ -269,3 +385,32 @@ def tcg_call(sel_i, sel_j, rot, trn, wk, wt, Xc, Sc, Lc, gc, radius,
         out_specs=(vspec, vspec, vspec),
         interpret=interpret,
     )(sel_i, sel_j, rot, trn, wk, wt, Xc, Sc, Lc, gc, radius)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "r", "d", "max_iters", "kappa", "theta", "initial_radius",
+    "max_rejections", "interpret"))
+def rtr_call(sel_i, sel_j, seln_i, seln_j, rot, trn, wk, wt, Xc, Zc, Sc, Lc,
+             gc, *, r: int, d: int, max_iters: int, kappa: float,
+             theta: float, initial_radius: float, max_rejections: int,
+             interpret: bool = False):
+    """Invoke the full single-step RTR kernel for one agent.
+
+    Returns (X_out_c [rk, n], stats [1, 4] = (attempts, accepted, f0, f)).
+    """
+    rk, n = Xc.shape
+    kern = functools.partial(_rtr_kernel, r=r, d=d, max_iters=max_iters,
+                             kappa=kappa, theta=theta,
+                             initial_radius=initial_radius,
+                             max_rejections=max_rejections)
+    vspec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kern,
+        out_shape=(
+            jax.ShapeDtypeStruct((rk, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, 4), jnp.float32),
+        ),
+        in_specs=[vspec] * 13,
+        out_specs=(vspec, vspec),
+        interpret=interpret,
+    )(sel_i, sel_j, seln_i, seln_j, rot, trn, wk, wt, Xc, Zc, Sc, Lc, gc)
