@@ -61,9 +61,12 @@ def time_rounds(device, dtype, rounds):
     state = jax.device_put(state, device)
     graph = jax.device_put(graph, device)
 
-    step = lambda s: rbcd.rbcd_step(s, graph, meta, params)
+    # Fused stepping (rbcd.rbcd_steps): the whole trial runs as one on-device
+    # fori_loop of full rounds — pose exchange + per-agent RTR each — so the
+    # measurement excludes host/tunnel dispatch, which otherwise dominates.
+    steps = lambda s, k: rbcd.rbcd_steps(s, graph, k, meta, params)
     t0 = time.perf_counter()
-    state = step(state)
+    state = steps(state, 1)
     _ = np.asarray(state.X)
     log(f"  [{device.platform}] compile+first round: "
         f"{time.perf_counter() - t0:.1f}s")
@@ -74,10 +77,8 @@ def time_rounds(device, dtype, rounds):
     rates = []
     state0 = state
     for _ in range(3):
-        state = state0
         t0 = time.perf_counter()
-        for _ in range(rounds):
-            state = step(state)
+        state = steps(state0, rounds)
         # Device->host readback, NOT block_until_ready: on this image's
         # experimental tunneled TPU platform, block_until_ready empirically
         # returns before execution finishes (measured: 100 chained rounds
@@ -87,6 +88,7 @@ def time_rounds(device, dtype, rounds):
         Xh = np.asarray(state.X)
         dt = time.perf_counter() - t0
         assert bool(np.isfinite(Xh).all()), "non-finite state"
+        assert int(state.iteration) == int(state0.iteration) + rounds
         rates.append(rounds / dt)
         log(f"  [{device.platform}] trial: {rounds / dt:.1f} rounds/s")
     return float(np.median(rates))

@@ -821,6 +821,30 @@ rbcd_step = jax.jit(_rbcd_round, static_argnames=(
     "meta", "params", "axis_name", "update_weights", "restart"))
 
 
+def _rbcd_rounds(state: RBCDState, graph: MultiAgentGraph, num_rounds,
+                 meta: GraphMeta, params: AgentParams,
+                 axis_name: str | None = None) -> RBCDState:
+    """``num_rounds`` consecutive *plain* rounds (no weight update, no
+    restart) as one on-device ``fori_loop``.
+
+    The per-round jitted step leaves the host in the loop: every round pays
+    a dispatch (an RPC round-trip on a tunneled TPU), which dominates once
+    the device-side round is fast.  Fusing rounds keeps the whole schedule
+    segment on-device — one dispatch per segment, identical math (the body
+    is ``_rbcd_round`` itself, so single-round and fused traces agree).
+    ``num_rounds`` is a traced scalar: one compile serves every segment
+    length."""
+    body = lambda _i, s: _rbcd_round(s, graph, meta, params,
+                                     axis_name=axis_name)
+    return jax.lax.fori_loop(0, num_rounds, body, state)
+
+
+#: Jitted fused rounds (single-device; ``parallel.make_sharded_multi_step``
+#: embeds the same loop inside shard_map for the mesh path).
+rbcd_steps = jax.jit(_rbcd_rounds, static_argnames=(
+    "meta", "params", "axis_name"))
+
+
 # ---------------------------------------------------------------------------
 # Initialization, rounding, and the high-level driver
 # ---------------------------------------------------------------------------
@@ -948,6 +972,7 @@ def run_rbcd(
     eval_every: int = 1,
     dtype=jnp.float64,
     params: AgentParams | None = None,
+    multi_step=None,
 ) -> RBCDResult:
     """The driver loop shared by the single-device and mesh-sharded solvers —
     the analog of the ``multi-robot-example`` loop
@@ -960,6 +985,13 @@ def run_rbcd(
     ``step(state, update_weights, restart)`` receives the two host-side
     static schedule flags each round.  ``params`` drives the GNC /
     acceleration schedules (omit for plain L2 RBCD).
+
+    ``multi_step(state, k)``, when given, runs ``k`` consecutive plain
+    rounds in one device call (``rbcd_steps`` / the shard_map equivalent);
+    the driver then dispatches once per schedule segment — the stretch
+    between weight-update/restart/eval rounds — instead of once per round,
+    which removes the host round-trip that dominates wall-clock on fast
+    devices.  Identical math either way (the fused body is ``_rbcd_round``).
     """
     n_total = part.meas_global.num_poses
     num_meas = len(part.meas_global)
@@ -981,7 +1013,8 @@ def run_rbcd(
     terminated_by = "max_iters"
     it = 0
     num_weight_updates = 0
-    for it in range(max_iters):
+    cap = params.robust_opt_num_weight_updates if params is not None else 0
+    while it < max_iters:
         # The modular counters of the reference (shouldUpdateLoopClosure-
         # Weights / shouldRestart, PGOAgent.cpp:1174-1179, 1033-1038) live on
         # the host: round variants compile branch-free.  Beyond-reference:
@@ -991,16 +1024,32 @@ def run_rbcd(
         # with warm start disabled, would keep resetting the iterate and
         # prevent the solve from ever settling; the cap also bounds the
         # mu <- 1.4 mu growth.
-        update_w = robust_on and \
-            (it + 1) % params.robust_opt_inner_iters == 0 and \
-            (params.robust_opt_num_weight_updates <= 0 or
-             num_weight_updates < params.robust_opt_num_weight_updates)
-        num_weight_updates += int(update_w)
+        updates_remaining = robust_on and (cap <= 0 or num_weight_updates < cap)
+        update_w = updates_remaining and \
+            (it + 1) % params.robust_opt_inner_iters == 0
         restart = accel_on and (it + 1) % params.restart_interval == 0
-        state = step(state, update_w, restart)
+        if update_w or restart or multi_step is None:
+            num_weight_updates += int(update_w)
+            state = step(state, update_w, restart)
+            it += 1
+        else:
+            # Fuse the plain rounds up to (exclusive) the next flagged round
+            # and (inclusive) the next eval boundary into one device call.
+            end = max_iters
+            if updates_remaining:
+                end = min(end, ((it // params.robust_opt_inner_iters) + 1)
+                          * params.robust_opt_inner_iters - 1)
+            if accel_on:
+                end = min(end, ((it // params.restart_interval) + 1)
+                          * params.restart_interval - 1)
+            end = min(max(end, it + 1),
+                      ((it // eval_every) + 1) * eval_every, max_iters)
+            k = end - it
+            state = multi_step(state, k) if k > 1 else step(state, False, False)
+            it = end
         # Host syncs (metrics readback + consensus flag) only every
         # eval_every rounds so device dispatch stays ahead of the host.
-        if (it + 1) % eval_every == 0:
+        if it % eval_every == 0 or it >= max_iters:
             f, gn = central_metrics(state.X, state.weights)
             cost_hist.append(float(f))
             gn_hist.append(float(gn))
@@ -1015,7 +1064,7 @@ def run_rbcd(
     Xg = gather_to_global(state.X, graph, n_total)
     T = round_global(Xg, ylift)
     return RBCDResult(T=T, X=state.X, cost_history=cost_hist,
-                      grad_norm_history=gn_hist, iterations=it + 1,
+                      grad_norm_history=gn_hist, iterations=it,
                       terminated_by=terminated_by,
                       weights=global_weights(state.weights, graph, num_meas))
 
@@ -1057,5 +1106,7 @@ def solve_rbcd(
     state = init_state(graph, meta, X0, params=params)
     step = lambda s, uw, rs: rbcd_step(s, graph, meta, params,
                                        update_weights=uw, restart=rs)
+    multi = lambda s, k: rbcd_steps(s, graph, k, meta, params)
     return run_rbcd(state, graph, meta, step, part, max_iters,
-                    grad_norm_tol, eval_every, dtype, params=params)
+                    grad_norm_tol, eval_every, dtype, params=params,
+                    multi_step=multi)

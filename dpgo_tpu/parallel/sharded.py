@@ -109,6 +109,27 @@ def make_sharded_step(mesh: Mesh, meta: GraphMeta, params: AgentParams):
     return step
 
 
+def make_sharded_multi_step(mesh: Mesh, meta: GraphMeta, params: AgentParams):
+    """Compile the fused plain-round loop for the mesh path: ``k`` consecutive
+    rounds (collective pose exchange included in each) as one on-device
+    ``fori_loop`` inside shard_map — one dispatch per schedule segment
+    instead of per round (see ``models.rbcd.rbcd_steps``).  ``k`` is traced,
+    so one compile serves every segment length."""
+
+    @jax.jit
+    def steps(state: RBCDState, graph: MultiAgentGraph, num_rounds) -> RBCDState:
+        def body(s, g, n):
+            return rbcd._rbcd_rounds(s, g, n, meta, params, axis_name=AXIS)
+
+        in_specs = (_specs(mesh, state), _specs(mesh, graph), P())
+        out_specs = _specs(mesh, state)
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs,
+                             check_vma=False)(state, graph, num_rounds)
+
+    return steps
+
+
 def solve_rbcd_sharded(
     meas: Measurements,
     num_robots: int,
@@ -136,6 +157,9 @@ def solve_rbcd_sharded(
     state, graph = shard_problem(mesh, state, graph)
 
     sharded_step = make_sharded_step(mesh, meta, params)
+    sharded_multi = make_sharded_multi_step(mesh, meta, params)
     step = lambda s, uw, rs: sharded_step(s, graph, update_weights=uw, restart=rs)
+    multi = lambda s, k: sharded_multi(s, graph, k)
     return rbcd.run_rbcd(state, graph, meta, step, part, max_iters,
-                         grad_norm_tol, eval_every, dtype, params=params)
+                         grad_norm_tol, eval_every, dtype, params=params,
+                         multi_step=multi)

@@ -206,6 +206,72 @@ def test_package_sets_full_matmul_precision():
     assert jax.config.jax_default_matmul_precision == expected
 
 
+def test_fused_rounds_match_sequential(rng):
+    """``rbcd_steps(k)`` (the one-dispatch fori_loop) must reproduce k
+    sequential ``rbcd_step`` calls exactly — same trace body, same math."""
+    meas, _ = make_measurements(rng, n=20, d=3, num_lc=8,
+                                rot_noise=0.03, trans_noise=0.03)
+    params = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.JACOBI)
+    part = partition_contiguous(meas, 4)
+    graph, meta = rbcd.build_graph(part, params.r, jnp.float64)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float64)
+    state0 = rbcd.init_state(graph, meta, X0, params=params)
+
+    seq = state0
+    for _ in range(5):
+        seq = rbcd.rbcd_step(seq, graph, meta, params)
+    fused = rbcd.rbcd_steps(state0, graph, 5, meta, params)
+
+    assert int(fused.iteration) == int(seq.iteration) == 5
+    assert np.allclose(np.asarray(fused.X), np.asarray(seq.X), atol=1e-12)
+    assert np.allclose(np.asarray(fused.rel_change),
+                       np.asarray(seq.rel_change), atol=1e-12)
+
+
+def test_solver_uses_fused_segments(rng, monkeypatch):
+    """``solve_rbcd`` with ``eval_every > 1`` must route plain stretches
+    through the fused path (dispatch count shrinks) and still converge to the
+    same answer as per-round stepping."""
+    meas, (Rs, ts) = make_measurements(rng, n=20, d=3, num_lc=10)
+    params = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.JACOBI)
+
+    calls = {"fused": 0}
+    orig = rbcd.rbcd_steps
+
+    def counting(state, graph, k, *a, **kw):
+        calls["fused"] += 1
+        return orig(state, graph, k, *a, **kw)
+
+    monkeypatch.setattr(rbcd, "rbcd_steps", counting)
+    res = rbcd.solve_rbcd(meas, 4, params, max_iters=60, grad_norm_tol=1e-6,
+                          eval_every=10)
+    assert calls["fused"] >= 1
+    assert res.grad_norm_history[-1] < 1e-6
+    assert trajectory_error(res.T, Rs, ts) < 1e-4
+
+
+def test_fused_segments_respect_gnc_and_restart_schedule(rng):
+    """With acceleration + GNC active, the fused driver must fire the same
+    weight-update/restart rounds as the per-round driver: identical final
+    weights and iterates for eval_every 1 vs 7."""
+    from dpgo_tpu.config import RobustCostParams, RobustCostType
+
+    meas, _ = make_measurements(rng, n=20, d=3, num_lc=10, outlier_lc=3,
+                                rot_noise=0.01, trans_noise=0.01)
+    params = AgentParams(
+        d=3, r=5, num_robots=4, schedule=Schedule.JACOBI, acceleration=True,
+        robust=RobustCostParams(cost_type=RobustCostType.GNC_TLS),
+        robust_opt_inner_iters=10, restart_interval=15,
+        rel_change_tol=1e-14)  # keep the consensus gate out of the picture
+    res_a = rbcd.solve_rbcd(meas, 4, params, max_iters=40, grad_norm_tol=0.0,
+                            eval_every=1)
+    res_b = rbcd.solve_rbcd(meas, 4, params, max_iters=40, grad_norm_tol=0.0,
+                            eval_every=7)
+    assert np.allclose(np.asarray(res_a.weights), np.asarray(res_b.weights),
+                       atol=1e-12)
+    assert np.allclose(np.asarray(res_a.X), np.asarray(res_b.X), atol=1e-10)
+
+
 def test_egrad_ell_matches_scatter(rng):
     """The gather-only ELL gradient/Hessian path must agree with the
     scatter-add reference formulation on every agent."""

@@ -123,6 +123,33 @@ def test_sharded_solve_robust_accel(rng):
     assert np.all(w[:-4] > 0.99)
 
 
+def test_sharded_fused_rounds_match_per_round(rng):
+    """The fused mesh loop (fori_loop inside shard_map, one dispatch) must
+    reproduce per-round sharded stepping exactly."""
+    from dpgo_tpu.parallel import make_sharded_multi_step
+
+    meas, _ = make_measurements(rng, n=48, d=3, num_lc=14, rot_noise=0.01,
+                                trans_noise=0.01)
+    params = AgentParams(d=3, r=5, num_robots=8, schedule=Schedule.JACOBI)
+    _, graph, meta, state = _setup(meas, 8, params)
+
+    mesh = make_mesh(8)
+    sh_state, sh_graph = shard_problem(mesh, state, graph)
+    step = make_sharded_step(mesh, meta, params)
+    multi = make_sharded_multi_step(mesh, meta, params)
+
+    seq = sh_state
+    for _ in range(4):
+        seq = step(seq, sh_graph)
+    fused = multi(sh_state, sh_graph, 4)
+
+    assert int(fused.iteration) == 4
+    np.testing.assert_allclose(np.asarray(fused.X), np.asarray(seq.X),
+                               atol=1e-12)
+    np.testing.assert_allclose(np.asarray(fused.rel_change),
+                               np.asarray(seq.rel_change), atol=1e-12)
+
+
 def test_mesh_size_divisibility(rng):
     meas, _ = make_measurements(rng, n=24, d=3, num_lc=5)
     params = AgentParams(d=3, r=5, num_robots=6)
