@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import os
 import threading
 
 import jax
@@ -51,6 +52,7 @@ import numpy as np
 from .config import AgentParams, RobustCostType
 from . import robust as robust_mod
 from .types import EdgeSet, Measurements
+from .utils import logger as logger_mod
 from .utils.lie import lifting_matrix as make_lifting_matrix
 from .ops import chordal, manifold, quadratic
 from .models.rbcd import _agent_update, _edge_residuals
@@ -529,6 +531,10 @@ class PGOAgent:
                 return False
             params = self.params
             self._status.iteration_number += 1
+            # Early-stop trajectory snapshot at iteration 50
+            # (reference iterate(), PGOAgent.cpp:646-651).
+            if self._status.iteration_number == 50 and params.log_data:
+                self._log_global_trajectory("trajectory_early_stop.csv")
             robust_on = params.robust.cost_type != RobustCostType.L2
             if robust_on and \
                     self._status.iteration_number % params.robust_opt_inner_iters == 0 and \
@@ -631,15 +637,75 @@ class PGOAgent:
 
     def reset(self) -> None:
         """Roll to the next problem instance keeping the lifting matrix
-        (``reset``, ``PGOAgent.cpp:583-640``)."""
+        (``reset``, ``PGOAgent.cpp:583-640``), dumping the solve's data first
+        when logging is enabled (``:587-603``)."""
         # Join the loop thread BEFORE taking the lock: the thread's iterate()
         # needs the lock, so joining under it would deadlock.
         self.end_optimization_loop()
         with self._lock:
+            if self.params.log_data:
+                self._log_measurements("measurements.csv")
+                self._log_global_trajectory("trajectory_optimized.csv")
+                self._log_x("X.txt")
             instance = self._status.instance_number + 1
             self._clear_problem()
             self._status.instance_number = instance
             self._neighbor_status.clear()
+
+    def log_trajectory(self) -> None:
+        """Mid-run dump with per-robot file names (reference
+        ``log_trajectory``, ``PGOAgent.cpp:1301-1319``): measurements incl.
+        current GNC weights, the rounded global-frame trajectory as
+        ``robot{id}+trajectory_optimized.csv``, and the raw lifted iterate as
+        ``{id}_X.txt``."""
+        with self._lock:
+            if not self.params.log_data:
+                return
+            self._log_measurements("measurements.csv")
+            self._log_global_trajectory(
+                f"robot{self.robot_id}+trajectory_optimized.csv")
+            self._log_x(f"{self.robot_id}_X.txt")
+
+    # -- data logging (reference PGOLogger wiring) --------------------------
+
+    def _log_path(self, name: str) -> str:
+        """Per-robot dump location ``log_directory/robot{id}/``.
+
+        The reference runs one process per robot, each with its own
+        ``logDirectory``; here many agents commonly share one ``AgentParams``
+        (in-process examples/tests), so a flat directory would have robots
+        silently overwriting each other's fixed-name dumps — the per-robot
+        subdirectory keeps the reference's file names collision-free."""
+        directory = os.path.join(self.params.log_directory or ".",
+                                 f"robot{self.robot_id}")
+        os.makedirs(directory, exist_ok=True)
+        return os.path.join(directory, name)
+
+    def _log_measurements(self, name: str) -> None:
+        """All of this robot's measurements with their live GNC weights
+        (reference reset()/log_trajectory(), PGOAgent.cpp:587-593)."""
+        if self._meas is None:
+            return
+        meas = dataclasses.replace(
+            self._meas, weight=np.asarray(self._weights, np.float64).copy())
+        logger_mod.log_measurements(meas, self._log_path(name))
+
+    def _log_global_trajectory(self, name: str) -> None:
+        """Rounded global-frame trajectory; skipped (like the reference's
+        ``if getTrajectoryInGlobalFrame(T)``) when the agent is not
+        initialized or no anchor is known yet."""
+        if self.X is None or self.get_global_anchor() is None:
+            return
+        logger_mod.log_trajectory(self.trajectory_in_global_frame(),
+                                  self._log_path(name))
+
+    def _log_x(self, name: str) -> None:
+        """Raw lifted iterate before rounding (``writeMatrixToFile(X, ...)``,
+        PGOAgent.cpp:602; layout [r, (d+1)n] like the reference's X)."""
+        if self.X is None:
+            return
+        X2d = np.asarray(self.X).transpose(1, 0, 2).reshape(self.r, -1)
+        logger_mod.save_matrix(X2d, self._log_path(name))
 
     # -- diagnostics --------------------------------------------------------
 

@@ -137,3 +137,12 @@ class AgentParams:
     # analog; each agent updates independently with this probability)
     async_update_prob: float = 0.5
     verbose: bool = False
+    # Data logging (reference logData/logDirectory, PGOAgent.h:131-136):
+    # when enabled the per-robot runtime dumps trajectory/measurement CSVs
+    # and the raw lifted X on reset() and an early-stop trajectory snapshot
+    # at iteration 50 (PGOAgent.cpp:583-603, 646-651).  Each agent writes
+    # under log_directory/robot{id}/ — unlike the reference's one-process-
+    # per-robot layout, one AgentParams is commonly shared by all agents
+    # here, and a flat directory would collide on the fixed file names.
+    log_data: bool = False
+    log_directory: str = ""

@@ -19,7 +19,14 @@ from ..config import RobustCostParams, RobustCostType
 from ..utils.lie import project_to_rotation
 from .. import robust
 
-_W_TOL = 1e-8  # weight convergence tolerance (reference DPGO_utils.cpp:585)
+def _w_tol(dtype) -> float:
+    """Weight convergence tolerance (reference 1e-8, DPGO_utils.cpp:585),
+    widened to a few ulps of the compute dtype when that is coarser: in
+    float32 the spacing around 1.0 is ~1.2e-7, so ``1.0 - 1e-8`` rounds to
+    exactly 1.0 and ``w > 1.0 - 1e-8`` would hold for NO weight — GNC
+    averaging would report zero inliers even on perfectly agreeing
+    measurements (the TPU deployment precision)."""
+    return max(1e-8, 32.0 * float(jnp.finfo(dtype).eps))
 
 
 def single_translation_averaging(ts: jax.Array, tau: jax.Array | None = None,
@@ -57,7 +64,7 @@ def single_pose_averaging(Rs, ts, kappa=None, tau=None, mask=None):
 class RobustAveragingResult(NamedTuple):
     R: jax.Array  # [d, d] averaged rotation
     t: jax.Array  # [d] averaged translation (zeros for rotation-only)
-    inlier_mask: jax.Array  # [k] bool, weight > 1 - 1e-8
+    inlier_mask: jax.Array  # [k] bool, weight > 1 - tol (see _w_tol)
     weights: jax.Array  # [k] final GNC weights
 
 
@@ -71,14 +78,20 @@ def _gnc_averaging_loop(solve_fn, residual_sq_fn, init_sol, barc: float,
     mu0 <= 0 (all residuals already small); stop when every weight has
     converged to {0, 1}.
     """
+    # A numpy scalar barc would silently promote float32 weights to float64
+    # inside the while_loop carry (numpy scalars are strongly typed under
+    # x64); a Python float is weakly typed and preserves the input dtype.
+    barc = float(barc)
     barc_sq = barc * barc
     r_sq0 = residual_sq_fn(init_sol, weights0)
     max_r_sq = jnp.max(jnp.where(mask > 0, r_sq0, 0.0))
     mu_init = jnp.minimum(barc_sq / (2.0 * max_r_sq - barc_sq), 1e-5)
     params = RobustCostParams(cost_type=RobustCostType.GNC_TLS, gnc_barc=barc)
 
+    tol = _w_tol(weights0.dtype)
+
     def converged(w):
-        conv = (w < _W_TOL) | (w > 1.0 - _W_TOL)
+        conv = (w < tol) | (w > 1.0 - tol)
         return jnp.all(conv | (mask <= 0))
 
     def cond(state):
@@ -131,7 +144,7 @@ def robust_single_rotation_averaging(
     weights, R = _gnc_averaging_loop(solve, residual_sq, R0, error_threshold,
                                      max_iters, jnp.ones(k, Rs.dtype) * mask_, mask_)
     R = solve(weights)
-    inliers = (weights > 1.0 - _W_TOL) & (mask_ > 0)
+    inliers = (weights > 1.0 - _w_tol(weights.dtype)) & (mask_ > 0)
     return RobustAveragingResult(R=R, t=jnp.zeros(Rs.shape[-1], Rs.dtype),
                                  inlier_mask=inliers, weights=weights)
 
@@ -169,5 +182,5 @@ def robust_single_pose_averaging(
     weights, sol = _gnc_averaging_loop(solve, residual_sq, sol0, error_threshold,
                                        max_iters, jnp.ones(k, Rs.dtype) * mask_, mask_)
     R, t = solve(weights)
-    inliers = (weights > 1.0 - _W_TOL) & (mask_ > 0)
+    inliers = (weights > 1.0 - _w_tol(weights.dtype)) & (mask_ > 0)
     return RobustAveragingResult(R=R, t=t, inlier_mask=inliers, weights=weights)

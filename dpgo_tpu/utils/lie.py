@@ -176,8 +176,11 @@ def angular_to_chordal_so3(rad: float) -> float:
     """Angular distance (radians) -> chordal (Frobenius) distance on SO(3).
 
     Reference ``angular2ChordalSO3`` (``DPGO_utils.cpp:522-524``).
+
+    Returns a Python float: a ``np.float64`` scalar is strongly typed under
+    jax_enable_x64 and would promote float32 GNC arithmetic to float64.
     """
-    return 2.0 * np.sqrt(2.0) * np.sin(rad / 2.0)
+    return float(2.0 * np.sqrt(2.0) * np.sin(rad / 2.0))
 
 
 def chi2inv(quantile: float, dof: int) -> float:

@@ -267,6 +267,52 @@ def test_pose_message_before_lifting_matrix_defers():
     assert a1.get_status().state == AgentState.INITIALIZED
 
 
+def test_log_data_dumps_on_reset_and_iter50(tmp_path):
+    """logData wiring (reference PGOAgent.cpp:583-603, 646-651, 1301-1319):
+    reset() writes measurements.csv / trajectory_optimized.csv / X.txt, the
+    iteration-50 snapshot writes trajectory_early_stop.csv, log_trajectory()
+    the per-robot-named files — and the CSVs round-trip through the
+    loaders."""
+    from dpgo_tpu.utils import logger as logger_mod
+
+    agents, part, T_true = make_agents(
+        2, n=10, num_lc=4, log_data=True, log_directory=str(tmp_path))
+    exchange(agents)
+    broadcast_anchor(agents)
+    n0, n1 = agents[0].n, agents[1].n
+    for it in range(51):
+        exchange(agents)
+        for ag in agents:
+            ag.iterate(True)
+    # Every robot dumps into its own subdirectory — shared AgentParams must
+    # not make robots overwrite each other's fixed-name files.
+    for rid in (0, 1):
+        assert (tmp_path / f"robot{rid}" / "trajectory_early_stop.csv").exists()
+
+    agents[0].log_trajectory()
+    assert (tmp_path / "robot0" / "robot0+trajectory_optimized.csv").exists()
+    assert (tmp_path / "robot0" / "0_X.txt").exists()
+
+    for ag in agents:
+        ag.reset()
+    for rid in (0, 1):
+        for name in ("measurements.csv", "trajectory_optimized.csv", "X.txt"):
+            assert (tmp_path / f"robot{rid}" / name).exists(), (rid, name)
+
+    T = logger_mod.load_trajectory(
+        str(tmp_path / "robot0" / "trajectory_optimized.csv"))
+    assert T.shape == (n0, 3, 4)
+    m = logger_mod.load_measurements(
+        str(tmp_path / "robot0" / "measurements.csv"))
+    assert len(m) > 0
+    X = logger_mod.load_matrix(str(tmp_path / "robot0" / "X.txt"))
+    assert X.shape == (5, 4 * n0)
+    # Distinct content per robot: robot1's trajectory has robot1's length.
+    T1 = logger_mod.load_trajectory(
+        str(tmp_path / "robot1" / "trajectory_optimized.csv"))
+    assert T1.shape == (n1, 3, 4)
+
+
 def test_reset_rolls_instance():
     agents, part, _ = make_agents(1, n=8, num_lc=4)
     (ag,) = agents

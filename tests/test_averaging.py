@@ -83,6 +83,36 @@ def test_robust_pose_averaging_outliers(rng):
     assert np.linalg.norm(np.asarray(res.t) - t) < 0.05
 
 
+def test_robust_averaging_float32(rng):
+    """Regression: in float32 the inlier test ``w > 1 - 1e-8`` folds to
+    ``w > 1`` (1e-8 is below the f32 spacing at 1.0) and every weight —
+    including exact 1s — stopped counting as an inlier, so distributed
+    initialization found 0 inliers at TPU deployment precision.  The
+    tolerance is now dtype-aware."""
+    R = random_rotation(rng)
+    # Exact agreement, f32: all inliers, loop must terminate via skip path.
+    Rs = jnp.asarray(np.stack([R] * 4), jnp.float32)
+    res = averaging.robust_single_rotation_averaging(Rs)
+    assert res.weights.dtype == jnp.float32
+    assert res.inlier_mask.tolist() == [True] * 4
+
+    # Inliers + outliers, f32: exact inlier-set recovery still works.
+    inliers = [perturbed(R, rng, rng.normal(0.0, 0.01)) for _ in range(8)]
+    outliers = [random_rotation(rng) for _ in range(12)]
+    Rs = jnp.asarray(np.stack(inliers + outliers), jnp.float32)
+    thresh = lie.angular_to_chordal_so3(0.5)
+    res = averaging.robust_single_rotation_averaging(Rs, error_threshold=thresh)
+    mask = np.asarray(res.inlier_mask)
+    assert mask[:8].all(), f"lost inliers: {mask[:8]}"
+    assert not mask[8:].any(), "outliers accepted"
+
+    ts = jnp.asarray(rng.standard_normal((4, 3)), jnp.float32)
+    res = averaging.robust_single_pose_averaging(
+        jnp.asarray(np.stack([R] * 4), jnp.float32),
+        jnp.broadcast_to(ts[0], (4, 3)))
+    assert res.inlier_mask.tolist() == [True] * 4
+
+
 def test_robust_averaging_is_jittable(rng):
     import jax
 
