@@ -7,19 +7,31 @@ or noise-perturbed relative measurements, and assert recovery.
 """
 
 import numpy as np
-import jax.numpy as jnp
 
 from dpgo_tpu.types import Measurements
 from dpgo_tpu.utils import lie
 
 
+def _project_rotations_np(M: np.ndarray) -> np.ndarray:
+    """Batched numpy SO(d) projection (SVD with det fix).
+
+    Pure host work on purpose: the JAX equivalent (``lie.project_to_rotation``)
+    would dispatch one tiny kernel per call to the *default* backend — on the
+    tunneled-TPU image that is an RPC round-trip each, which turns a
+    100k-pose synthesis into hours."""
+    U, _, Vh = np.linalg.svd(M)
+    det = np.linalg.det(U @ Vh)
+    U[det < 0, :, -1] *= -1.0
+    return U @ Vh
+
+
 def random_rotation(rng, d=3):
-    return np.asarray(lie.project_to_rotation(jnp.asarray(rng.standard_normal((d, d)))))
+    return _project_rotations_np(rng.standard_normal((d, d))[None])[0]
 
 
 def random_trajectory(rng, n, d=3, step=1.0):
     """Ground-truth poses: random rotations, random-walk translations."""
-    Rs = np.stack([random_rotation(rng, d) for _ in range(n)])
+    Rs = _project_rotations_np(rng.standard_normal((n, d, d)))
     ts = np.cumsum(step * rng.standard_normal((n, d)), axis=0)
     # Anchor pose 0 at the identity for easy gauge comparison.
     R0inv = Rs[0].T
