@@ -332,6 +332,68 @@ def neighbor_buffer(Xpub: jax.Array, graph: MultiAgentGraph) -> jax.Array:
     return Z * graph.nbr_mask[:, :, None, None]
 
 
+class PPermutePlan(NamedTuple):
+    """Per-agent routing for the ppermute pose exchange (all arrays [A, S_max],
+    sharded over agents like the rest of the graph).
+
+    ``src`` indexes the stacked received tables: 0 = this device's own table,
+    1 + i = the table received at ``shifts[i]``; ``lrobot`` is the neighbor
+    robot's *local* index on its home device."""
+
+    src: jax.Array
+    lrobot: jax.Array
+
+
+def plan_ppermute(graph: MultiAgentGraph, num_robots: int, n_dev: int):
+    """Host-side routing plan for the shift-based neighbor exchange.
+
+    The all_gather v1 moves every agent's public table to every device —
+    on a ring that is ``n_dev - 1`` hops of the full table regardless of who
+    actually needs what (SURVEY.md section 2.4).  Robot adjacency in SLAM
+    partitions is sparse and mostly local (contiguous partitions put the
+    odometry-crossing edges between consecutive robots), so the set of
+    *device-to-device* shifts that carry any edge is small; one
+    ``lax.ppermute`` per needed shift moves only those tables.  Returns
+    ``(shifts, plan)``: ``shifts`` is the static tuple of nonzero ring
+    offsets (compile-time; one collective each), ``plan`` the per-agent
+    routing arrays.  ``num_robots`` must be a multiple of ``n_dev``, with
+    agents laid out in contiguous blocks per device (``shard_problem``)."""
+    if num_robots % n_dev != 0:
+        raise ValueError(
+            f"num_robots={num_robots} must be a multiple of n_dev={n_dev} "
+            "(contiguous agent blocks per device, as shard_problem lays out)")
+    A_loc = num_robots // n_dev
+    nbr_robot = np.asarray(graph.nbr_robot)
+    nbr_mask = np.asarray(graph.nbr_mask) > 0
+    dev_of = np.arange(num_robots) // A_loc
+    da = dev_of[:, None]
+    db = dev_of[nbr_robot]
+    s = np.where(nbr_mask, (da - db) % n_dev, 0)
+    shifts = tuple(sorted(set(s[nbr_mask].astype(int).tolist()) - {0}))
+    pos = {0: 0, **{sh: i + 1 for i, sh in enumerate(shifts)}}
+    src = np.zeros_like(s)
+    for sh, p in pos.items():
+        src[s == sh] = p
+    plan = PPermutePlan(src=jnp.asarray(src, jnp.int32),
+                        lrobot=jnp.asarray(nbr_robot % A_loc, jnp.int32))
+    return shifts, plan
+
+
+def _ppermute_exchange(Xl: jax.Array, graph: MultiAgentGraph,
+                       plan: PPermutePlan, shifts: tuple, axis_name: str,
+                       n_dev: int) -> jax.Array:
+    """Neighbor buffer via one ppermute per needed device shift (the
+    optimized ICI path; bitwise-identical result to the all_gather form)."""
+    T = public_table(Xl, graph)  # this shard's own public table
+    parts = [T]
+    for s in shifts:
+        perm = [(i, (i + s) % n_dev) for i in range(n_dev)]
+        parts.append(jax.lax.ppermute(T, axis_name, perm))
+    stacked = jnp.stack(parts)  # [1 + len(shifts), A_loc, P_max, r, d+1]
+    Z = stacked[plan.src, plan.lrobot, graph.nbr_pub]
+    return Z * graph.nbr_mask[:, :, None, None]
+
+
 # ---------------------------------------------------------------------------
 # The jitted step
 # ---------------------------------------------------------------------------
@@ -623,7 +685,9 @@ def _converged_weight_ratio(edges, params: AgentParams):
 
 def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
                 params: AgentParams, axis_name: str | None = None,
-                update_weights: bool = False, restart: bool = False) -> RBCDState:
+                update_weights: bool = False, restart: bool = False,
+                plan: PPermutePlan | None = None,
+                shifts: tuple = ()) -> RBCDState:
     """One synchronous RBCD round over the agents held by this device.
 
     Communication happens once per round: the public-pose table is built
@@ -649,6 +713,14 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
     to the pre-round value), a plain un-accelerated step is taken instead,
     and the aux state collapses (V = Y = X, gamma = alpha = 0) — so it
     compiles as a plain round plus aux reset, with no wasted solve.
+
+    ``plan``/``shifts`` (mesh path only) switch the pose exchange from the
+    all_gather v1 to the shift-based ppermute route (``plan_ppermute``):
+    same result bitwise, with one collective per ring offset that carries
+    any cross-device edge (a win when the partition's device adjacency is
+    near-chain; a random partition can need up to ``n_dev - 1`` shifts —
+    all_gather volume).  The greedy schedule's argmax still all_gathers its
+    [A] gradient-norm vector (negligible payload).
     """
     if params.acceleration and state.V is None:
         raise ValueError(
@@ -674,15 +746,24 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
     if axis_name is None:
         agent_ids = jnp.arange(A_loc)
         gather = lambda t: t
+        if plan is not None:
+            raise ValueError("ppermute exchange requires a mesh axis_name")
     else:
         agent_ids = jax.lax.axis_index(axis_name) * A_loc + jnp.arange(A_loc)
         gather = lambda t: jax.lax.all_gather(t, axis_name, axis=0, tiled=True)
 
+    if plan is None:
+        exchange = lambda Xl: neighbor_buffer(gather(public_table(Xl, graph)),
+                                              graph)
+    else:
+        n_dev = A_tot // A_loc
+        exchange = lambda Xl: _ppermute_exchange(Xl, graph, plan, shifts,
+                                                 axis_name, n_dev)
+
     # Regular neighbor buffer (from X) — needed always when un-accelerated,
     # and on weight-update / restart rounds when accelerated.
     need_regular = (not accel) or restart or update_weights
-    Z = neighbor_buffer(gather(public_table(X, graph)), graph) if need_regular \
-        else None
+    Z = exchange(X) if need_regular else None
 
     # --- GNC weight update (before the pose update, reference iterate()
     # PGOAgent.cpp:654-668) ---
@@ -697,7 +778,7 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
             # BEFORE this round's optimization (PGOAgent.cpp:657-662); the
             # reset X also refreshes the regular neighbor buffer.
             X = state.X_init
-            Z = neighbor_buffer(gather(public_table(X, graph)), graph)
+            Z = exchange(X)
         if accel:  # initializeAcceleration (PGOAgent.cpp:1054-1063)
             V = X
             gamma = jnp.zeros_like(gamma)
@@ -736,7 +817,7 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
         alpha = 1.0 / (gamma * A_tot)
         a = alpha[:, None, None, None]
         Ynes = manifold.project((1.0 - a) * X + a * V)
-        Zaux = neighbor_buffer(gather(public_table(Ynes, graph)), graph)
+        Zaux = exchange(Ynes)
         start, Zuse = Ynes, Zaux
     else:
         start, Zuse = X, Z
@@ -818,12 +899,14 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
 #: Jitted RBCD round. Single-device over all agents with the default
 #: ``axis_name=None``; the sharded path re-wraps ``_rbcd_round`` in shard_map.
 rbcd_step = jax.jit(_rbcd_round, static_argnames=(
-    "meta", "params", "axis_name", "update_weights", "restart"))
+    "meta", "params", "axis_name", "update_weights", "restart", "shifts"))
 
 
 def _rbcd_rounds(state: RBCDState, graph: MultiAgentGraph, num_rounds,
                  meta: GraphMeta, params: AgentParams,
-                 axis_name: str | None = None) -> RBCDState:
+                 axis_name: str | None = None,
+                 plan: PPermutePlan | None = None,
+                 shifts: tuple = ()) -> RBCDState:
     """``num_rounds`` consecutive *plain* rounds (no weight update, no
     restart) as one on-device ``fori_loop``.
 
@@ -835,14 +918,15 @@ def _rbcd_rounds(state: RBCDState, graph: MultiAgentGraph, num_rounds,
     ``num_rounds`` is a traced scalar: one compile serves every segment
     length."""
     body = lambda _i, s: _rbcd_round(s, graph, meta, params,
-                                     axis_name=axis_name)
+                                     axis_name=axis_name, plan=plan,
+                                     shifts=shifts)
     return jax.lax.fori_loop(0, num_rounds, body, state)
 
 
 #: Jitted fused rounds (single-device; ``parallel.make_sharded_multi_step``
 #: embeds the same loop inside shard_map for the mesh path).
 rbcd_steps = jax.jit(_rbcd_rounds, static_argnames=(
-    "meta", "params", "axis_name"))
+    "meta", "params", "axis_name", "shifts"))
 
 
 # ---------------------------------------------------------------------------

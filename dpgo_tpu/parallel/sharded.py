@@ -87,29 +87,55 @@ def shard_problem(mesh: Mesh, state: RBCDState, graph: MultiAgentGraph):
     return state, graph
 
 
-def make_sharded_step(mesh: Mesh, meta: GraphMeta, params: AgentParams):
+def _exchange_plan(mesh: Mesh, meta: GraphMeta, graph: MultiAgentGraph,
+                   exchange: str):
+    """Resolve the pose-exchange backend: ``"all_gather"`` (v1, full public
+    table to every device) or ``"ppermute"`` (one collective per device
+    shift that actually carries an edge — the optimized ICI route of
+    SURVEY.md section 2.4).  Returns ``(shifts, plan)`` with plan arrays
+    placed like the rest of the per-agent graph data."""
+    if exchange == "all_gather":
+        return (), None
+    if exchange != "ppermute":
+        raise ValueError(f"unknown exchange backend {exchange!r}")
+    shifts, plan = rbcd.plan_ppermute(graph, meta.num_robots,
+                                      mesh.devices.size)
+    plan = jax.tree.map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P(AXIS))), plan)
+    return shifts, plan
+
+
+def make_sharded_step(mesh: Mesh, meta: GraphMeta, params: AgentParams,
+                      shifts: tuple = (), plan=None):
     """Compile the sharded RBCD round: shard_map of the per-shard body over
     the agent axis, jitted as one XLA program (collectives included).
 
     The returned callable takes the driver's two static schedule flags
     (``update_weights``, ``restart``); each (True/False) combination compiles
-    once."""
+    once.  ``shifts``/``plan`` (from ``_exchange_plan``) select the ppermute
+    pose exchange; default is the all_gather v1."""
 
     @partial(jax.jit, static_argnames=("update_weights", "restart"))
     def step(state: RBCDState, graph: MultiAgentGraph,
              update_weights: bool = False, restart: bool = False) -> RBCDState:
-        body = partial(rbcd._rbcd_round, meta=meta, params=params,
-                       axis_name=AXIS, update_weights=update_weights,
-                       restart=restart)
-        in_specs = (_specs(mesh, state), _specs(mesh, graph))
+        def body(s, g, p):
+            return rbcd._rbcd_round(s, g, meta=meta, params=params,
+                                    axis_name=AXIS,
+                                    update_weights=update_weights,
+                                    restart=restart, plan=p, shifts=shifts)
+
+        in_specs = (_specs(mesh, state), _specs(mesh, graph),
+                    _specs(mesh, plan))
         out_specs = _specs(mesh, state)
         return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)(state, graph)
+                             out_specs=out_specs,
+                             check_vma=False)(state, graph, plan)
 
     return step
 
 
-def make_sharded_multi_step(mesh: Mesh, meta: GraphMeta, params: AgentParams):
+def make_sharded_multi_step(mesh: Mesh, meta: GraphMeta, params: AgentParams,
+                            shifts: tuple = (), plan=None):
     """Compile the fused plain-round loop for the mesh path: ``k`` consecutive
     rounds (collective pose exchange included in each) as one on-device
     ``fori_loop`` inside shard_map — one dispatch per schedule segment
@@ -118,14 +144,16 @@ def make_sharded_multi_step(mesh: Mesh, meta: GraphMeta, params: AgentParams):
 
     @jax.jit
     def steps(state: RBCDState, graph: MultiAgentGraph, num_rounds) -> RBCDState:
-        def body(s, g, n):
-            return rbcd._rbcd_rounds(s, g, n, meta, params, axis_name=AXIS)
+        def body(s, g, n, p):
+            return rbcd._rbcd_rounds(s, g, n, meta, params, axis_name=AXIS,
+                                     plan=p, shifts=shifts)
 
-        in_specs = (_specs(mesh, state), _specs(mesh, graph), P())
+        in_specs = (_specs(mesh, state), _specs(mesh, graph), P(),
+                    _specs(mesh, plan))
         out_specs = _specs(mesh, state)
         return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs,
-                             check_vma=False)(state, graph, num_rounds)
+                             check_vma=False)(state, graph, num_rounds, plan)
 
     return steps
 
@@ -141,11 +169,15 @@ def solve_rbcd_sharded(
     dtype=jnp.float64,
     part: Partition | None = None,
     init: str = "chordal",
+    exchange: str = "all_gather",
 ) -> rbcd.RBCDResult:
     """Distributed solve over a device mesh — the deployment path of the
     framework (``models.rbcd.solve_rbcd`` is the single-device debug path).
     Shares the driver loop (``rbcd.run_rbcd``); only problem placement and
-    the step function differ."""
+    the step function differ.  ``exchange`` selects the pose-exchange
+    collective: ``"all_gather"`` (v1) or ``"ppermute"`` (one collective per
+    ring offset that carries a cross-device edge — fewer hops than the
+    all_gather ring when the device adjacency is near-chain)."""
     mesh = mesh or make_mesh()
     params = params or AgentParams(d=meas.d, r=5, num_robots=num_robots)
     max_iters = params.max_num_iters if max_iters is None else max_iters
@@ -156,8 +188,9 @@ def solve_rbcd_sharded(
     state = init_state(graph, meta, X0, params=params)
     state, graph = shard_problem(mesh, state, graph)
 
-    sharded_step = make_sharded_step(mesh, meta, params)
-    sharded_multi = make_sharded_multi_step(mesh, meta, params)
+    shifts, plan = _exchange_plan(mesh, meta, graph, exchange)
+    sharded_step = make_sharded_step(mesh, meta, params, shifts, plan)
+    sharded_multi = make_sharded_multi_step(mesh, meta, params, shifts, plan)
     step = lambda s, uw, rs: sharded_step(s, graph, update_weights=uw, restart=rs)
     multi = lambda s, k: sharded_multi(s, graph, k)
     return rbcd.run_rbcd(state, graph, meta, step, part, max_iters,

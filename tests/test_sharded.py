@@ -150,6 +150,87 @@ def test_sharded_fused_rounds_match_per_round(rng):
                                np.asarray(seq.rel_change), atol=1e-12)
 
 
+def test_ppermute_exchange_matches_all_gather(rng):
+    """The shift-based ppermute pose exchange must be bitwise-identical to
+    the all_gather v1 — same rounds, same state — including with several
+    agents per device and with the accel/robust special rounds."""
+    from dpgo_tpu.config import RobustCostParams, RobustCostType
+    from dpgo_tpu.parallel.sharded import _exchange_plan
+
+    meas, _ = make_measurements(rng, n=64, d=3, num_lc=20, rot_noise=0.01,
+                                trans_noise=0.01, outlier_lc=4)
+    params = AgentParams(
+        d=3, r=5, num_robots=16, schedule=Schedule.JACOBI,
+        acceleration=True, restart_interval=4,
+        robust=RobustCostParams(cost_type=RobustCostType.GNC_TLS,
+                                gnc_barc=0.5),
+        robust_opt_inner_iters=3)
+    _, graph, meta, state = _setup(meas, 16, params)
+
+    mesh = make_mesh(8)  # 2 agents per device
+    sh_state, sh_graph = shard_problem(mesh, state, graph)
+    shifts, plan = _exchange_plan(mesh, meta, sh_graph, "ppermute")
+    assert len(shifts) >= 1
+    step_ag = make_sharded_step(mesh, meta, params)
+    step_pp = make_sharded_step(mesh, meta, params, shifts, plan)
+
+    sa, sp = sh_state, sh_state
+    for it in range(8):
+        uw = (it + 1) % 3 == 0
+        rs = (it + 1) % 4 == 0
+        sa = step_ag(sa, sh_graph, update_weights=uw, restart=rs)
+        sp = step_pp(sp, sh_graph, update_weights=uw, restart=rs)
+    np.testing.assert_array_equal(np.asarray(sp.X), np.asarray(sa.X))
+    np.testing.assert_array_equal(np.asarray(sp.weights),
+                                  np.asarray(sa.weights))
+    np.testing.assert_array_equal(np.asarray(sp.V), np.asarray(sa.V))
+
+
+def test_ppermute_solve_end_to_end(data_dir):
+    """solve_rbcd_sharded(exchange='ppermute') reaches the demo gate on
+    smallGrid3D with the same trace as the all_gather solve."""
+    meas = read_g2o(f"{data_dir}/smallGrid3D.g2o")
+    params = AgentParams(d=3, r=5, num_robots=8, rel_change_tol=1e-4)
+    res_a = solve_rbcd_sharded(meas, num_robots=8, mesh=make_mesh(8),
+                               params=params, max_iters=60,
+                               grad_norm_tol=0.1)
+    res_p = solve_rbcd_sharded(meas, num_robots=8, mesh=make_mesh(8),
+                               params=params, max_iters=60,
+                               grad_norm_tol=0.1, exchange="ppermute")
+    assert res_p.terminated_by == res_a.terminated_by
+    assert res_p.iterations == res_a.iterations
+    np.testing.assert_array_equal(np.asarray(res_p.T), np.asarray(res_a.T))
+
+
+def test_ppermute_plan_routing(rng):
+    """plan_ppermute routes every masked neighbor slot to the correct
+    (shift, local robot) pair and only emits shifts that carry edges."""
+    from dpgo_tpu.models.rbcd import plan_ppermute
+    from dpgo_tpu.utils.partition import partition_contiguous as pc
+
+    meas, _ = make_measurements(rng, n=48, d=3, num_lc=14)
+    part = pc(meas, 8)
+    graph, meta = rbcd.build_graph(part, 5, jnp.float64)
+    n_dev = 4  # 2 agents per device
+    shifts, plan = plan_ppermute(graph, 8, n_dev)
+    A_loc = 8 // n_dev
+    nbr_robot = np.asarray(graph.nbr_robot)
+    nbr_mask = np.asarray(graph.nbr_mask) > 0
+    src = np.asarray(plan.src)
+    lrobot = np.asarray(plan.lrobot)
+    for a in range(8):
+        for m in range(nbr_robot.shape[1]):
+            if not nbr_mask[a, m]:
+                continue
+            b = nbr_robot[a, m]
+            s = (a // A_loc - b // A_loc) % n_dev
+            expect = 0 if s == 0 else 1 + shifts.index(s)
+            assert src[a, m] == expect, (a, m)
+            assert lrobot[a, m] == b % A_loc
+    for s in shifts:
+        assert s != 0
+
+
 def test_mesh_size_divisibility(rng):
     meas, _ = make_measurements(rng, n=24, d=3, num_lc=5)
     params = AgentParams(d=3, r=5, num_robots=6)
