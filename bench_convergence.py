@@ -1,0 +1,186 @@
+"""Benchmark #2: time to certified 1e-6 relative suboptimality.
+
+The north-star target (BASELINE.md) is stated two ways: RBCD rounds/sec
+(``bench.py``, the driver metric) and **time-to-1e-6 relative
+suboptimality at matching certified gap** — this script measures the
+second on sphere2500 with 8 agents, r=5:
+
+1. Establish the certified optimum f* once: a centralized float64 CPU
+   solve driven to gradnorm <= 1e-9, certified by the dual-certificate
+   eigensolve (``models.certify``) — the relaxation is tight at r=5 on
+   sphere2500, so f* is the global PGO optimum, not just a local anchor.
+2. Run the distributed solver (fused rounds) and time how long until the
+   centralized cost of the assembled iterate reaches
+   ``f <= f* * (1 + 1e-6)``, checking every ``EVAL_EVERY`` rounds.
+   Timing by device-to-host readback (see bench.py on why
+   block_until_ready cannot be trusted on the tunneled platform).
+
+Prints one JSON line:
+  {"metric": "time_to_1e-6_subopt_sphere2500_8agents_r5", "value": <s>,
+   "unit": "s", "rounds": N, "f_opt": ..., "certified": true}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+DATASET = "/root/reference/data/sphere2500.g2o"
+NUM_ROBOTS = 8
+RANK = 5
+REL_GAP = 1e-6
+EVAL_EVERY = int(os.environ.get("BENCH_EVAL_EVERY", "25"))
+MAX_ROUNDS = int(os.environ.get("BENCH_MAX_ROUNDS", "4000"))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def certified_optimum():
+    """f* from a float64 centralized solve + dual certificate (cached)."""
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".bench_fopt_sphere2500.json")
+    if os.path.exists(cache):
+        with open(cache) as f:
+            d = json.load(f)
+        log(f"  cached f* = {d['f_opt']:.9f} (certified={d['certified']})")
+        return d["f_opt"], d["certified"]
+
+    import subprocess
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=dict(os.environ, BENCH_MODE="fopt"),
+        capture_output=True, text=True, timeout=3600)
+    sys.stderr.write(out.stderr)
+    if out.returncode != 0:
+        raise RuntimeError(f"f* solve failed:\n{out.stderr[-2000:]}")
+    d = json.loads(out.stdout.strip().splitlines()[-1])
+    with open(cache, "w") as f:
+        json.dump(d, f)
+    return d["f_opt"], d["certified"]
+
+
+def fopt_main():
+    """Subprocess: centralized f64 CPU solve + certificate (the TPU-tunnel
+    process cannot enable x64, see bench.py)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from dpgo_tpu.models import certify
+    from dpgo_tpu.models.local_pgo import solve_local
+    from dpgo_tpu.types import edge_set_from_measurements
+    from dpgo_tpu.utils.g2o import read_g2o
+
+    meas = read_g2o(DATASET)
+    res = solve_local(meas, rank=RANK, grad_norm_tol=1e-9, max_iters=1000,
+                      dtype=jnp.float64)
+    edges = edge_set_from_measurements(meas, dtype=jnp.float64)
+    cert = certify.certify_solution(res.X, edges)
+    log(f"  f* = {float(res.cost):.9f}, gradnorm {float(res.grad_norm):.2e}, "
+        f"lambda_min {cert.lambda_min:.3e}, certified={cert.certified}")
+    print(json.dumps({"f_opt": float(res.cost),
+                      "certified": bool(cert.certified)}))
+
+
+def main():
+    if os.environ.get("BENCH_MODE") == "fopt":
+        fopt_main()
+        return
+
+    import jax
+    import jax.numpy as jnp
+    from dpgo_tpu.models import rbcd
+    from dpgo_tpu.ops import quadratic
+    from dpgo_tpu.types import edge_set_from_measurements
+    from dpgo_tpu.config import AgentParams, SolverParams
+    from dpgo_tpu.utils.g2o import read_g2o
+    from dpgo_tpu.utils.partition import partition_contiguous
+
+    f_opt, certified = certified_optimum()
+    target = f_opt * (1.0 + REL_GAP)
+
+    dev = jax.devices()[0]
+    log(f"benchmark device: {dev.platform} ({dev.device_kind})")
+    dtype = jnp.float32 if dev.platform != "cpu" else jnp.float64
+
+    meas = read_g2o(DATASET)
+    params = AgentParams(
+        d=3, r=RANK, num_robots=NUM_ROBOTS, rel_change_tol=0.0,
+        # Drive the local solves tight: the reference's per-step budget
+        # (tol 1e-2) caps achievable global suboptimality far above 1e-6.
+        solver=SolverParams(grad_norm_tol=1e-9, max_inner_iters=10))
+    part = partition_contiguous(meas, NUM_ROBOTS)
+    graph, meta = rbcd.build_graph(part, RANK, dtype)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, dtype)
+    state0 = rbcd.init_state(graph, meta, X0, params=params)
+    edges_g = edge_set_from_measurements(part.meas_global, dtype=dtype)
+    n_total = part.meas_global.num_poses
+
+    @jax.jit
+    def cost_of(s):
+        return quadratic.cost(rbcd.gather_to_global(s.X, graph, n_total),
+                              edges_g)
+
+    # Warm-up: compile the fused step and the cost eval outside the clock.
+    state = rbcd.rbcd_steps(state0, graph, 1, meta, params)
+    _ = float(cost_of(state))
+
+    # Ladder of relative gaps: record the first crossing time of each, so
+    # TPU (float32: floor measured ~4e-6 on this problem) and CPU (float64)
+    # compare at matching gaps down to each one's precision floor.
+    ladder = [1e-3, 1e-4, 1e-5, REL_GAP]
+    crossed: dict[float, tuple[float, int]] = {}
+    state = state0
+    t0 = time.perf_counter()
+    rounds = 0
+    best = float("inf")
+    stall = 0
+    while rounds < MAX_ROUNDS:
+        state = rbcd.rbcd_steps(state, graph, EVAL_EVERY, meta, params)
+        rounds += EVAL_EVERY
+        f = float(cost_of(state))  # device->host sync each eval
+        now = time.perf_counter() - t0
+        for g in ladder:
+            if g not in crossed and f <= f_opt * (1.0 + g):
+                crossed[g] = (now, rounds)
+                log(f"  gap {g:.0e} at {now:.2f}s ({rounds} rounds)")
+        if f <= target:
+            break
+        # Stall detection: the f32 iterate has a precision floor above
+        # 1e-6; stop once the cost stops improving instead of burning the
+        # whole round budget at the floor.
+        if f >= best * (1.0 - 1e-9):
+            stall += 1
+            if stall >= 8:
+                log(f"  stalled at rel gap {f / f_opt - 1.0:.2e}")
+                break
+        else:
+            stall = 0
+        best = min(best, f)
+    f = float(cost_of(state))
+    gap = f / f_opt - 1.0
+    dt = time.perf_counter() - t0
+    log(f"  rounds {rounds}, final cost {f:.9f}, rel gap {gap:.2e}, "
+        f"elapsed {dt:.2f}s")
+    reached = crossed.get(REL_GAP, (None, rounds))[0]
+    print(json.dumps({
+        "metric": "time_to_1e-6_subopt_sphere2500_8agents_r5",
+        "value": round(reached, 3) if reached is not None else None,
+        "unit": "s",
+        "rounds": rounds,
+        "f_opt": f_opt,
+        "rel_gap_reached": gap,
+        "ladder": {f"{g:.0e}": {"s": round(t, 3), "rounds": r}
+                   for g, (t, r) in sorted(crossed.items(), reverse=True)},
+        "certified": certified,
+    }))
+
+
+if __name__ == "__main__":
+    main()
