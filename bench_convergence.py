@@ -88,26 +88,18 @@ def fopt_main():
                       "certified": bool(cert.certified)}))
 
 
-def main():
-    if os.environ.get("BENCH_MODE") == "fopt":
-        fopt_main()
-        return
-
+def _build_problem(dtype, init: str = "chordal"):
+    """Shared benchmark-problem builder (main / polish subprocess): one
+    definition so the polish measures exactly the problem the accelerator
+    descent ran.  Returns (rbcd, graph, meta, params, state0, cost_of)."""
     import jax
     import jax.numpy as jnp
+    from dpgo_tpu.config import AgentParams, SolverParams
     from dpgo_tpu.models import rbcd
     from dpgo_tpu.ops import quadratic
     from dpgo_tpu.types import edge_set_from_measurements
-    from dpgo_tpu.config import AgentParams, SolverParams
     from dpgo_tpu.utils.g2o import read_g2o
     from dpgo_tpu.utils.partition import partition_contiguous
-
-    f_opt, certified = certified_optimum()
-    target = f_opt * (1.0 + REL_GAP)
-
-    dev = jax.devices()[0]
-    log(f"benchmark device: {dev.platform} ({dev.device_kind})")
-    dtype = jnp.float32 if dev.platform != "cpu" else jnp.float64
 
     meas = read_g2o(DATASET)
     params = AgentParams(
@@ -117,8 +109,10 @@ def main():
         solver=SolverParams(grad_norm_tol=1e-9, max_inner_iters=10))
     part = partition_contiguous(meas, NUM_ROBOTS)
     graph, meta = rbcd.build_graph(part, RANK, dtype)
-    X0 = rbcd.centralized_chordal_init(part, meta, graph, dtype)
-    state0 = rbcd.init_state(graph, meta, X0, params=params)
+    state0 = None
+    if init == "chordal":
+        X0 = rbcd.centralized_chordal_init(part, meta, graph, dtype)
+        state0 = rbcd.init_state(graph, meta, X0, params=params)
     edges_g = edge_set_from_measurements(part.meas_global, dtype=dtype)
     n_total = part.meas_global.num_poses
 
@@ -126,6 +120,67 @@ def main():
     def cost_of(s):
         return quadratic.cost(rbcd.gather_to_global(s.X, graph, n_total),
                               edges_g)
+
+    return rbcd, graph, meta, params, state0, cost_of
+
+
+def polish_main():
+    """Subprocess: warm-started float64 CPU polish from the TPU's floored
+    float32 iterate (path in BENCH_POLISH_STATE) down to the 1e-6 gap —
+    the practical recipe for certified-grade output: TPU does the descent,
+    a few f64 rounds do the last decimal."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    data = np.load(os.environ["BENCH_POLISH_STATE"])
+    f_opt = float(os.environ["BENCH_F_OPT"])
+    target = f_opt * (1.0 + REL_GAP)
+
+    rbcd, graph, meta, params, _state0, cost_of = _build_problem(jnp.float64)
+    X0 = jnp.asarray(data["X"], jnp.float64)
+    state = rbcd.init_state(graph, meta, X0, params=params)
+
+    _ = float(cost_of(rbcd.rbcd_steps(state, graph, 1, meta, params)))  # compile
+    state = rbcd.init_state(graph, meta, X0, params=params)
+
+    t0 = time.perf_counter()
+    rounds = 0
+    reached = False
+    while rounds < MAX_ROUNDS:
+        state = rbcd.rbcd_steps(state, graph, 5, meta, params)
+        rounds += 5
+        f = float(cost_of(state))
+        if f <= target:
+            reached = True
+            break
+    dt = time.perf_counter() - t0
+    log(f"  polish: {rounds} f64 rounds, {dt:.2f}s, "
+        f"rel gap {f / f_opt - 1.0:.2e}, reached={reached}")
+    print(json.dumps({"polish_s": dt, "polish_rounds": rounds,
+                      "rel_gap": f / f_opt - 1.0, "reached": reached}))
+
+
+def main():
+    if os.environ.get("BENCH_MODE") == "fopt":
+        fopt_main()
+        return
+    if os.environ.get("BENCH_MODE") == "polish":
+        polish_main()
+        return
+
+    import jax
+    import jax.numpy as jnp
+
+    f_opt, certified = certified_optimum()
+    target = f_opt * (1.0 + REL_GAP)
+
+    dev = jax.devices()[0]
+    log(f"benchmark device: {dev.platform} ({dev.device_kind})")
+    dtype = jnp.float32 if dev.platform != "cpu" else jnp.float64
+
+    rbcd, graph, meta, params, state0, cost_of = _build_problem(dtype)
 
     # Warm-up: compile the fused step and the cost eval outside the clock.
     state = rbcd.rbcd_steps(state0, graph, 1, meta, params)
@@ -157,7 +212,7 @@ def main():
         # whole round budget at the floor.
         if f >= best * (1.0 - 1e-9):
             stall += 1
-            if stall >= 8:
+            if stall >= 4:
                 log(f"  stalled at rel gap {f / f_opt - 1.0:.2e}")
                 break
         else:
@@ -169,6 +224,43 @@ def main():
     log(f"  rounds {rounds}, final cost {f:.9f}, rel gap {gap:.2e}, "
         f"elapsed {dt:.2f}s")
     reached = crossed.get(REL_GAP, (None, rounds))[0]
+
+    # Hybrid: when the accelerator's f32 iterate floors above the target
+    # gap, hand the trajectory to a warm-started float64 CPU polish — the
+    # end-to-end time to certified-grade 1e-6 output.
+    hybrid = None
+    if reached is None and jax.devices()[0].platform != "cpu":
+        # The polish is auxiliary — any failure in it (timeout, bad output)
+        # must not destroy the accelerator result gathered above.
+        import subprocess
+        import tempfile
+        path = None
+        try:
+            with tempfile.NamedTemporaryFile(suffix=".npz",
+                                             delete=False) as fh:
+                np.savez(fh, X=np.asarray(state.X, np.float64))
+                path = fh.name
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=dict(os.environ, BENCH_MODE="polish",
+                         BENCH_POLISH_STATE=path, BENCH_F_OPT=repr(f_opt)),
+                capture_output=True, text=True, timeout=1800)
+            sys.stderr.write(out.stderr)
+            if out.returncode == 0:
+                pol = json.loads(out.stdout.strip().splitlines()[-1])
+                hybrid = {"accel_s": round(dt, 3),
+                          "polish_s": round(pol["polish_s"], 3),
+                          "polish_rounds": pol["polish_rounds"],
+                          "rel_gap": pol["rel_gap"],  # unrounded
+                          "reached": pol["reached"],
+                          "total_s": round(dt + pol["polish_s"], 3)}
+                log(f"  hybrid total (accel + f64 polish): "
+                    f"{hybrid['total_s']:.2f}s, reached={pol['reached']}")
+        except Exception as e:  # noqa: BLE001 — auxiliary step
+            log(f"  polish failed: {type(e).__name__}: {e}")
+        finally:
+            if path is not None and os.path.exists(path):
+                os.unlink(path)
     print(json.dumps({
         "metric": "time_to_1e-6_subopt_sphere2500_8agents_r5",
         "value": round(reached, 3) if reached is not None else None,
@@ -178,6 +270,7 @@ def main():
         "rel_gap_reached": gap,
         "ladder": {f"{g:.0e}": {"s": round(t, 3), "rounds": r}
                    for g, (t, r) in sorted(crossed.items(), reverse=True)},
+        "hybrid": hybrid,
         "certified": certified,
     }))
 
