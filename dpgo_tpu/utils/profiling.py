@@ -1,0 +1,100 @@
+"""Profiling / tracing hooks — the framework's observability layer.
+
+The reference's "tracing" is wall-clock + objective bookkeeping in
+``ROPTResult`` (``DPGO_types.h:40-59``, filled at
+``QuadraticOptimizer.cpp:36-54``) plus verbose printouts.  The TPU-native
+equivalents here (SURVEY.md section 5):
+
+* ``trace(logdir)`` — context manager around ``jax.profiler`` capturing a
+  device timeline (XLA op breakdown, HBM traffic) viewable in
+  TensorBoard/Perfetto.  Works on CPU and TPU backends.
+* ``annotate(name)`` — named region that shows up inside the timeline
+  (wraps ``jax.profiler.TraceAnnotation``); use around driver phases
+  (exchange / solve / eval) when hunting dispatch gaps.
+* ``RoundTimer`` — lightweight host-side per-phase wall-clock accumulator
+  for driver loops, with the readback caveat of the tunneled-TPU platform
+  (see bench.py) baked in: ``stop`` optionally blocks on a device value
+  by materializing it.
+
+The per-iteration *metrics* (cost, gradient norm, relative change,
+per-agent readiness) are first-class solver outputs — ``RBCDResult.
+cost_history`` / ``grad_norm_history`` and the gossiped status arrays —
+not a tracing concern; this module is about *where the time goes*.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a JAX device/host profile into ``logdir``.
+
+    Usage::
+
+        with profiling.trace("/tmp/dpgo-trace"):
+            state = rbcd.rbcd_steps(state, graph, 100, meta, params)
+            np.asarray(state.X)   # materialize inside the trace window
+    """
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named timeline region: ``with profiling.annotate("exchange"): ...``"""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+class RoundTimer:
+    """Host-side per-phase wall-clock accumulator for driver loops.
+
+    ``stop(phase, sync=x)`` materializes ``x`` (device->host readback)
+    before taking the timestamp — on the tunneled-TPU platform
+    ``block_until_ready`` returns early (see bench.py), so a transfer is
+    the only trustworthy fence.
+    """
+
+    def __init__(self):
+        self.totals: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+        self._t0: dict[str, float] = {}
+
+    def start(self, phase: str) -> None:
+        self._t0[phase] = time.perf_counter()
+
+    def stop(self, phase: str, sync=None) -> float:
+        if sync is not None:
+            np.asarray(sync)
+        dt = time.perf_counter() - self._t0.pop(phase)
+        self.totals[phase] = self.totals.get(phase, 0.0) + dt
+        self.counts[phase] = self.counts.get(phase, 0) + 1
+        return dt
+
+    @contextlib.contextmanager
+    def phase(self, name: str, sync_fn=None):
+        """``with timer.phase("solve", lambda: state.X): ...`` — the sync
+        callable (if given) produces the device value to materialize at
+        exit."""
+        self.start(name)
+        try:
+            yield
+        finally:
+            self.stop(name, sync=sync_fn() if sync_fn is not None else None)
+
+    def summary(self) -> str:
+        rows = [f"{k}: {v:.4f}s / {self.counts[k]} "
+                f"({1e3 * v / max(self.counts[k], 1):.2f} ms avg)"
+                for k, v in sorted(self.totals.items(),
+                                   key=lambda kv: -kv[1])]
+        return "\n".join(rows)

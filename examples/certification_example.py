@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Certifiably correct PGO via the Riemannian staircase — beyond-reference.
+
+The reference implements the RBCD solver of the T-RO 2021 paper but not its
+certification half (no certificate code exists in ``/root/reference/src``);
+this driver exposes the framework's implementation (``dpgo_tpu.models.
+certify``): solve the rank-r relaxation, test global optimality with the
+dual-certificate minimum-eigenvalue solve, and climb the staircase
+r -> r + 1 on failure until the solution is certified (BASELINE config #5
+scope).
+
+Usage:
+    python examples/certification_example.py DATASET.g2o [--r-min R]
+        [--r-max R] [--eta 1e-5] [--log-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import setup_jax  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dataset", help="input .g2o file")
+    ap.add_argument("--r-min", type=int, default=None,
+                    help="starting relaxation rank (default d + 1)")
+    ap.add_argument("--r-max", type=int, default=10)
+    ap.add_argument("--eta", type=float, default=1e-5,
+                    help="certificate tolerance on lambda_min(S)")
+    ap.add_argument("--max-iters", type=int, default=300)
+    ap.add_argument("--grad-norm-tol", type=float, default=1e-6)
+    ap.add_argument("--log-dir", default=None)
+    args = ap.parse_args()
+
+    setup_jax()
+
+    from dpgo_tpu.models.certify import solve_staircase
+    from dpgo_tpu.utils import logger
+    from dpgo_tpu.utils.g2o import read_g2o
+
+    meas = read_g2o(args.dataset)
+    print(f"Loaded {len(meas)} measurements over {meas.num_poses} poses "
+          f"(SE({meas.d})) from {args.dataset}")
+
+    t0 = time.perf_counter()
+    res = solve_staircase(meas, r_min=args.r_min, r_max=args.r_max,
+                          eta=args.eta, max_iters=args.max_iters,
+                          grad_norm_tol=args.grad_norm_tol, verbose=True)
+    dt = time.perf_counter() - t0
+
+    cert = res.certificate
+    print(f"Staircase finished at rank {res.rank} in {dt:.2f}s: "
+          f"cost {res.cost:.6f}, lambda_min {cert.lambda_min:.3e}, "
+          f"certified={cert.certified}")
+    for rank, cost, lam in res.history:
+        print(f"  rank {rank}: cost {cost:.6f}, lambda_min {lam:.3e}")
+    if cert.certified:
+        print("The rounded trajectory is a certified global optimum of the "
+              "(weighted) PGO problem.")
+    else:
+        print(f"NOT certified at r_max={args.r_max}; consider raising it.")
+
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        logger.log_trajectory(
+            res.T, os.path.join(args.log_dir, "trajectory_optimized.csv"))
+        print(f"Saved certified trajectory to {args.log_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Profiling hooks (SURVEY.md section 5 tracing equivalent)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from dpgo_tpu.utils import profiling
+
+
+def test_trace_writes_profile(tmp_path):
+    logdir = str(tmp_path / "trace")
+    with profiling.trace(logdir):
+        with profiling.annotate("work"):
+            x = jnp.ones((64, 64)) @ jnp.ones((64, 64))
+            np.asarray(x)
+    found = [os.path.join(r, f) for r, _d, fs in os.walk(logdir) for f in fs]
+    assert found, "profiler produced no trace files"
+
+
+def test_round_timer_accumulates():
+    t = profiling.RoundTimer()
+    with t.phase("solve", sync_fn=lambda: jnp.ones(4)):
+        jnp.ones(8)
+    t.start("exchange")
+    t.stop("exchange")
+    with t.phase("solve"):
+        pass
+    assert t.counts == {"solve": 2, "exchange": 1}
+    assert all(v >= 0.0 for v in t.totals.values())
+    s = t.summary()
+    assert "solve" in s and "exchange" in s
