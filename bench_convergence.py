@@ -148,10 +148,10 @@ def polish_main():
     _ = float(cost_of(rbcd.rbcd_steps(state, graph, 1, meta, params)))  # compile
     state = rbcd.init_state(graph, meta, X0, params=params)
 
+    f = float(cost_of(state))  # also covers MAX_ROUNDS < 5 (loop never runs)
     t0 = time.perf_counter()
     rounds = 0
     reached = False
-    f = float(cost_of(state))
     while rounds < MAX_ROUNDS:
         state = rbcd.rbcd_steps(state, graph, 5, meta, params)
         rounds += 5

@@ -46,6 +46,7 @@ import numpy as np
 from ..config import AgentParams, ROptAlg, RobustCostType, Schedule
 from .. import robust
 from ..types import EdgeSet, Measurements, edge_set_from_measurements
+from ..utils.graph_plan import plan_topology
 from ..utils.lie import lifting_matrix as _lifting_matrix
 from ..utils.partition import Partition, partition_contiguous
 from ..ops import chordal, manifold, quadratic, solver
@@ -131,108 +132,46 @@ class RBCDState(NamedTuple):
 
 
 def build_graph(part: Partition, rank: int, dtype=jnp.float32,
-                pallas_sel: bool | None = None):
+                pallas_sel: bool | None = None, planner: str = "auto"):
     """Assemble padded per-agent arrays from a partitioned measurement set.
 
     Each shared measurement appears in both endpoint agents' edge lists with
     the remote endpoint redirected to a neighbor slot — the same double
     bookkeeping as ``PGOAgent::addSharedLoopClosure`` (reference
     ``PGOAgent.cpp:228-248``), but as index arrays instead of dictionaries.
+    Topology (edge rows, slot tables, ELL incidence) comes from the planner
+    (``utils.graph_plan``: native C++ when available, Python fallback —
+    identical output); the per-edge payload scatter here is vectorized
+    numpy.
     """
     A = part.num_robots
     meas = part.meas
     d = meas.d
     n_max = part.n_max
-    M = len(meas)
 
-    # Public poses: local endpoints of inter-robot edges.
-    pub: list[dict[int, int]] = [dict() for _ in range(A)]
-    for k in range(M):
-        a, b = int(meas.r1[k]), int(meas.r2[k])
-        if a != b:
-            pub[a].setdefault(int(meas.p1[k]), len(pub[a]))
-            pub[b].setdefault(int(meas.p2[k]), len(pub[b]))
-
-    # Neighbor slots: remote (robot, pose) pairs referenced per agent.
-    nbr: list[dict[tuple[int, int], int]] = [dict() for _ in range(A)]
-    edge_rows: list[list[tuple]] = [[] for _ in range(A)]  # (i, j, meas_id)
-    for k in range(M):
-        a, b = int(meas.r1[k]), int(meas.r2[k])
-        p, q = int(meas.p1[k]), int(meas.p2[k])
-        if a == b:
-            edge_rows[a].append((p, q, k))
-        else:
-            sa = nbr[a].setdefault((b, q), len(nbr[a]))
-            edge_rows[a].append((p, n_max + sa, k))
-            sb = nbr[b].setdefault((a, p), len(nbr[b]))
-            edge_rows[b].append((n_max + sb, q, k))
-
-    e_max = max(1, max(len(r) for r in edge_rows))
-    s_max = max(1, max(len(x) for x in nbr))
-    p_max = max(1, max(len(x) for x in pub))
+    plan = plan_topology(meas.r1, meas.p1, meas.r2, meas.p2, A, n_max,
+                         backend=planner)
+    e_max, s_max, p_max = plan.e_max, plan.s_max, plan.p_max
 
     cls = part.classify()  # 0 odo, 1 private LC, 2 shared
 
-    ei = np.zeros((A, e_max), np.int32)
-    ej = np.zeros((A, e_max), np.int32)
+    # Vectorized per-edge payload scatter over the planned rows.
+    valid = plan.emask  # [A, e_max] bool
+    kk = plan.meas_id[valid]  # global measurement id per valid (a, idx)
     eR = np.tile(np.eye(d), (A, e_max, 1, 1))
     et = np.zeros((A, e_max, d))
     ekap = np.zeros((A, e_max))
     etau = np.zeros((A, e_max))
-    emask = np.zeros((A, e_max))
     eis_lc = np.zeros((A, e_max))
     efix = np.zeros((A, e_max))
     eweight = np.ones((A, e_max))
-    meas_id = np.zeros((A, e_max), np.int32)
-
-    for a in range(A):
-        for idx, (i, j, k) in enumerate(edge_rows[a]):
-            ei[a, idx] = i
-            ej[a, idx] = j
-            eR[a, idx] = meas.R[k]
-            et[a, idx] = meas.t[k]
-            ekap[a, idx] = meas.kappa[k]
-            etau[a, idx] = meas.tau[k]
-            emask[a, idx] = 1.0
-            eis_lc[a, idx] = 0.0 if cls[k] == 0 else 1.0
-            efix[a, idx] = float(meas.is_known_inlier[k])
-            eweight[a, idx] = meas.weight[k]
-            meas_id[a, idx] = k
-
-    pub_idx = np.zeros((A, p_max), np.int64)
-    pub_mask = np.zeros((A, p_max))
-    for a in range(A):
-        for q, pos in pub[a].items():
-            pub_idx[a, pos] = q
-            pub_mask[a, pos] = 1.0
-
-    nbr_robot = np.zeros((A, s_max), np.int32)
-    nbr_pub = np.zeros((A, s_max), np.int32)
-    nbr_mask = np.zeros((A, s_max))
-    for a in range(A):
-        for (b, q), slot in nbr[a].items():
-            nbr_robot[a, slot] = b
-            nbr_pub[a, slot] = pub[b][q]
-            nbr_mask[a, slot] = 1.0
-
-    # ELL incidence of local poses: which [gi | gj] slots accumulate into
-    # each pose (slot = edge index for endpoint i, e_max + edge index for
-    # endpoint j).  Pose-graph degree is small (~4-12), so K stays tiny.
-    inc: list[list[list[int]]] = [[[] for _ in range(n_max)] for _ in range(A)]
-    for a in range(A):
-        for idx, (i, j, _k) in enumerate(edge_rows[a]):
-            if i < n_max:
-                inc[a][i].append(idx)
-            if j < n_max:
-                inc[a][j].append(e_max + idx)
-    k_max = max(1, max((len(s) for rows in inc for s in rows), default=1))
-    inc_slot = np.zeros((A, n_max, k_max), np.int32)
-    inc_mask = np.zeros((A, n_max, k_max))
-    for a in range(A):
-        for v in range(n_max):
-            for c, slot in enumerate(inc[a][v]):
-                inc_slot[a, v, c] = slot
-                inc_mask[a, v, c] = 1.0
+    eR[valid] = meas.R[kk]
+    et[valid] = meas.t[kk]
+    ekap[valid] = meas.kappa[kk]
+    etau[valid] = meas.tau[kk]
+    eis_lc[valid] = (cls[kk] != 0).astype(np.float64)
+    efix[valid] = np.asarray(meas.is_known_inlier, bool)[kk].astype(np.float64)
+    eweight[valid] = meas.weight[kk]
 
     # One-hot selection matrices for the Pallas tCG kernel, bounded to a
     # memory budget ([A, E, n] f32 x 2; beyond it the kernel is skipped and
@@ -247,16 +186,13 @@ def build_graph(part: Partition, rank: int, dtype=jnp.float32,
         sel_j = np.zeros((A, e_max, n_max), np.float32)
         seln_i = np.zeros((A, e_max, s_max), np.float32)
         seln_j = np.zeros((A, e_max, s_max), np.float32)
-        for a in range(A):
-            for idx, (i, j, _k) in enumerate(edge_rows[a]):
-                if i < n_max:
-                    sel_i[a, idx, i] = 1.0
-                else:
-                    seln_i[a, idx, i - n_max] = 1.0
-                if j < n_max:
-                    sel_j[a, idx, j] = 1.0
-                else:
-                    seln_j[a, idx, j - n_max] = 1.0
+        aa, ee = np.nonzero(valid)
+        for endpoint, sel, seln in ((plan.ei, sel_i, seln_i),
+                                    (plan.ej, sel_j, seln_j)):
+            idx = endpoint[aa, ee]
+            loc = idx < n_max
+            sel[aa[loc], ee[loc], idx[loc]] = 1.0
+            seln[aa[~loc], ee[~loc], idx[~loc] - n_max] = 1.0
         rot_c = np.ascontiguousarray(
             eR.transpose(0, 2, 3, 1).reshape(A, d * d, e_max))
         trn_c = np.ascontiguousarray(et.transpose(0, 2, 1))
@@ -271,25 +207,26 @@ def build_graph(part: Partition, rank: int, dtype=jnp.float32,
     pose_mask = (np.arange(n_max)[None, :] < part.n[:, None]).astype(np.float64)
 
     edges = EdgeSet(
-        i=jnp.asarray(ei), j=jnp.asarray(ej),
+        i=jnp.asarray(plan.ei), j=jnp.asarray(plan.ej),
         R=jnp.asarray(eR, dtype), t=jnp.asarray(et, dtype),
         kappa=jnp.asarray(ekap, dtype), tau=jnp.asarray(etau, dtype),
-        weight=jnp.asarray(eweight, dtype), mask=jnp.asarray(emask, dtype),
+        weight=jnp.asarray(eweight, dtype),
+        mask=jnp.asarray(valid.astype(np.float64), dtype),
         is_lc=jnp.asarray(eis_lc, dtype), fixed_weight=jnp.asarray(efix, dtype),
     )
     graph = MultiAgentGraph(
         edges=edges,
-        meas_id=jnp.asarray(meas_id),
+        meas_id=jnp.asarray(plan.meas_id.astype(np.int32)),
         n=jnp.asarray(part.n, jnp.int32),
         pose_mask=jnp.asarray(pose_mask, dtype),
-        pub_idx=jnp.asarray(np.maximum(pub_idx, 0), jnp.int32),
-        pub_mask=jnp.asarray(pub_mask, dtype),
-        nbr_robot=jnp.asarray(nbr_robot),
-        nbr_pub=jnp.asarray(nbr_pub),
-        nbr_mask=jnp.asarray(nbr_mask, dtype),
+        pub_idx=jnp.asarray(np.maximum(plan.pub_idx, 0), jnp.int32),
+        pub_mask=jnp.asarray(plan.pub_mask.astype(np.float64), dtype),
+        nbr_robot=jnp.asarray(plan.nbr_robot),
+        nbr_pub=jnp.asarray(plan.nbr_pub),
+        nbr_mask=jnp.asarray(plan.nbr_mask.astype(np.float64), dtype),
         global_index=jnp.asarray(np.maximum(part.global_index, 0), jnp.int32),
-        inc_slot=jnp.asarray(inc_slot),
-        inc_mask=jnp.asarray(inc_mask, dtype),
+        inc_slot=jnp.asarray(plan.inc_slot),
+        inc_mask=jnp.asarray(plan.inc_mask.astype(np.float64), dtype),
         **pallas_fields,
     )
     meta = GraphMeta(num_robots=A, n_max=n_max, e_max=e_max, s_max=s_max,

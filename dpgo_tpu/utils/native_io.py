@@ -69,7 +69,11 @@ def load_library():
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.exists(_LIB_PATH) and not _build_library():
+        # Always run make when the source tree is present: the Makefile's
+        # dependency check makes it a no-op when current, and it rebuilds a
+        # stale .so (e.g. one predating a newly added native component)
+        # that would otherwise be served with missing symbols.
+        if not _build_library() and not os.path.exists(_LIB_PATH):
             _load_failed = True
             return None
         try:

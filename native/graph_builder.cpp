@@ -1,0 +1,219 @@
+// Native multi-agent graph planner for dpgo_tpu.
+//
+// The reference ingests and classifies measurements in C++
+// (PGOAgent::setPoseGraph + addOdometry/add*LoopClosure,
+// src/PGOAgent.cpp:126-248, building index maps of public poses and
+// neighbor references).  This is the equivalent host-runtime component for
+// the batched TPU layout (models/rbcd.py build_graph): given the edge
+// endpoints (robot, pose) it computes, per agent,
+//   * the padded edge rows (i, j, measurement id) where remote endpoints
+//     are redirected to neighbor slots  [A, e_max]
+//   * the public-pose table (local poses touched by inter-robot edges)
+//     [A, p_max]
+//   * the neighbor-slot table (remote robot, remote public position)
+//     [A, s_max]
+//   * the ELL incidence of local poses over the [gi | gj] edge-gradient
+//     concatenation  [A, n_max, k_max]
+// mirroring the Python planner exactly (same insertion orders, so the two
+// backends produce identical arrays).  Payload scatter (rotations,
+// weights, one-hot selection matrices) stays in numpy — it is already
+// vectorized there.
+//
+// Plain C ABI for ctypes.  The library allocates, the caller copies into
+// numpy and calls dpgo_graph_free.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct PlanOut {
+  int32_t A = 0;
+  int32_t n_max = 0;
+  int32_t e_max = 0;
+  int32_t s_max = 0;
+  int32_t p_max = 0;
+  int32_t k_max = 0;
+  // [A * e_max]
+  int32_t* ei = nullptr;
+  int32_t* ej = nullptr;
+  int64_t* meas_id = nullptr;
+  uint8_t* emask = nullptr;
+  // [A * p_max]
+  int64_t* pub_idx = nullptr;
+  uint8_t* pub_mask = nullptr;
+  // [A * s_max]
+  int32_t* nbr_robot = nullptr;
+  int32_t* nbr_pub = nullptr;
+  uint8_t* nbr_mask = nullptr;
+  // [A * n_max * k_max]
+  int32_t* inc_slot = nullptr;
+  uint8_t* inc_mask = nullptr;
+  char error[256] = {0};
+};
+
+inline uint64_t pair_key(int32_t robot, int64_t pose) {
+  // Poses are dataset indices (< 2^40 by a wide margin); robots < 2^16.
+  return (static_cast<uint64_t>(static_cast<uint32_t>(robot)) << 40) ^
+         static_cast<uint64_t>(pose);
+}
+
+template <typename T>
+T* zalloc(size_t n) {
+  return static_cast<T*>(std::calloc(n ? n : 1, sizeof(T)));
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success, nonzero with out->error set otherwise.
+int dpgo_graph_plan(int64_t M, const int32_t* r1, const int64_t* p1,
+                    const int32_t* r2, const int64_t* p2, int32_t A,
+                    int32_t n_max, PlanOut* out) {
+  if (A <= 0 || n_max <= 0) {
+    std::snprintf(out->error, sizeof(out->error),
+                  "A (%d) and n_max (%d) must be positive", A, n_max);
+    return 2;
+  }
+  out->A = A;
+  out->n_max = n_max;
+
+  // Pass 1: insertion-ordered public poses and neighbor slots per agent,
+  // plus each agent's edge rows — the same scan order as the Python
+  // planner so positions match exactly.
+  std::vector<std::unordered_map<int64_t, int32_t>> pub(A);   // pose -> position
+  std::vector<std::vector<int64_t>> pub_order(A);
+  std::vector<std::unordered_map<uint64_t, int32_t>> nbr(A);  // (robot,pose) -> slot
+  std::vector<std::vector<std::pair<int32_t, int64_t>>> nbr_order(A);
+  struct Row {
+    int64_t i, j, k;
+  };
+  std::vector<std::vector<Row>> rows(A);
+
+  // First scan assigns public positions (both endpoints of each
+  // inter-robot edge), mirroring the Python first loop.
+  for (int64_t k = 0; k < M; ++k) {
+    const int32_t a = r1[k], b = r2[k];
+    if (a < 0 || a >= A || b < 0 || b >= A) {
+      std::snprintf(out->error, sizeof(out->error),
+                    "edge %lld references robot out of range [0, %d)",
+                    static_cast<long long>(k), A);
+      return 2;
+    }
+    if (a != b) {
+      if (pub[a].emplace(p1[k], (int32_t)pub_order[a].size()).second)
+        pub_order[a].push_back(p1[k]);
+      if (pub[b].emplace(p2[k], (int32_t)pub_order[b].size()).second)
+        pub_order[b].push_back(p2[k]);
+    }
+  }
+  // Second scan assigns neighbor slots and builds edge rows.
+  for (int64_t k = 0; k < M; ++k) {
+    const int32_t a = r1[k], b = r2[k];
+    const int64_t p = p1[k], q = p2[k];
+    if (p < 0 || p >= n_max || q < 0 || q >= n_max) {
+      std::snprintf(out->error, sizeof(out->error),
+                    "edge %lld pose index out of range [0, %d)",
+                    static_cast<long long>(k), n_max);
+      return 2;
+    }
+    if (a == b) {
+      rows[a].push_back({p, q, k});
+    } else {
+      auto ins_a = nbr[a].emplace(pair_key(b, q), (int32_t)nbr_order[a].size());
+      if (ins_a.second) nbr_order[a].push_back({b, q});
+      rows[a].push_back({p, n_max + ins_a.first->second, k});
+      auto ins_b = nbr[b].emplace(pair_key(a, p), (int32_t)nbr_order[b].size());
+      if (ins_b.second) nbr_order[b].push_back({a, p});
+      rows[b].push_back({n_max + ins_b.first->second, q, k});
+    }
+  }
+
+  int64_t e_max = 1, s_max = 1, p_max = 1;
+  for (int32_t a = 0; a < A; ++a) {
+    if ((int64_t)rows[a].size() > e_max) e_max = rows[a].size();
+    if ((int64_t)nbr_order[a].size() > s_max) s_max = nbr_order[a].size();
+    if ((int64_t)pub_order[a].size() > p_max) p_max = pub_order[a].size();
+  }
+  out->e_max = (int32_t)e_max;
+  out->s_max = (int32_t)s_max;
+  out->p_max = (int32_t)p_max;
+
+  out->ei = zalloc<int32_t>(A * e_max);
+  out->ej = zalloc<int32_t>(A * e_max);
+  out->meas_id = zalloc<int64_t>(A * e_max);
+  out->emask = zalloc<uint8_t>(A * e_max);
+  out->pub_idx = zalloc<int64_t>(A * p_max);
+  out->pub_mask = zalloc<uint8_t>(A * p_max);
+  out->nbr_robot = zalloc<int32_t>(A * s_max);
+  out->nbr_pub = zalloc<int32_t>(A * s_max);
+  out->nbr_mask = zalloc<uint8_t>(A * s_max);
+
+  // ELL incidence: count local-pose degrees over [gi | gj] slots.
+  std::vector<std::vector<std::vector<int32_t>>> inc(A);
+  int64_t k_max = 1;
+  for (int32_t a = 0; a < A; ++a) {
+    inc[a].assign(n_max, {});
+    for (size_t idx = 0; idx < rows[a].size(); ++idx) {
+      const Row& r = rows[a][idx];
+      if (r.i < n_max) inc[a][r.i].push_back((int32_t)idx);
+      if (r.j < n_max) inc[a][r.j].push_back((int32_t)(e_max + idx));
+    }
+    for (int32_t v = 0; v < n_max; ++v)
+      if ((int64_t)inc[a][v].size() > k_max) k_max = inc[a][v].size();
+  }
+  out->k_max = (int32_t)k_max;
+  out->inc_slot = zalloc<int32_t>((int64_t)A * n_max * k_max);
+  out->inc_mask = zalloc<uint8_t>((int64_t)A * n_max * k_max);
+
+  for (int32_t a = 0; a < A; ++a) {
+    for (size_t idx = 0; idx < rows[a].size(); ++idx) {
+      const Row& r = rows[a][idx];
+      out->ei[a * e_max + idx] = (int32_t)r.i;
+      out->ej[a * e_max + idx] = (int32_t)r.j;
+      out->meas_id[a * e_max + idx] = r.k;
+      out->emask[a * e_max + idx] = 1;
+    }
+    for (size_t pos = 0; pos < pub_order[a].size(); ++pos) {
+      out->pub_idx[a * p_max + pos] = pub_order[a][pos];
+      out->pub_mask[a * p_max + pos] = 1;
+    }
+    for (size_t slot = 0; slot < nbr_order[a].size(); ++slot) {
+      out->nbr_robot[a * s_max + slot] = nbr_order[a][slot].first;
+      const int32_t b = nbr_order[a][slot].first;
+      out->nbr_pub[a * s_max + slot] =
+          pub[b].at(nbr_order[a][slot].second);
+      out->nbr_mask[a * s_max + slot] = 1;
+    }
+    for (int32_t v = 0; v < n_max; ++v) {
+      const auto& lst = inc[a][v];
+      for (size_t c = 0; c < lst.size(); ++c) {
+        out->inc_slot[((int64_t)a * n_max + v) * k_max + c] = lst[c];
+        out->inc_mask[((int64_t)a * n_max + v) * k_max + c] = 1;
+      }
+    }
+  }
+  return 0;
+}
+
+void dpgo_graph_free(PlanOut* out) {
+  std::free(out->ei);
+  std::free(out->ej);
+  std::free(out->meas_id);
+  std::free(out->emask);
+  std::free(out->pub_idx);
+  std::free(out->pub_mask);
+  std::free(out->nbr_robot);
+  std::free(out->nbr_pub);
+  std::free(out->nbr_mask);
+  std::free(out->inc_slot);
+  std::free(out->inc_mask);
+  *out = PlanOut{};
+}
+
+}  // extern "C"
