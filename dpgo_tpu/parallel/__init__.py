@@ -1,7 +1,9 @@
 """Distributed (device-mesh) execution layer — see ``sharded.py``."""
 
-from .sharded import (AXIS, make_mesh, make_sharded_multi_step,
-                      make_sharded_step, shard_problem, solve_rbcd_sharded)
+from .sharded import (AXIS, comm_bytes_per_round, make_mesh,
+                      make_sharded_multi_step, make_sharded_step,
+                      shard_problem, solve_rbcd_sharded)
 
-__all__ = ["AXIS", "make_mesh", "make_sharded_multi_step",
-           "make_sharded_step", "shard_problem", "solve_rbcd_sharded"]
+__all__ = ["AXIS", "comm_bytes_per_round", "make_mesh",
+           "make_sharded_multi_step", "make_sharded_step", "shard_problem",
+           "solve_rbcd_sharded"]

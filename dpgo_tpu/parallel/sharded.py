@@ -158,6 +158,32 @@ def make_sharded_multi_step(mesh: Mesh, meta: GraphMeta, params: AgentParams,
     return steps
 
 
+def comm_bytes_per_round(meta: GraphMeta, mesh_size: int,
+                         shifts: tuple | None = None,
+                         accel: bool = False, itemsize: int = 4) -> int:
+    """Modeled per-device ICI/DCN bytes for one round's pose exchange —
+    the mesh analog of the reference driver's hand-counted communication
+    bytes (``MultiRobotExample.cpp:60,143,195,209,274-279``; the in-process
+    model lives in ``examples/multi_robot_example.py``).
+
+    all_gather (``shifts=None``) moves each device's public table to every
+    other device: ``mesh_size - 1`` table hops on a ring.  The ppermute
+    route moves it once per planned shift (``len(shifts)`` hops).  Nesterov
+    acceleration doubles the volume (aux poses Y exchanged too); the greedy
+    schedule's [A]-float gradient-norm all_gather is included.
+    """
+    if meta.num_robots % mesh_size != 0:
+        raise ValueError(
+            f"num_robots={meta.num_robots} must be a multiple of "
+            f"mesh_size={mesh_size} (shard_problem's layout)")
+    A_loc = meta.num_robots // mesh_size
+    table = A_loc * meta.p_max * meta.rank * (meta.d + 1) * itemsize
+    hops = (mesh_size - 1) if shifts is None else len(shifts)
+    exchanges = 2 if accel else 1
+    greedy_gather = (mesh_size - 1) * A_loc * itemsize
+    return exchanges * hops * table + greedy_gather
+
+
 def solve_rbcd_sharded(
     meas: Measurements,
     num_robots: int,

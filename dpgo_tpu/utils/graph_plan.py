@@ -138,9 +138,17 @@ def plan_native(r1, p1, r2, p2, num_robots: int, n_max: int) -> TopologyPlan:
 
 
 def plan_python(r1, p1, r2, p2, num_robots: int, n_max: int) -> TopologyPlan:
-    """Pure-Python planner — the specification the native backend mirrors."""
+    """Pure-Python planner — the specification the native backend mirrors
+    (including input validation, so both backends fail identically on bad
+    indices instead of one silently corrupting the plan)."""
     A = num_robots
     M = len(r1)
+    r = np.concatenate([np.asarray(r1), np.asarray(r2)])
+    p = np.concatenate([np.asarray(p1), np.asarray(p2)])
+    if M and ((r < 0).any() or (r >= A).any()):
+        raise ValueError(f"edge references robot out of range [0, {A})")
+    if M and ((p < 0).any() or (p >= n_max).any()):
+        raise ValueError(f"edge pose index out of range [0, {n_max})")
 
     pub: list[dict[int, int]] = [dict() for _ in range(A)]
     for k in range(M):

@@ -172,6 +172,21 @@ def lifting_matrix(rank: int, d: int, dtype=jnp.float32) -> jax.Array:
     return fixed_stiefel(rank, d, dtype)
 
 
+def check_rotation_matrix(R, tol: float = 1e-8) -> bool:
+    """Validate SO(d) membership: det +1 and orthonormal within ``tol``
+    (reference ``checkRotationMatrix``, ``DPGO_utils.cpp:526-531`` — an
+    assert there; a boolean here so callers choose raise vs mask).
+    Batched: returns an [...] bool array for [..., d, d] input."""
+    R = np.asarray(R)
+    d = R.shape[-1]
+    det_ok = np.abs(np.linalg.det(R) - 1.0) < tol
+    eye = np.eye(d)
+    orth = np.linalg.norm(
+        np.swapaxes(R, -1, -2) @ R - eye, axis=(-2, -1)) < tol
+    out = det_ok & orth
+    return bool(out) if out.ndim == 0 else out
+
+
 def angular_to_chordal_so3(rad: float) -> float:
     """Angular distance (radians) -> chordal (Frobenius) distance on SO(3).
 

@@ -71,6 +71,8 @@ T* zalloc(size_t n) {
 
 extern "C" {
 
+void dpgo_graph_free(PlanOut* out);
+
 // Returns 0 on success, nonzero with out->error set otherwise.
 int dpgo_graph_plan(int64_t M, const int32_t* r1, const int64_t* p1,
                     const int32_t* r2, const int64_t* p2, int32_t A,
@@ -153,6 +155,13 @@ int dpgo_graph_plan(int64_t M, const int32_t* r1, const int64_t* p1,
   out->nbr_robot = zalloc<int32_t>(A * s_max);
   out->nbr_pub = zalloc<int32_t>(A * s_max);
   out->nbr_mask = zalloc<uint8_t>(A * s_max);
+  if (!out->ei || !out->ej || !out->meas_id || !out->emask ||
+      !out->pub_idx || !out->pub_mask || !out->nbr_robot || !out->nbr_pub ||
+      !out->nbr_mask) {
+    dpgo_graph_free(out);
+    std::snprintf(out->error, sizeof(out->error), "out of memory");
+    return 3;
+  }
 
   // ELL incidence: count local-pose degrees over [gi | gj] slots.
   std::vector<std::vector<std::vector<int32_t>>> inc(A);
@@ -170,6 +179,11 @@ int dpgo_graph_plan(int64_t M, const int32_t* r1, const int64_t* p1,
   out->k_max = (int32_t)k_max;
   out->inc_slot = zalloc<int32_t>((int64_t)A * n_max * k_max);
   out->inc_mask = zalloc<uint8_t>((int64_t)A * n_max * k_max);
+  if (!out->inc_slot || !out->inc_mask) {
+    dpgo_graph_free(out);
+    std::snprintf(out->error, sizeof(out->error), "out of memory");
+    return 3;
+  }
 
   for (int32_t a = 0; a < A; ++a) {
     for (size_t idx = 0; idx < rows[a].size(); ++idx) {

@@ -41,14 +41,23 @@ def test_native_matches_python_on_dataset(data_dir):
                               np.asarray(getattr(b, f))), f
 
 
-@pytest.mark.skipif(not NATIVE, reason="native library unavailable")
-def test_native_rejects_bad_input():
+@pytest.mark.parametrize("backend", ["native", "python"])
+def test_planners_reject_bad_input(backend):
+    """Both backends must fail identically on invalid indices (a silent
+    wrong plan on one of them would make behavior depend on toolchain
+    availability)."""
+    if backend == "native" and not NATIVE:
+        pytest.skip("native library unavailable")
+    plan = getattr(graph_plan, f"plan_{backend}")
     r1 = np.array([0], np.int32)
     p1 = np.array([0], np.int64)
     r2 = np.array([5], np.int32)  # robot out of range for A=2
     p2 = np.array([0], np.int64)
     with pytest.raises(ValueError, match="out of range"):
-        graph_plan.plan_native(r1, p1, r2, p2, 2, 4)
+        plan(r1, p1, r2, p2, 2, 4)
+    with pytest.raises(ValueError, match="out of range"):
+        plan(np.array([0], np.int32), np.array([9], np.int64),
+             np.array([1], np.int32), np.array([0], np.int64), 2, 4)
 
 
 def test_build_graph_planner_backends_agree(rng):

@@ -83,3 +83,17 @@ def test_random_stiefel_batch():
     Y = np.asarray(Y)
     eye = np.broadcast_to(np.eye(3), (7, 3, 3))
     assert np.allclose(np.swapaxes(Y, -1, -2) @ Y, eye, atol=1e-12)
+
+
+def test_check_rotation_matrix(rng):
+    """checkRotationMatrix parity (reference DPGO_utils.cpp:526-531)."""
+    from dpgo_tpu.utils.synthetic import random_rotation
+
+    R = random_rotation(rng)
+    assert lie.check_rotation_matrix(R)
+    assert not lie.check_rotation_matrix(2.0 * R)          # not orthonormal
+    Rf = R.copy()
+    Rf[:, 0] *= -1.0                                        # det -1
+    assert not lie.check_rotation_matrix(Rf)
+    batch = np.stack([R, Rf])
+    assert lie.check_rotation_matrix(batch).tolist() == [True, False]

@@ -202,6 +202,28 @@ def test_ppermute_solve_end_to_end(data_dir):
     np.testing.assert_array_equal(np.asarray(res_p.T), np.asarray(res_a.T))
 
 
+def test_comm_bytes_model(rng):
+    """The ppermute route must model strictly less traffic than all_gather
+    on a chain-adjacency partition, and acceleration doubles the exchange."""
+    from dpgo_tpu.models.rbcd import plan_ppermute
+    from dpgo_tpu.parallel import comm_bytes_per_round
+
+    meas, _ = make_measurements(rng, n=64, d=3, num_lc=0)  # pure chain
+    params = AgentParams(d=3, r=5, num_robots=8)
+    part = partition_contiguous(meas, 8)
+    graph, meta = rbcd.build_graph(part, 5, jnp.float64)
+    shifts, _plan = plan_ppermute(graph, 8, 8)
+    # Odometry chain: only +-1 device adjacency.
+    assert set(shifts) <= {1, 7}
+    ag = comm_bytes_per_round(meta, 8)
+    pp = comm_bytes_per_round(meta, 8, shifts=shifts)
+    assert pp < ag
+    # Acceleration doubles the table exchange (aux poses), not the greedy
+    # gradient-norm gather.
+    greedy = (8 - 1) * (meta.num_robots // 8) * 4
+    assert comm_bytes_per_round(meta, 8, accel=True) == 2 * (ag - greedy) + greedy
+
+
 def test_ppermute_plan_routing(rng):
     """plan_ppermute routes every masked neighbor slot to the correct
     (shift, local robot) pair and only emits shifts that carry edges."""
