@@ -71,6 +71,13 @@ class SolverParams:
     CG inner iterations, gradnorm tolerance 1e-2, initial radius 100, and the
     shrink-on-reject loop of ``QuadraticOptimizer.cpp:92-110`` (radius /= 4,
     at most 10 rejections).
+
+    The reference additionally bounds each solve by 5 s of wall clock
+    (``QuadraticOptimizer.cpp:90``).  A data-dependent time bound cannot
+    exist inside a compiled XLA program; the equivalent safety here is that
+    every loop has a static trip count (outer/inner iteration caps,
+    rejection cap), so a solve's cost is bounded at compile time rather
+    than interrupted at runtime.
     """
 
     algorithm: ROptAlg = ROptAlg.RTR

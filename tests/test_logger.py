@@ -108,3 +108,60 @@ def test_checkpoint_roundtrip(tmp_path):
     np.testing.assert_allclose(out.weights, ckpt.weights)
     assert out.mu == ckpt.mu
     assert out.iteration == ckpt.iteration
+
+
+def test_checkpoint_resume_matches_uninterrupted(rng, tmp_path):
+    """The checkpoint/resume contract end to end: a robust RBCD solve
+    checkpointed mid-GNC and resumed into a fresh state (X, weights, mu,
+    iteration + refresh_problem for the carried factors) must continue
+    exactly like the uninterrupted solve."""
+    import jax.numpy as jnp
+
+    from dpgo_tpu.config import AgentParams, RobustCostParams, RobustCostType
+    from dpgo_tpu.models import rbcd
+    from dpgo_tpu.utils.partition import partition_contiguous
+
+    meas, _ = make_measurements(rng, n=20, d=3, num_lc=10, outlier_lc=3,
+                                rot_noise=0.01, trans_noise=0.01)
+    params = AgentParams(
+        d=3, r=5, num_robots=4,
+        robust=RobustCostParams(cost_type=RobustCostType.GNC_TLS,
+                                gnc_barc=0.5),
+        robust_opt_inner_iters=10)
+    part = partition_contiguous(meas, 4)
+    graph, meta = rbcd.build_graph(part, params.r, jnp.float64)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float64)
+
+    def step_to(state, start, stop):
+        for it in range(start, stop):
+            uw = (it + 1) % params.robust_opt_inner_iters == 0
+            state = rbcd.rbcd_step(state, graph, meta, params,
+                                   update_weights=uw)
+        return state
+
+    # Uninterrupted run to round 40, checkpointing at 25.
+    state = rbcd.init_state(graph, meta, X0, params=params)
+    state = step_to(state, 0, 25)
+    ckpt = logger.Checkpoint(X=np.asarray(state.X),
+                             weights=np.asarray(state.weights),
+                             mu=float(state.mu),
+                             iteration=int(state.iteration))
+    logger.save_checkpoint(ckpt, str(tmp_path))
+    full = step_to(state, 25, 40)
+
+    # Fresh process: rebuild the problem, load, resume.
+    loaded = logger.load_checkpoint(str(tmp_path))
+    resumed = rbcd.init_state(graph, meta, X0, params=params)
+    resumed = resumed._replace(
+        X=jnp.asarray(loaded.X), weights=jnp.asarray(loaded.weights),
+        mu=jnp.asarray(loaded.mu, jnp.float64),
+        iteration=jnp.asarray(loaded.iteration, jnp.int32))
+    resumed = rbcd.refresh_problem(resumed, graph, meta, params)
+    resumed = step_to(resumed, 25, 40)
+
+    assert int(resumed.iteration) == int(full.iteration) == 40
+    np.testing.assert_allclose(np.asarray(resumed.X), np.asarray(full.X),
+                               atol=1e-12)
+    np.testing.assert_allclose(np.asarray(resumed.weights),
+                               np.asarray(full.weights), atol=1e-12)
+    assert np.isclose(float(resumed.mu), float(full.mu))
