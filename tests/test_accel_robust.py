@@ -182,3 +182,31 @@ def test_gnc_accelerated(rng):
     w = np.asarray(res.weights)
     assert np.all(w[-4:] < 0.01)
     assert trajectory_error(res.T, Rs, ts) < 1e-3
+
+
+@pytest.mark.parametrize("cost_type,kw", [
+    (RobustCostType.Huber, dict(huber_threshold=0.5)),
+    # Residuals are sqrt(kappa)-scaled (~0.1-0.5 for inliers at this noise,
+    # ~20 for gross outliers); the hard TLS cut must sit between.
+    (RobustCostType.TLS, dict(tls_threshold=5.0)),
+    (RobustCostType.GM, dict()),
+    (RobustCostType.L1, dict()),
+])
+def test_non_gnc_robust_costs_downweight_outliers(rng, cost_type, kw):
+    """The reference's RobustCost supports more than GNC_TLS
+    (DPGO_robust.cpp:23-67); every weight function must run through the
+    actual RBCD reweighting loop and pull outlier weights below inlier
+    weights."""
+    meas, (Rs, ts) = make_measurements(rng, n=20, d=3, num_lc=10,
+                                       outlier_lc=3, rot_noise=0.005,
+                                       trans_noise=0.005)
+    params = AgentParams(
+        d=3, r=5, num_robots=4, schedule=Schedule.JACOBI,
+        robust=RobustCostParams(cost_type=cost_type, **kw),
+        robust_opt_inner_iters=10, rel_change_tol=1e-10,
+        solver=SolverParams(grad_norm_tol=1e-6))
+    res = rbcd.solve_rbcd(meas, 4, params, max_iters=60, grad_norm_tol=0.0)
+    w = np.asarray(res.weights)
+    # The 3 outlier loop closures are the last measurements.
+    assert w[-3:].max() < w[:-3].min(), (cost_type, w[-6:])
+    assert res.cost_history[-1] <= res.cost_history[0]
