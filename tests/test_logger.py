@@ -165,3 +165,37 @@ def test_checkpoint_resume_matches_uninterrupted(rng, tmp_path):
     np.testing.assert_allclose(np.asarray(resumed.weights),
                                np.asarray(full.weights), atol=1e-12)
     assert np.isclose(float(resumed.mu), float(full.mu))
+
+
+def test_orbax_checkpoint_roundtrip(rng, tmp_path):
+    """The Orbax backend stores the same Checkpoint contents (atomic
+    directory commit, sharding-aware restore for multi-host runs)."""
+    pytest.importorskip("orbax.checkpoint")
+    ckpt = logger.Checkpoint(
+        X=rng.standard_normal((3, 6, 5, 4)),
+        weights=rng.uniform(size=(3, 9)),
+        mu=0.014,
+        iteration=123,
+    )
+    logger.save_checkpoint_orbax(ckpt, str(tmp_path / "ocp"))
+    out = logger.load_checkpoint_orbax(str(tmp_path / "ocp"))
+    np.testing.assert_allclose(out.X, ckpt.X)
+    np.testing.assert_allclose(out.weights, ckpt.weights)
+    assert out.mu == ckpt.mu
+    assert out.iteration == ckpt.iteration
+
+
+def test_orbax_checkpoint_restore_with_target(rng, tmp_path):
+    """Restoring against an abstract target (the sharding-aware path)."""
+    pytest.importorskip("orbax.checkpoint")
+    ckpt = logger.Checkpoint(
+        X=rng.standard_normal((2, 5, 5, 4)),
+        weights=rng.uniform(size=(2, 6)),
+        mu=2e-3,
+        iteration=9,
+    )
+    logger.save_checkpoint_orbax(ckpt, str(tmp_path / "ocp"))
+    out = logger.load_checkpoint_orbax(str(tmp_path / "ocp"), like=ckpt)
+    np.testing.assert_allclose(out.X, ckpt.X)
+    np.testing.assert_allclose(out.weights, ckpt.weights)
+    assert out.mu == ckpt.mu and out.iteration == ckpt.iteration
