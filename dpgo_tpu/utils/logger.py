@@ -19,6 +19,9 @@ TPU-native equivalent of the reference's ``PGOLogger`` (``src/PGOLogger.cpp``):
   (lifted ``X``, edge weights, GNC ``mu``, iteration counter) for resuming
   an interrupted robust RBCD run; beyond-reference convenience built on the
   same CSV primitives.
+* ``save_checkpoint_orbax`` / ``load_checkpoint_orbax`` — the same bundle
+  through Orbax (atomic directory commits; sharding-aware restore against
+  an abstract target), via the optional ``orbax`` extra.
 
 Unlike the reference, which silently skips 2D problems (``PGOLogger.cpp:27``,
 ``57``), SE(2) trajectories/measurements are logged by embedding the yaw
