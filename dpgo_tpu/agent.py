@@ -318,7 +318,14 @@ class PGOAgent:
         st = self._neighbor_status.get(neighbor_id)
         if st is not None:
             return st.state == AgentState.INITIALIZED
-        # Without gossiped status, receiving poses implies the sender is
+        if self._neighbor_status:
+            # The transport does gossip statuses (we hold some): a neighbor
+            # whose status has not arrived cannot be assumed initialized —
+            # an early-publishing transport would otherwise let us frame-
+            # align against garbage poses (``PGOAgent.cpp:434-458`` gates on
+            # the gossiped ``mState`` for the same reason).
+            return False
+        # Status-less transport: receiving poses implies the sender is
         # initialized (the reference transport only publishes after init).
         return True
 
@@ -656,14 +663,14 @@ class PGOAgent:
         """Mid-run dump with per-robot file names (reference
         ``log_trajectory``, ``PGOAgent.cpp:1301-1319``): measurements incl.
         current GNC weights, the rounded global-frame trajectory as
-        ``robot{id}+trajectory_optimized.csv``, and the raw lifted iterate as
-        ``{id}_X.txt``."""
+        ``robot+{id}+trajectory_optimized.csv``, and the raw lifted iterate
+        as ``{id}_X.txt``."""
         with self._lock:
             if not self.params.log_data:
                 return
             self._log_measurements("measurements.csv")
             self._log_global_trajectory(
-                f"robot{self.robot_id}+trajectory_optimized.csv")
+                f"robot+{self.robot_id}+trajectory_optimized.csv")
             self._log_x(f"{self.robot_id}_X.txt")
 
     # -- data logging (reference PGOLogger wiring) --------------------------

@@ -76,6 +76,8 @@ class RoundTimer:
     def stop(self, phase: str, sync=None) -> float:
         if sync is not None:
             np.asarray(sync)
+        if phase not in self._t0:
+            raise ValueError(f"stop({phase!r}) without a matching start()")
         dt = time.perf_counter() - self._t0.pop(phase)
         self.totals[phase] = self.totals.get(phase, 0.0) + dt
         self.counts[phase] = self.counts.get(phase, 0) + 1

@@ -115,6 +115,26 @@ def test_distributed_initialization_and_consensus_solve():
     assert team_error(agents, part, T_true) < 1e-1
 
 
+def test_early_publishing_uninitialized_neighbor_does_not_align():
+    """On a status-gossiping transport, poses from a neighbor whose status
+    has NOT arrived must not trigger frame alignment — an early-publishing
+    transport could be shipping an uninitialized sender's garbage poses
+    (the reference gates on gossiped ``mState``, ``PGOAgent.cpp:434-458``).
+    """
+    agents, part, T_true = make_agents(3, n=18, num_lc=12)
+    a2 = agents[2]
+    assert a2.get_status().state == AgentState.WAIT_FOR_INITIALIZATION
+    # The transport gossips statuses (a2 holds robot 1's), but robot 0's
+    # poses arrive before robot 0's status: no alignment.
+    a2.set_neighbor_status(agents[1].get_status())
+    a2.update_neighbor_poses(0, agents[0].get_shared_pose_dict())
+    assert a2.get_status().state == AgentState.WAIT_FOR_INITIALIZATION
+    # Once robot 0's INITIALIZED status lands, the next message aligns.
+    a2.set_neighbor_status(agents[0].get_status())
+    a2.update_neighbor_poses(0, agents[0].get_shared_pose_dict())
+    assert a2.get_status().state == AgentState.INITIALIZED
+
+
 def test_accelerated_solve():
     """Accelerated sync RBCD with the reference driver's sequencing
     (MultiRobotExample.cpp:175-217): non-selected agents iterate(false)
@@ -290,7 +310,7 @@ def test_log_data_dumps_on_reset_and_iter50(tmp_path):
         assert (tmp_path / f"robot{rid}" / "trajectory_early_stop.csv").exists()
 
     agents[0].log_trajectory()
-    assert (tmp_path / "robot0" / "robot0+trajectory_optimized.csv").exists()
+    assert (tmp_path / "robot0" / "robot+0+trajectory_optimized.csv").exists()
     assert (tmp_path / "robot0" / "0_X.txt").exists()
 
     for ag in agents:
