@@ -84,17 +84,18 @@ class MultiAgentGraph(NamedTuple):
     # E_max + e = endpoint j.  K = max local pose degree over the partition.
     inc_slot: jax.Array  # [A, n_max, K] into the [gi | gj] concatenation
     inc_mask: jax.Array  # [A, n_max, K]
-    # One-hot endpoint selection matrices + component-major edge data for
-    # the Pallas VMEM solver kernels (``ops.pallas_tcg``); None when the
-    # selection matrices exceed the memory budget.  sel_* select local
-    # endpoints (zero rows for neighbor endpoints), seln_* the neighbor
-    # slots (zero rows for local endpoints).
-    sel_i: jax.Array | None = None   # [A, E_max, n_max] f32 0/1
-    sel_j: jax.Array | None = None   # [A, E_max, n_max]
-    seln_i: jax.Array | None = None  # [A, E_max, s_max]
-    seln_j: jax.Array | None = None  # [A, E_max, s_max]
-    rot_c: jax.Array | None = None   # [A, d*d, E_max]
-    trn_c: jax.Array | None = None   # [A, d, E_max]
+    # Tile-major edge data for the Pallas VMEM solver kernels
+    # (``ops.pallas_tcg``): edges padded to nt * T and stored with the tile
+    # axis leading so the kernel streams one [*, T] tile per ``fori_loop``
+    # step, building each one-hot selection tile on the fly from the int32
+    # endpoint indices (memory O(E), vs the O(E*n) resident one-hot
+    # matrices of the first design).  Padded edges carry index
+    # n_max + s_max, which one-hots to all-zero in both the local and the
+    # neighbor range.  None when built with pallas_sel=False.
+    eidx_i: jax.Array | None = None  # [A, nt, 1, T] int32 into [n+s] buffer
+    eidx_j: jax.Array | None = None  # [A, nt, 1, T]
+    rot_t: jax.Array | None = None   # [A, nt, d*d, T]
+    trn_t: jax.Array | None = None   # [A, nt, d, T]
 
 
 class RBCDState(NamedTuple):
@@ -173,36 +174,36 @@ def build_graph(part: Partition, rank: int, dtype=jnp.float32,
     efix[valid] = np.asarray(meas.is_known_inlier, bool)[kk].astype(np.float64)
     eweight[valid] = meas.weight[kk]
 
-    # One-hot selection matrices for the Pallas tCG kernel, bounded to a
-    # memory budget ([A, E, n] f32 x 2; beyond it the kernel is skipped and
-    # the XLA ELL path runs).  Skipped entirely (pallas_sel=None -> auto)
-    # off-TPU, where the kernel would only ever run in interpreter mode —
-    # force with pallas_sel=True for interpreter-mode testing.
+    # Tile-major edge arrays for the Pallas tCG kernel (int32 endpoint
+    # indices + edge transforms, padded to nt * T — O(E) memory, so no
+    # budget gate is needed at build time).  Skipped entirely
+    # (pallas_sel=None -> auto) off-TPU, where the kernel would only ever
+    # run in interpreter mode — force with pallas_sel=True for
+    # interpreter-mode testing.
     if pallas_sel is None:
         pallas_sel = jax.default_backend() == "tpu"
-    SEL_BUDGET_BYTES = 256 << 20
-    if pallas_sel and 2 * A * e_max * (n_max + s_max) * 4 <= SEL_BUDGET_BYTES:
-        sel_i = np.zeros((A, e_max, n_max), np.float32)
-        sel_j = np.zeros((A, e_max, n_max), np.float32)
-        seln_i = np.zeros((A, e_max, s_max), np.float32)
-        seln_j = np.zeros((A, e_max, s_max), np.float32)
-        aa, ee = np.nonzero(valid)
-        for endpoint, sel, seln in ((plan.ei, sel_i, seln_i),
-                                    (plan.ej, sel_j, seln_j)):
-            idx = endpoint[aa, ee]
-            loc = idx < n_max
-            sel[aa[loc], ee[loc], idx[loc]] = 1.0
-            seln[aa[~loc], ee[~loc], idx[~loc] - n_max] = 1.0
-        rot_c = np.ascontiguousarray(
-            eR.transpose(0, 2, 3, 1).reshape(A, d * d, e_max))
-        trn_c = np.ascontiguousarray(et.transpose(0, 2, 1))
+    if pallas_sel:
+        T, nt = _edge_tile_shape(n_max, s_max, e_max)
+        Ep = nt * T
+        pad_idx = n_max + s_max  # one-hots to all-zero in both ranges
+        idx_i = np.full((A, Ep), pad_idx, np.int32)
+        idx_j = np.full((A, Ep), pad_idx, np.int32)
+        idx_i[:, :e_max][valid] = plan.ei[valid]
+        idx_j[:, :e_max][valid] = plan.ej[valid]
+        rot_flat = np.zeros((A, d * d, Ep), np.float32)
+        trn_flat = np.zeros((A, d, Ep), np.float32)
+        rot_flat[:, :, :e_max] = eR.transpose(0, 2, 3, 1).reshape(
+            A, d * d, e_max)
+        trn_flat[:, :, :e_max] = et.transpose(0, 2, 1)
         pallas_fields = dict(
-            sel_i=jnp.asarray(sel_i), sel_j=jnp.asarray(sel_j),
-            seln_i=jnp.asarray(seln_i), seln_j=jnp.asarray(seln_j),
-            rot_c=jnp.asarray(rot_c, dtype), trn_c=jnp.asarray(trn_c, dtype))
+            eidx_i=jnp.asarray(idx_i.reshape(A, nt, 1, T)),
+            eidx_j=jnp.asarray(idx_j.reshape(A, nt, 1, T)),
+            rot_t=jnp.asarray(np.ascontiguousarray(
+                rot_flat.reshape(A, d * d, nt, T).transpose(0, 2, 1, 3))),
+            trn_t=jnp.asarray(np.ascontiguousarray(
+                trn_flat.reshape(A, d, nt, T).transpose(0, 2, 1, 3))))
     else:
-        pallas_fields = dict(sel_i=None, sel_j=None, seln_i=None,
-                             seln_j=None, rot_c=None, trn_c=None)
+        pallas_fields = dict(eidx_i=None, eidx_j=None, rot_t=None, trn_t=None)
 
     pose_mask = (np.arange(n_max)[None, :] < part.n[:, None]).astype(np.float64)
 
@@ -429,34 +430,40 @@ def use_dense_q(meta: GraphMeta, params: AgentParams | None,
     return meta.num_robots * K * K * itemsize <= DENSE_Q_BUDGET_BYTES
 
 
-#: Per-agent VMEM the Pallas tCG kernel may stage (selection matrices +
-#: loop vectors must fit beside double-buffering headroom on a ~16 MiB
-#: VMEM core).
+#: Per-agent VMEM the Pallas tCG kernel may stage (loop vectors, tiled edge
+#: payloads, and the per-tile transient one-hots must fit beside
+#: double-buffering headroom on a ~16 MiB VMEM core).
 PALLAS_TCG_VMEM_BUDGET_BYTES = 10 << 20
 
 
-#: Empirical Mosaic compile ceiling for the full-RTR kernel on TPU v5e:
-#: shapes with e_max <= 765 / n_max <= 358 compile and run; e_max = 883 /
-#: n_max = 420 crashes the TPU compile helper (HTTP 500 from
-#: tpu_compile_helper, no diagnostic) regardless of d/r.  Gate strictly
-#: inside the verified-good region; larger problems run the XLA ELL path.
-#: Revisit with newer libtpu/Mosaic (the lighter tCG-only kernel compiled
-#: up to e_max 883, so the ceiling tracks total kernel size).
-PALLAS_TCG_MAX_EDGES = 765
-PALLAS_TCG_MAX_POSES = 358
+def _edge_tile_shape(n_max: int, s_max: int, e_max: int) -> tuple[int, int]:
+    """(T, nt) of the kernel's tile-major edge layout.  Adaptive tile: the
+    kernel's transient one-hots are [n, T]; halve the tile for large pose
+    buffers to keep them inside VMEM."""
+    from ..ops.pallas_tcg import TILE
+
+    T = TILE if (n_max + s_max) <= 1024 else TILE // 2
+    return T, max(1, -(-e_max // T))
 
 
-def _pallas_vmem_ok(meta: GraphMeta) -> bool:
-    """Whether the kernel's per-agent working set fits: VMEM estimate (the
-    two [E, n] selection matrices dominate; edge components and ~12
-    [r(d+1), n] loop vectors ride along) plus the empirical Mosaic compile
-    ceiling."""
-    if meta.e_max > PALLAS_TCG_MAX_EDGES or meta.n_max > PALLAS_TCG_MAX_POSES:
-        return False
+def _pallas_vmem_ok(meta: GraphMeta, graph) -> bool:
+    """Whether the kernel's per-agent working set fits in VMEM.
+
+    With the tile-streaming kernel the resident set is ~12 [r(d+1), n]
+    loop vectors, the O(E) tiled edge payload, and the transient per-tile
+    one-hot selection tiles (4 x [n or s, T] live at the cost evaluation).
+    This is a budget check, not an edge-count gate — the old one-hot
+    design's ~765-edge Mosaic compile ceiling is gone (e_max 1906 /
+    n_max 1000 verified compiling and running on v5e); the remaining
+    ceiling tracks real VMEM pressure (e_max 3793 / n_max 2000 at T=256
+    crashes the compile helper, consistent with this estimate)."""
+    T = graph.eidx_i.shape[-1]
+    nt = graph.eidx_i.shape[1]
     rk = meta.rank * (meta.d + 1)
-    sel = 2 * meta.e_max * (meta.n_max + meta.s_max)
-    vecs = 12 * rk * meta.n_max + (2 * meta.d * meta.d + 4) * meta.e_max
-    return (sel + vecs) * 4 <= PALLAS_TCG_VMEM_BUDGET_BYTES
+    edge_tiles = nt * T * (meta.d * meta.d + meta.d + 4)
+    onehots = 4 * T * (meta.n_max + meta.s_max)
+    vecs = 12 * rk * meta.n_max
+    return (edge_tiles + onehots + vecs) * 4 <= PALLAS_TCG_VMEM_BUDGET_BYTES
 
 
 def _formulation(meta: GraphMeta, params: AgentParams | None, graph,
@@ -469,22 +476,16 @@ def _formulation(meta: GraphMeta, params: AgentParams | None, graph,
     if params is None:
         return "ell"
     rtr = params.solver.algorithm == ROptAlg.RTR
-    pallas_ok = rtr and graph.sel_i is not None and _pallas_vmem_ok(meta)
+    pallas_ok = rtr and graph.eidx_i is not None and _pallas_vmem_ok(meta, graph)
     if params.solver.pallas_tcg is True:
         if not pallas_ok:
             # An explicit force that cannot be honored must not silently
             # downgrade — the caller believes the kernel is being covered.
             if not rtr:
                 reason = "algorithm is not RTR"
-            elif graph.sel_i is None:
-                reason = ("the graph was built without selection matrices "
+            elif graph.eidx_i is None:
+                reason = ("the graph was built without edge tiles "
                           "(build_graph(pallas_sel=True))")
-            elif (meta.e_max > PALLAS_TCG_MAX_EDGES
-                  or meta.n_max > PALLAS_TCG_MAX_POSES):
-                reason = (f"the per-agent shapes (e_max={meta.e_max}, "
-                          f"n_max={meta.n_max}) exceed the empirical Mosaic "
-                          f"compile ceiling ({PALLAS_TCG_MAX_EDGES} edges / "
-                          f"{PALLAS_TCG_MAX_POSES} poses)")
             else:
                 reason = "the per-agent problem exceeds the kernel's VMEM budget"
             raise ValueError(f"pallas_tcg=True cannot run: {reason}")
@@ -512,8 +513,8 @@ def _agent_update(X_local, z, edges, params: AgentParams, chol=None, inc=None,
     ``chol`` carries precomputed preconditioner factors (recomputed here when
     omitted — the single-shot path of ``agent.PGOAgent``); ``inc``/``qbuf``
     select the ELL / dense-Q problem formulations (``_agent_local_problem``);
-    ``pallas = (sel_i, sel_j, seln_i, seln_j, rot_c, trn_c, interpret)``
-    runs the whole single-step RTR in the VMEM Pallas kernel
+    ``pallas = (eidx_i, eidx_j, rot_t, trn_t, interpret)`` (tile-major edge
+    arrays) runs the whole single-step RTR in the VMEM Pallas kernel
     (``ops.pallas_tcg.rtr_call``).
     Returns the updated block and the block gradient norm at the *starting*
     point — the greedy selection metric (``MultiRobotExample.cpp:242-256``)
@@ -534,13 +535,14 @@ def _agent_update(X_local, z, edges, params: AgentParams, chol=None, inc=None,
     if pallas is not None:
         from ..ops import pallas_tcg as ptcg
 
-        sel_i, sel_j, seln_i, seln_j, rot_c, trn_c, interpret = pallas
-        d = trn_c.shape[0]
+        eidx_i, eidx_j, rot_t, trn_t, interpret = pallas
+        nt, tile = eidx_i.shape[0], eidx_i.shape[-1]
+        d = trn_t.shape[1]
         k = d + 1
         r = X_local.shape[-2]
         w = edges.mask * edges.weight
-        wk = (w * edges.kappa).astype(jnp.float32)[None]
-        wt = (w * edges.tau).astype(jnp.float32)[None]
+        wk = ptcg.edge_tiles((w * edges.kappa).astype(jnp.float32), nt, tile)
+        wt = ptcg.edge_tiles((w * edges.tau).astype(jnp.float32), nt, tile)
         Lc = chol.transpose(1, 2, 0).reshape(k * k, n_max)
         # Gradient at the start point (ELL path) -> the kernel runs the
         # whole single-step RTR (tCG + retraction + acceptance + radius
@@ -556,7 +558,7 @@ def _agent_update(X_local, z, edges, params: AgentParams, chol=None, inc=None,
         S = 0.5 * (M + jnp.swapaxes(M, -1, -2))
         Sc = S.transpose(1, 2, 0).reshape(d * d, n_max)
         X_out_c, stats = ptcg.rtr_call(
-            sel_i, sel_j, seln_i, seln_j, rot_c, trn_c, wk, wt,
+            eidx_i, eidx_j, rot_t, trn_t, wk, wt,
             ptcg.comp_major(X_local.astype(jnp.float32)),
             ptcg.comp_major(z.astype(jnp.float32)),
             Sc.astype(jnp.float32), Lc.astype(jnp.float32),
@@ -767,12 +769,11 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
         # inc rides along for the start-point gradient (gather-only ELL);
         # the full RTR step runs in the VMEM kernel.
         X_upd, gn0 = jax.vmap(
-            lambda x, z, e, c, s, m, si, sj, sni, snj, rc, tc: _agent_update(
+            lambda x, z, e, c, s, m, ii, ij, rc, tc: _agent_update(
                 x, z, e, params, c, inc=(s, m),
-                pallas=(si, sj, sni, snj, rc, tc, interp)))(
+                pallas=(ii, ij, rc, tc, interp)))(
             start, Zuse, edges, chol, graph.inc_slot, graph.inc_mask,
-            graph.sel_i, graph.sel_j, graph.seln_i, graph.seln_j,
-            graph.rot_c, graph.trn_c)
+            graph.eidx_i, graph.eidx_j, graph.rot_t, graph.trn_t)
     elif form == "dense":  # qbuf presence enforced above
         X_upd, gn0 = jax.vmap(
             lambda x, z, e, c, q: _agent_update(x, z, e, params, c, qbuf=q))(

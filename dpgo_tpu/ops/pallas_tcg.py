@@ -8,15 +8,19 @@ dispatch latency dominates at per-agent problem sizes (~25 KB of state,
 ~50 KB of edges).  Here the solver executes inside a kernel with every
 operand in VMEM:
 
-* Pose gathers/scatters are one-hot matmuls: ``V_i = V @ Sel_i^T`` and
-  ``H = g_i @ Sel_i + g_j @ Sel_j`` ride the MXU instead of lowering to
-  serialized scatter ops.  ``sel_i/sel_j [E, n]`` select the *local*
-  endpoint of each edge (zero rows for neighbor endpoints — exactly the
-  "neighbors are constants" Hessian semantics of ``quadratic.hessvec``);
-  ``seln_i/seln_j [E, s]`` select the neighbor endpoints for cost
-  evaluation.
+* Pose gathers/scatters ride the MXU as one-hot matmuls, but the one-hot
+  selection matrices are never stored: the kernel holds only the int32
+  endpoint indices (``[nt, 1, T]`` edge tiles) and materializes each
+  ``[n, T]`` one-hot tile on the fly (``broadcasted_iota`` + compare)
+  inside a ``fori_loop`` over edge tiles.  Memory is O(E + T·n) instead of
+  the O(E·n) resident selection matrices of the first design — per-agent
+  edge counts in the thousands fit comfortably where the old kernel's
+  ceiling was ~765 edges.  An endpoint index that falls outside the
+  compared range produces an all-zero one-hot column, which encodes both
+  "neighbors are constants" (local selection skips buffer slots >= n) and
+  edge padding (index n + s matches neither range) with no masks.
 * All per-edge and per-pose arithmetic is unrolled over the static
-  ``(r, d)`` components on [E]- / [n]-shaped rows (component-major layout,
+  ``(r, d)`` components on [T]- / [n]-shaped rows (component-major layout,
   batch in lanes) — fully lane-parallel VPU work; the d x d / (d+1) x (d+1)
   math (curvature correction, tangent projection, preconditioner solves,
   Newton-Schulz retraction) is the same closed-form unrolled style as
@@ -36,9 +40,14 @@ Numerics match the XLA solver (same stopping rules, same epsilons);
 equivalence is asserted in tests/test_pallas_tcg.py, which runs the kernels
 in interpreter mode on CPU.
 
-Known limit: Mosaic's compile helper crashes (opaque HTTP 500) for
-per-agent shapes beyond ~900 edges / ~450 poses on the v5e toolchain; the
-dispatch gates on an empirical ceiling (``models.rbcd.PALLAS_TCG_MAX_*``).
+Edge-tile layout (built by ``models.rbcd.build_graph``): edges are padded
+to ``nt * T`` (tile size ``T`` a lane multiple) and stored tile-major so
+the kernel indexes tiles on the leading axis —
+
+* ``idx_i / idx_j [nt, 1, T]`` int32 endpoint indices into the
+  ``[n + s]`` pose buffer (``n + s`` for padding),
+* ``rot_t [nt, d*d, T]`` / ``trn_t [nt, d, T]`` edge transforms,
+* ``wk_t / wt_t [nt, 1, T]`` the weighted kappa/tau (zero on padding).
 """
 
 from __future__ import annotations
@@ -53,30 +62,44 @@ from jax.experimental.pallas import tpu as pltpu
 
 HI = jax.lax.Precision.HIGHEST
 
+#: Edge-tile lane width: tiles are [n, T] one-hots and [*, T] payload rows.
+TILE = 256
 
-def _build_math(sel_i, sel_j, rot, trn, wk, wt, X, S, L, *, r, d,
-                max_iters, kappa, theta):
-    """Closures over the loaded per-agent arrays (component-major layout).
 
-    ``X`` is the expansion point (fixed during a solve): tangent projection
-    and the Riemannian curvature correction are taken at ``X``; ``S =
-    sym(Y^T G_Y)`` per pose; ``L`` the preconditioner Cholesky components.
+def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
+                X, S, L, *, r, d, max_iters, kappa, theta):
+    """Closures over the per-agent VMEM refs (component-major layout).
+
+    Edge data arrives as tile-major refs (see module docstring) read
+    tile-by-tile inside ``fori_loop``; ``X`` is the expansion point (fixed
+    during a solve): tangent projection and the Riemannian curvature
+    correction are taken at ``X``; ``S = sym(Y^T G_Y)`` per pose; ``L`` the
+    preconditioner Cholesky components.
     """
     k = d + 1
     rk = r * k
+    n = X.shape[-1]
+    nt = idx_i_ref.shape[0]
+    T = idx_i_ref.shape[-1]
     f32 = jnp.float32
     eps = jnp.asarray(1e-30, f32)
 
     def q(a, c):  # component row of pose-block entry (a, c)
         return a * k + c
 
-    def dotT(V, Sel):  # [rk, n] x [E, n] -> [rk, E]   (gather)
-        return jax.lax.dot_general(V, Sel, (((1,), (1,)), ((), ())),
+    def gather(V, Sel):  # [rk, m] x [m, T] -> [rk, T]
+        return jax.lax.dot_general(V, Sel, (((1,), (0,)), ((), ())),
                                    precision=HI, preferred_element_type=f32)
 
-    def dot(G, Sel):   # [rk, E] x [E, n] -> [rk, n]   (scatter-add)
-        return jax.lax.dot_general(G, Sel, (((1,), (0,)), ((), ())),
+    def scatter(G, Sel):  # [rk, T] x [m, T] -> [rk, m]  (scatter-add)
+        return jax.lax.dot_general(G, Sel, (((1,), (1,)), ((), ())),
                                    precision=HI, preferred_element_type=f32)
+
+    def onehot(idx_row, m, base):
+        """[m, T] one-hot of (idx - base): column e selects row idx[e]-base,
+        all-zero when the shifted index falls outside [0, m)."""
+        io = jax.lax.broadcasted_iota(jnp.int32, (m, T), 0)
+        return ((idx_row - base) == io).astype(f32)
 
     def rows(mat):
         return [mat[i] for i in range(mat.shape[0])]
@@ -84,14 +107,13 @@ def _build_math(sel_i, sel_j, rot, trn, wk, wt, X, S, L, *, r, d,
     def stack(rlist):
         return jnp.stack(rlist, axis=0)
 
-    R = rows(rot)
-    t = rows(trn)
     Xr = rows(X)
     Sr = rows(S)
     Lr = rows(L)
 
-    def edge_residuals(Vi, Vj):
-        """Per-edge lifted residual components from gathered endpoints."""
+    def edge_residuals(Vi, Vj, R, t):
+        """Per-edge lifted residual components from gathered endpoints
+        (per-tile: rows are [T])."""
         rR = [[Vj[q(a, c)] - sum(Vi[q(a, b)] * R[b * d + c]
                                  for b in range(d))
                for c in range(d)] for a in range(r)]
@@ -101,23 +123,60 @@ def _build_math(sel_i, sel_j, rot, trn, wk, wt, X, S, L, *, r, d,
         return rR, rt
 
     def hess_euclidean(V):
-        """(V Q)_local on the buffer graph: per-edge residual forms of the
-        tangent vector, one-hot scatter back (``quadratic.hessvec``)."""
-        Vi = rows(dotT(V, sel_i))   # r*k rows of [E]
-        Vj = rows(dotT(V, sel_j))
-        rR, rt = edge_residuals(Vi, Vj)
-        gj = [None] * rk
-        gi = [None] * rk
-        for a in range(r):
-            for c in range(d):
-                gj[q(a, c)] = wk * rR[a][c]
-                # gi_Y[a,c] = -wk (rR R^T)[a,c] - wt rt[a] t[c]
-                gi[q(a, c)] = -wk * sum(rR[a][b] * R[c * d + b]
-                                        for b in range(d)) \
-                    - wt * rt[a] * t[c]
-            gj[q(a, d)] = wt * rt[a]
-            gi[q(a, d)] = -wt * rt[a]
-        return dot(stack(gi), sel_i) + dot(stack(gj), sel_j)
+        """(V Q)_local on the buffer graph, accumulated over edge tiles:
+        per-tile one-hot gather, residual forms, one-hot scatter back
+        (``quadratic.hessvec``)."""
+
+        def tile(ti, acc):
+            sel_i = onehot(idx_i_ref[ti], n, 0)
+            sel_j = onehot(idx_j_ref[ti], n, 0)
+            R = rows(rot_ref[ti])
+            t = rows(trn_ref[ti])
+            wk = wk_ref[ti][0]
+            wt = wt_ref[ti][0]
+            Vi = rows(gather(V, sel_i))
+            Vj = rows(gather(V, sel_j))
+            rR, rt = edge_residuals(Vi, Vj, R, t)
+            gj = [None] * rk
+            gi = [None] * rk
+            for a in range(r):
+                for c in range(d):
+                    gj[q(a, c)] = wk * rR[a][c]
+                    # gi_Y[a,c] = -wk (rR R^T)[a,c] - wt rt[a] t[c]
+                    gi[q(a, c)] = -wk * sum(rR[a][b] * R[c * d + b]
+                                            for b in range(d)) \
+                        - wt * rt[a] * t[c]
+                gj[q(a, d)] = wt * rt[a]
+                gi[q(a, d)] = -wt * rt[a]
+            return acc + scatter(stack(gi), sel_i) + scatter(stack(gj), sel_j)
+
+        return jax.lax.fori_loop(0, nt, tile, jnp.zeros((rk, n), f32))
+
+    def cost(V, Z):
+        """f over the full buffer: local candidate V plus fixed neighbors Z
+        (``quadratic.cost`` semantics), accumulated over edge tiles."""
+        s = Z.shape[-1]
+
+        def tile(ti, acc):
+            ii = idx_i_ref[ti]
+            jj = idx_j_ref[ti]
+            sel_i = onehot(ii, n, 0)
+            sel_j = onehot(jj, n, 0)
+            seln_i = onehot(ii, s, n)
+            seln_j = onehot(jj, s, n)
+            R = rows(rot_ref[ti])
+            t = rows(trn_ref[ti])
+            wk = wk_ref[ti][0]
+            wt = wt_ref[ti][0]
+            Vi = rows(gather(V, sel_i) + gather(Z, seln_i))
+            Vj = rows(gather(V, sel_j) + gather(Z, seln_j))
+            rR, rt = edge_residuals(Vi, Vj, R, t)
+            quad = wk * sum(rR[a][c] * rR[a][c]
+                            for a in range(r) for c in range(d)) \
+                + wt * sum(rt[a] * rt[a] for a in range(r))
+            return acc + 0.5 * jnp.sum(quad)
+
+        return jax.lax.fori_loop(0, nt, tile, jnp.asarray(0.0, f32))
 
     def tangent_project(W):
         """W_Y - Y sym(Y^T W_Y) per pose; translation rows unchanged."""
@@ -251,8 +310,8 @@ def _build_math(sel_i, sel_j, rot, trn, wk, wt, X, S, L, *, r, d,
 
         def sweep(_, YZ):
             Y, Z = YZ
-            T = 0.5 * (3.0 * eye - matmul3(Z, Y))
-            return matmul3(Y, T), matmul3(T, Z)
+            T_ = 0.5 * (3.0 * eye - matmul3(Z, Y))
+            return matmul3(Y, T_), matmul3(T_, Z)
 
         _, Zc = jax.lax.fori_loop(0, 24, sweep, (An, eye))
         inv_sqrt_s = jax.lax.rsqrt(s)
@@ -264,18 +323,15 @@ def _build_math(sel_i, sel_j, rot, trn, wk, wt, X, S, L, *, r, d,
             out[q(a, d)] = Xr[q(a, d)] + Vr[q(a, d)]
         return stack(out)
 
-    return SimpleNamespace(tcg=tcg, inner=inner, retract=retract,
-                           edge_residuals=edge_residuals, rows=rows,
-                           stack=stack, dotT=dotT, q=q)
+    return SimpleNamespace(tcg=tcg, inner=inner, retract=retract, cost=cost)
 
 
-def _tcg_kernel(sel_i_ref, sel_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
+def _tcg_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                 x_ref, scorr_ref, chol_ref, g_ref, radius_ref,
                 eta_ref, heta_ref, stats_ref,
                 *, r: int, d: int, max_iters: int, kappa: float,
                 theta: float):
-    m = _build_math(sel_i_ref[...], sel_j_ref[...], rot_ref[...],
-                    trn_ref[...], wk_ref[...][0], wt_ref[...][0],
+    m = _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                     x_ref[...], scorr_ref[...], chol_ref[...],
                     r=r, d=d, max_iters=max_iters, kappa=kappa, theta=theta)
     eta, Heta, kit, hit = m.tcg(g_ref[...], radius_ref[0, 0])
@@ -284,9 +340,9 @@ def _tcg_kernel(sel_i_ref, sel_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
     stats_ref[...] = jnp.stack([kit, hit.astype(jnp.float32)]).reshape(1, 2)
 
 
-def _rtr_kernel(sel_i_ref, sel_j_ref, seln_i_ref, seln_j_ref, rot_ref,
-                trn_ref, wk_ref, wt_ref, x_ref, z_ref, scorr_ref, chol_ref,
-                g_ref, x_out_ref, stats_ref,
+def _rtr_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
+                x_ref, z_ref, scorr_ref, chol_ref, g_ref,
+                x_out_ref, stats_ref,
                 *, r: int, d: int, max_iters: int, kappa: float,
                 theta: float, initial_radius: float, max_rejections: int):
     """Full single-step RTR (reference ``QuadraticOptimizer.cpp:92-110``):
@@ -297,35 +353,18 @@ def _rtr_kernel(sel_i_ref, sel_j_ref, seln_i_ref, seln_j_ref, rot_ref,
     X = x_ref[...]
     Z = z_ref[...]
     g = g_ref[...]
-    seln_i = seln_i_ref[...]
-    seln_j = seln_j_ref[...]
-    wk = wk_ref[...][0]
-    wt = wt_ref[...][0]
-    m = _build_math(sel_i_ref[...], sel_j_ref[...], rot_ref[...],
-                    trn_ref[...], wk, wt, X, scorr_ref[...], chol_ref[...],
+    m = _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
+                    X, scorr_ref[...], chol_ref[...],
                     r=r, d=d, max_iters=max_iters, kappa=kappa, theta=theta)
 
-    def cost(V):
-        """f over the full buffer: local candidate V plus fixed neighbors Z
-        (``quadratic.cost`` semantics)."""
-        Vi = m.rows(m.dotT(V, sel_i_ref[...])
-                    + m.dotT(Z, seln_i))
-        Vj = m.rows(m.dotT(V, sel_j_ref[...])
-                    + m.dotT(Z, seln_j))
-        rR, rt = m.edge_residuals(Vi, Vj)
-        quad = wk * sum(rR[a][c] * rR[a][c]
-                        for a in range(r) for c in range(d)) \
-            + wt * sum(rt[a] * rt[a] for a in range(r))
-        return 0.5 * jnp.sum(quad)
-
-    f0 = cost(X)
+    f0 = m.cost(X, Z)
     eps = jnp.asarray(1e-30, f32)
 
     def attempt_body(s):
         k_att, radius, X_best, f_best, accepted = s
         eta, Heta, _, _ = m.tcg(g, radius)
         X_prop = m.retract(eta)
-        f_prop = cost(X_prop)
+        f_prop = m.cost(X_prop, Z)
         mdec = -(m.inner(g, eta) + 0.5 * m.inner(eta, Heta))
         rho = (f0 - f_prop) / jnp.maximum(mdec, eps)
         ok = (rho > 0.1) & (f_prop <= f0)
@@ -360,14 +399,22 @@ def comp_minor(Xc: jax.Array, r: int, k: int) -> jax.Array:
     return Xc.reshape(r, k, n).transpose(2, 0, 1)
 
 
+def edge_tiles(w: jax.Array, nt: int, tile: int = TILE) -> jax.Array:
+    """Pad a per-edge row [E] to the kernel's tile-major [nt, 1, T]."""
+    E = w.shape[-1]
+    wp = jnp.pad(w, (0, nt * tile - E))
+    return wp.reshape(nt, tile)[:, None, :]
+
+
 @functools.partial(jax.jit, static_argnames=("r", "d", "max_iters", "kappa",
                                              "theta", "interpret"))
-def tcg_call(sel_i, sel_j, rot, trn, wk, wt, Xc, Sc, Lc, gc, radius,
+def tcg_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Sc, Lc, gc, radius,
              *, r: int, d: int, max_iters: int, kappa: float, theta: float,
              interpret: bool = False):
     """Invoke the tCG kernel for one agent (vmap adds the agent grid axis).
 
-    All tensor operands are component-major float32; ``radius`` is [1, 1].
+    Edge operands are tile-major (module docstring); pose operands are
+    component-major float32; ``radius`` is [1, 1].
     Returns (eta_c [rk, n], heta_c [rk, n], stats [1, 2] = (iters, hit)).
     """
     rk, n = Xc.shape
@@ -384,13 +431,13 @@ def tcg_call(sel_i, sel_j, rot, trn, wk, wt, Xc, Sc, Lc, gc, radius,
         in_specs=[vspec] * 11,
         out_specs=(vspec, vspec, vspec),
         interpret=interpret,
-    )(sel_i, sel_j, rot, trn, wk, wt, Xc, Sc, Lc, gc, radius)
+    )(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Sc, Lc, gc, radius)
 
 
 @functools.partial(jax.jit, static_argnames=(
     "r", "d", "max_iters", "kappa", "theta", "initial_radius",
     "max_rejections", "interpret"))
-def rtr_call(sel_i, sel_j, seln_i, seln_j, rot, trn, wk, wt, Xc, Zc, Sc, Lc,
+def rtr_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Sc, Lc,
              gc, *, r: int, d: int, max_iters: int, kappa: float,
              theta: float, initial_radius: float, max_rejections: int,
              interpret: bool = False):
@@ -410,7 +457,7 @@ def rtr_call(sel_i, sel_j, seln_i, seln_j, rot, trn, wk, wt, Xc, Zc, Sc, Lc,
             jax.ShapeDtypeStruct((rk, n), jnp.float32),
             jax.ShapeDtypeStruct((1, 4), jnp.float32),
         ),
-        in_specs=[vspec] * 13,
+        in_specs=[vspec] * 11,
         out_specs=(vspec, vspec),
         interpret=interpret,
-    )(sel_i, sel_j, seln_i, seln_j, rot, trn, wk, wt, Xc, Zc, Sc, Lc, gc)
+    )(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Sc, Lc, gc)

@@ -44,16 +44,17 @@ def test_kernel_matches_xla_tcg(rng, radius):
         pre = lambda V: manifold.tangent_project(x, prob.precond(x, V))
         ref = solver.truncated_cg(x, g, hvp, pre, rad, 10, 0.1, 1.0)
 
-        w = e.mask * e.weight
-        wk = (w * e.kappa)[None]
-        wt = (w * e.tau)[None]
+        nt, tile = graph.eidx_i.shape[1], graph.eidx_i.shape[-1]
+        w = (e.mask * e.weight).astype(jnp.float32)
+        wk = ptcg.edge_tiles(w * e.kappa, nt, tile)
+        wt = ptcg.edge_tiles(w * e.tau, nt, tile)
         Y, GY = x[..., :d], eg[..., :d]
         M = jnp.einsum("nab,nac->nbc", Y, GY)
         S = 0.5 * (M + jnp.swapaxes(M, -1, -2))
         Sc = S.transpose(1, 2, 0).reshape(d * d, meta.n_max)
         Lc = chol[a].transpose(1, 2, 0).reshape(k * k, meta.n_max)
         eta_c, heta_c, stats = ptcg.tcg_call(
-            graph.sel_i[a], graph.sel_j[a], graph.rot_c[a], graph.trn_c[a],
+            graph.eidx_i[a], graph.eidx_j[a], graph.rot_t[a], graph.trn_t[a],
             wk, wt, ptcg.comp_major(x), Sc, Lc, ptcg.comp_major(g),
             rad.reshape(1, 1), r=r, d=d, max_iters=10, kappa=0.1, theta=1.0,
             interpret=True)
@@ -80,20 +81,27 @@ def test_rounds_match_ell_path(rng):
     assert np.allclose(sp.X, se.X, atol=1e-5)
 
 
-def test_sel_matrices_respect_budget(rng):
+def test_edge_tiles_layout(rng):
+    """Tile-major edge indices: valid edges carry the planner's endpoint
+    (local < n_max, neighbor in [n_max, n_max + s_max)); padding carries
+    n_max + s_max, which one-hots to all-zero in both ranges."""
     graph, meta, _ = _setup(rng)
-    assert graph.sel_i is not None  # tiny problem: always built
-    # One-hot rows select exactly the local endpoint of each (real) edge.
+    assert graph.eidx_i is not None  # pallas_sel=True: always built
     a = 0
     i = np.asarray(graph.edges.i[a])
-    mask = np.asarray(graph.edges.mask[a])
-    sel_i = np.asarray(graph.sel_i[a])
-    for e_idx in range(len(i)):
-        row = sel_i[e_idx]
-        if mask[e_idx] > 0 and i[e_idx] < meta.n_max:
-            assert row.sum() == 1.0 and row[i[e_idx]] == 1.0
-        else:
-            assert row.sum() == 0.0
+    mask = np.asarray(graph.edges.mask[a]) > 0
+    flat = np.asarray(graph.eidx_i[a]).reshape(-1)  # [nt*T]
+    e_max = i.shape[0]
+    assert np.array_equal(flat[:e_max][mask], i[mask])
+    assert np.all(flat[:e_max][~mask] == meta.n_max + meta.s_max)
+    assert np.all(flat[e_max:] == meta.n_max + meta.s_max)
+    # Payload tiles carry the edge rotations at the matching positions.
+    rot = np.asarray(graph.rot_t[a])  # [nt, d*d, T]
+    nt, dd, T = rot.shape
+    rot_flat = rot.transpose(1, 0, 2).reshape(dd, nt * T)
+    R = np.asarray(graph.edges.R[a])  # [e_max, d, d]
+    ref = R.transpose(1, 2, 0).reshape(dd, e_max)
+    assert np.allclose(rot_flat[:, :e_max][:, mask], ref[:, mask], atol=1e-6)
 
 
 def test_rounds_match_ell_path_se2(rng):
@@ -117,14 +125,14 @@ def test_rounds_match_ell_path_se2(rng):
 
 
 def test_forced_pallas_without_sel_raises(rng):
-    """pallas_tcg=True on a graph without selection matrices must raise,
-    not silently downgrade to another formulation."""
+    """pallas_tcg=True on a graph without edge tiles must raise, not
+    silently downgrade to another formulation."""
     meas, _ = make_measurements(rng, n=16, d=3, num_lc=6)
     part = partition_contiguous(meas, 2)
     graph, meta = rbcd.build_graph(part, 5, jnp.float32, pallas_sel=False)
     X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float32)
     pp = AgentParams(d=3, r=5, num_robots=2,
                      solver=SolverParams(pallas_tcg=True))
-    with pytest.raises(ValueError, match="selection matrices"):
+    with pytest.raises(ValueError, match="edge tiles"):
         state = rbcd.init_state(graph, meta, X0, params=pp)
         rbcd.rbcd_step(state, graph, meta, pp)
