@@ -32,6 +32,91 @@ import pytest  # noqa: E402
 DATA_DIR = "/root/reference/data"
 
 
+# Compile-heavy tests (measured >= ~8 s each on the single-core CPU backend;
+# durations from a full-suite run) are auto-marked ``slow`` so the default
+# iteration loop is `pytest -m "not slow"` (< ~2 min); the full suite
+# (~25 min on this 1-core box) remains the pre-commit gate for solver math.
+SLOW_TESTS = {
+    "test_accelerated_solve",
+    "test_ppermute_exchange_matches_all_gather",
+    "test_sharded_matches_single_device_accel_robust",
+    "test_fused_segments_respect_gnc_and_restart_schedule",
+    "test_sharded_solve_robust_accel",
+    "test_winding_local_minimum_fails_certificate_and_staircase_escapes",
+    "test_kernel_matches_xla_tcg",
+    "test_rbcd_scale_20k_poses_32_agents",
+    "test_async_solve_kitti_se2",
+    "test_solve_staircase_end_to_end",
+    "test_solve_rbcd_distributed_init_end_to_end",
+    "test_sharded_64_agents_on_8_devices",
+    "test_rbcd_smallgrid_vs_centralized",
+    "test_rbcd_dense_matches_ell_rounds",
+    "test_gnc_accelerated",
+    "test_solve_rbcd_distributed_init_robust_odometry_start",
+    "test_accelerated_not_slower_than_plain",
+    "test_distributed_initialization_and_consensus_solve",
+    "test_gnc_rejects_outliers_and_recovers",
+    "test_sharded_matches_single_device",
+    "test_checkpoint_resume_matches_uninterrupted",
+    "test_rbcd_matches_centralized_on_noisy_graph",
+    "test_sharded_solve_smallgrid",
+    "test_rounds_match_ell_path_se2",
+    "test_rounds_match_ell_path",
+    "test_partition_by_keys",
+    "test_robust_solve_rejects_outliers",
+    "test_ppermute_solve_end_to_end",
+    "test_gnc_weights_consistent_between_shared_copies",
+    "test_rbcd_rgd_algorithm",
+    "test_accelerated_rbcd_converges",
+    "test_lifted_rank_matches_unlifted_optimum",
+    "test_log_data_dumps_on_reset_and_iter50",
+    "test_distributed_init_robust_to_outlier_shared_edges",
+    "test_rbcd_cost_monotone_jacobi",
+    "test_non_gnc_robust_costs_downweight_outliers",
+    "test_smallgrid_end_to_end",
+    "test_gnc_known_inliers_pinned",
+    "test_certificate_operator_matches_dense_eig",
+    "test_rbcd_se2",
+    "test_rgd_linesearch_converges",
+    "test_accelerated_restart_rounds_run",
+    "test_gnc_warm_start_disabled_resets",
+    "test_block_jacobi_precond_speeds_tcg",
+    "test_gnc_convergence_ratio_gates_consensus",
+    "test_optimal_solution_certifies",
+    "test_sharded_fused_rounds_match_per_round",
+    "test_rtr_monotone_and_reaches_tol",
+    "test_mesh_size_divisibility",
+    "test_fused_rounds_match_sequential",
+    "test_distributed_init_aligns_frames",
+    "test_local_initialization_per_agent_frames",
+    "test_rbcd_async_schedule_runs",
+    "test_rtr_single_step_decreases_cost",
+    "test_rbcd_converges_noiseless",
+    "test_early_publishing_uninitialized_neighbor_does_not_align",
+    "test_accelerated_greedy_schedule",
+    "test_staircase_rounding_handles_rotated_basis",
+    "test_async_solve_while_running",
+    "test_solver_uses_fused_segments",
+    "test_single_robot_iterate_converges",
+    "test_tcg_on_pgo_model_decreases",
+    "test_weight_update_cap_honored",
+    "test_dense_opt_in_without_qbuf_raises",
+    "test_chordal_init_exact_on_noiseless_graph",
+    "test_refresh_problem_rebakes_factors",
+    "test_forced_pallas_without_sel_raises",
+    "test_rgd_step_decreases_cost",
+    "test_solve_local_noiseless_exact",
+    "test_dense_q_problem_matches_edges",
+    "test_edge_tiles_layout",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.originalname in SLOW_TESTS or item.name in SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Drop compiled executables after each test module.
