@@ -34,6 +34,16 @@ def test_project_to_rotation(rng, d):
     assert np.allclose(np.linalg.det(R), 1.0, atol=1e-10)
 
 
+def test_project_to_rotation_chunked_matches_batch(rng, monkeypatch):
+    """The >_SVD_CHUNK path (pad + lax.map + slice, used by 100k-pose cold
+    init) must match the single-batch projection on a non-multiple size."""
+    monkeypatch.setattr(lie, "_SVD_CHUNK", 8)
+    M = rng.standard_normal((27, 3, 3))
+    R = np.asarray(lie.project_to_rotation(jnp.asarray(M)))
+    R_ref = np.asarray(lie._project_to_rotation_batch(jnp.asarray(M)))
+    assert np.allclose(R, R_ref, atol=1e-12)
+
+
 def test_project_to_rotation_fixes_reflection():
     # A reflection must be mapped to a proper rotation, not itself.
     M = np.diag([1.0, 1.0, -1.0])
