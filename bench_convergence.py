@@ -121,7 +121,7 @@ def _build_problem(dtype, init: str = "chordal"):
         return quadratic.cost(rbcd.gather_to_global(s.X, graph, n_total),
                               edges_g)
 
-    return rbcd, graph, meta, params, state0, cost_of
+    return rbcd, graph, meta, params, state0, cost_of, edges_g, n_total
 
 
 def polish_main():
@@ -140,7 +140,7 @@ def polish_main():
 
     # init="warm": skip _build_problem's chordal initialization — the
     # warm-start state comes from the accelerator's .npz.
-    rbcd, graph, meta, params, _none, cost_of = _build_problem(
+    rbcd, graph, meta, params, _none, cost_of, _eg, _nt = _build_problem(
         jnp.float64, init="warm")
     X0 = jnp.asarray(data["X"], jnp.float64)
     state = rbcd.init_state(graph, meta, X0, params=params)
@@ -184,7 +184,8 @@ def main():
     log(f"benchmark device: {dev.platform} ({dev.device_kind})")
     dtype = jnp.float32 if dev.platform != "cpu" else jnp.float64
 
-    rbcd, graph, meta, params, state0, cost_of = _build_problem(dtype)
+    rbcd, graph, meta, params, state0, cost_of, edges_g, n_total = \
+        _build_problem(dtype)
 
     # Warm-up: compile the fused step and the cost eval outside the clock.
     state = rbcd.rbcd_steps(state0, graph, 1, meta, params)
@@ -196,6 +197,14 @@ def main():
     ladder = [1e-3, 1e-4, 1e-5, REL_GAP]
     crossed: dict[float, tuple[float, int]] = {}
     state = state0
+    # On an f32 accelerator the re-centered refinement (below) continues the
+    # descent at the same per-round rate but without the precision floor, so
+    # hand off as soon as the remaining gap is refinement territory instead
+    # of burning rounds into the floor + stall detection.  The threshold sits
+    # ON the 1e-5 ladder rung so that crossing it is recorded (same loop
+    # iteration) before the handoff fires — a larger threshold would drop
+    # the 1e-5 ladder entry from every accelerator run.
+    handoff = 1e-5 if dtype == jnp.float32 else None
     t0 = time.perf_counter()
     rounds = 0
     best = float("inf")
@@ -210,6 +219,9 @@ def main():
                 crossed[g] = (now, rounds)
                 log(f"  gap {g:.0e} at {now:.2f}s ({rounds} rounds)")
         if f <= target:
+            break
+        if handoff is not None and f <= f_opt * (1.0 + handoff):
+            log(f"  handing off to refine at rel gap {f / f_opt - 1.0:.2e}")
             break
         # Stall detection: the f32 iterate has a precision floor above
         # 1e-6; stop once the cost stops improving instead of burning the
@@ -229,9 +241,44 @@ def main():
         f"elapsed {dt:.2f}s")
     reached = crossed.get(REL_GAP, (None, rounds))[0]
 
-    # Hybrid: when the accelerator's f32 iterate floors above the target
-    # gap, hand the trajectory to a warm-started float64 CPU polish — the
-    # end-to-end time to certified-grade 1e-6 output.
+    # TPU-only path to the target gap: re-centered refinement
+    # (``models.refine``) — the f64 reference lives on the host, the device
+    # iterates only the small f32 correction, so the f32 floor dissolves
+    # without leaving the accelerator's solve loop.
+    refine_res = None
+    if reached is None and jax.devices()[0].platform != "cpu":
+        try:
+            from dpgo_tpu.models import refine as refine_mod
+            import jax.numpy as jnp2
+            Xg64 = np.asarray(
+                rbcd.gather_to_global(state.X, graph, n_total), np.float64)
+            # Compile the fused refine rounds outside the clock (bench.py
+            # convention: steady-state timing, compile cached).
+            ref_w = refine_mod.recenter(Xg64, graph, meta, params, edges_g)
+            _ = np.asarray(refine_mod._refine_rounds_jit(
+                jnp2.zeros(ref_w.consts.R.shape, jnp2.float32),
+                ref_w.consts, graph, meta, params, 50))
+            t_r = time.perf_counter()
+            _X64, rgap, cycles, hist = refine_mod.solve_refine(
+                Xg64, graph, meta, params, edges_g, f_opt,
+                rel_gap=REL_GAP)
+            refine_s = time.perf_counter() - t_r
+            refine_res = {"refine_s": round(refine_s, 3),
+                          "cycles": cycles, "rel_gap": rgap,
+                          "reached": bool(rgap <= REL_GAP),
+                          "history": [float(h) for h in hist],
+                          "total_s": round(dt + refine_s, 3)}
+            log(f"  tpu-only refine: {refine_s:.2f}s, {cycles} cycles, "
+                f"rel gap {rgap:.2e} -> total {dt + refine_s:.2f}s")
+            if refine_res["reached"]:
+                reached = dt + refine_s
+                gap = rgap
+        except Exception as e:  # noqa: BLE001 — auxiliary step
+            log(f"  refine failed: {type(e).__name__}: {e}")
+
+    # Hybrid fallback: when the accelerator's f32 iterate floors above the
+    # target gap, hand the trajectory to a warm-started float64 CPU polish —
+    # the pre-refine recipe, kept for comparison.
     hybrid = None
     if reached is None and jax.devices()[0].platform != "cpu":
         # The polish is auxiliary — any failure in it (timeout, bad output)
@@ -274,6 +321,7 @@ def main():
         "rel_gap_reached": gap,
         "ladder": {f"{g:.0e}": {"s": round(t, 3), "rounds": r}
                    for g, (t, r) in sorted(crossed.items(), reverse=True)},
+        "refine": refine_res,
         "hybrid": hybrid,
         "certified": certified,
     }))

@@ -67,7 +67,7 @@ TILE = 256
 
 
 def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
-                X, S, L, *, r, d, max_iters, kappa, theta):
+                X, S, L, *, r, d, max_iters, kappa, theta, refine=None):
     """Closures over the per-agent VMEM refs (component-major layout).
 
     Edge data arrives as tile-major refs (see module docstring) read
@@ -75,6 +75,16 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
     during a solve): tangent projection and the Riemannian curvature
     correction are taken at ``X``; ``S = sym(Y^T G_Y)`` per pose; ``L`` the
     preconditioner Cholesky components.
+
+    ``refine = (rho_rot_ref [nt, r*d, T], rho_trn_ref [nt, r, T],
+    Rc [rk, n], D [rk, n])`` switches the kernel to the
+    re-centered terminal-refinement mode (``models.refine``): ``X`` is then
+    the evaluation point Y = R + D (projections/curvature only ever
+    multiply small vectors, so f32 Y is fine), ``D`` the small correction
+    the solve updates, ``cost`` evaluates the cross + quadratic increment
+    against the reference residuals rho (so its f32 error scales with |D|,
+    not with f), and ``retract`` maps eta to D_new via the polar correction
+    series without ever materializing R + D in the state.
     """
     k = d + 1
     rk = r * k
@@ -154,7 +164,11 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
 
     def cost(V, Z):
         """f over the full buffer: local candidate V plus fixed neighbors Z
-        (``quadratic.cost`` semantics), accumulated over edge tiles."""
+        (``quadratic.cost`` semantics), accumulated over edge tiles.
+
+        Refine mode: the per-edge terms are the recentered increment
+        ``w <rho, L> + 0.5 w |L|^2`` (= f(R + D) - f(R) exactly — the
+        ambient cost is quadratic), never the large |rho + L|^2."""
         s = Z.shape[-1]
 
         def tile(ti, acc):
@@ -174,6 +188,13 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
             quad = wk * sum(rR[a][c] * rR[a][c]
                             for a in range(r) for c in range(d)) \
                 + wt * sum(rt[a] * rt[a] for a in range(r))
+            if refine is not None:
+                rho_rot = rows(refine[0][ti])
+                rho_trn = rows(refine[1][ti])
+                cross = wk * sum(rho_rot[a * d + c] * rR[a][c]
+                                 for a in range(r) for c in range(d)) \
+                    + wt * sum(rho_trn[a] * rt[a] for a in range(r))
+                return acc + jnp.sum(cross + 0.5 * quad)
             return acc + 0.5 * jnp.sum(quad)
 
         return jax.lax.fori_loop(0, nt, tile, jnp.asarray(0.0, f32))
@@ -287,9 +308,46 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         kit, eta, Heta, *_, hit = jax.lax.while_loop(not_done, body, init)
         return eta, Heta, kit, hit
 
+    def retract_refine(V):
+        """Refine mode: D_new with X_new = polar(R + D + eta), via the
+        correction series C = (I + E)^{-1/2} - I on small quantities only
+        (mirrors ``models.refine._retract_d``)."""
+        Rc, Dstate = refine[2], refine[3]
+        Rr = rows(Rc)
+        U = Dstate + V  # D + eta, rows [rk, n]
+        Ur = rows(U)
+        MY = [[Rr[q(a, c)] + Ur[q(a, c)] for c in range(d)]
+              for a in range(r)]
+        # E = R^T U + U^T R + U^T U (Y-part, d x d over [n] lanes;
+        # R^T R = I exactly — R is the f64-projected host reference)
+        E = [[sum(Rr[q(a, b)] * Ur[q(a, c)]
+                    + Ur[q(a, b)] * Rr[q(a, c)]
+                    + Ur[q(a, b)] * Ur[q(a, c)] for a in range(r))
+              for c in range(d)] for b in range(d)]
+        E = [[0.5 * (E[b][c] + E[c][b]) for c in range(d)] for b in range(d)]
+
+        def mm(P, Q):
+            return [[sum(P[b, e] * Q[e, c] for e in range(d))
+                     for c in range(d)] for b in range(d)]
+
+        En = stack([stack(rw) for rw in E])
+        E2 = stack([stack(rw) for rw in mm(En, En)])
+        E3 = stack([stack(rw) for rw in mm(E2, En)])
+        E4 = stack([stack(rw) for rw in mm(E2, E2)])
+        C = -0.5 * En + 0.375 * E2 - 0.3125 * E3 + 0.2734375 * E4
+        out = [None] * rk
+        for a in range(r):
+            for c in range(d):
+                out[q(a, c)] = Ur[q(a, c)] + sum(
+                    MY[a][b] * C[b, c] for b in range(d))
+            out[q(a, d)] = Ur[q(a, d)]
+        return stack(out)
+
     def retract(V):
         """R_X(V): per-pose Newton-Schulz polar of (Y + V_Y), translation
         add (``manifold.retract`` / ``smallmat.polar_orthonormalize``)."""
+        if refine is not None:
+            return retract_refine(V)
         Vr = rows(V)
         M = [[Xr[q(a, c)] + Vr[q(a, c)] for c in range(d)]
              for a in range(r)]
@@ -387,6 +445,59 @@ def _rtr_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         [k_att, accepted.astype(f32), f0, f_out]).reshape(1, 4)
 
 
+def _rtr_refine_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref,
+                       wt_ref, rho_rot_ref, rho_trn_ref, rc_ref,
+                       d_ref, dz_ref, scorr_ref, chol_ref, g_ref,
+                       radius_ref, d_out_ref, stats_ref,
+                       *, r: int, d: int, max_iters: int, kappa: float,
+                       theta: float, max_rejections: int):
+    """Re-centered single-step RTR (``models.refine`` semantics): state is
+    the small correction D at host-held f64 reference R; same attempt loop
+    as ``_rtr_kernel``, but the initial radius arrives as a per-agent
+    operand — refinement steps live at the |D| scale, where a fixed large
+    radius would let the cubic model error reject every attempt before the
+    shrink schedule bites."""
+    f32 = jnp.float32
+    D = d_ref[...]
+    Dz = dz_ref[...]
+    Rc = rc_ref[...]
+    g = g_ref[...]
+    initial_radius = radius_ref[0, 0]
+    Y = Rc + D
+    m = _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
+                    Y, scorr_ref[...], chol_ref[...],
+                    r=r, d=d, max_iters=max_iters, kappa=kappa, theta=theta,
+                    refine=(rho_rot_ref, rho_trn_ref, Rc, D))
+
+    f0 = m.cost(D, Dz)
+    eps = jnp.asarray(1e-30, f32)
+
+    def attempt_body(s):
+        k_att, radius, D_best, f_best, accepted = s
+        eta, Heta, _, _ = m.tcg(g, radius)
+        D_prop = m.retract(eta)
+        f_prop = m.cost(D_prop, Dz)
+        mdec = -(m.inner(g, eta) + 0.5 * m.inner(eta, Heta))
+        rho = (f0 - f_prop) / jnp.maximum(mdec, eps)
+        ok = (rho > 0.1) & (f_prop <= f0)
+        return (k_att + 1.0, jnp.where(ok, radius, radius / 4.0),
+                jnp.where(ok, D_prop, D_best),
+                jnp.where(ok, f_prop, f_best), accepted | ok)
+
+    def attempt_cond(s):
+        k_att, _, _, _, accepted = s
+        return (k_att < max_rejections) & ~accepted
+
+    init = (jnp.asarray(0.0, f32), initial_radius,
+            D, f0, jnp.asarray(False))
+    k_att, _, D_out, f_out, accepted = jax.lax.while_loop(
+        attempt_cond, attempt_body, init)
+
+    d_out_ref[...] = D_out
+    stats_ref[...] = jnp.stack(
+        [k_att, accepted.astype(f32), f0, f_out]).reshape(1, 4)
+
+
 def comp_major(X: jax.Array) -> jax.Array:
     """[n, r, k] pose blocks -> [r*k, n] component-major."""
     n, r, k = X.shape
@@ -461,3 +572,32 @@ def rtr_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Sc, Lc,
         out_specs=(vspec, vspec),
         interpret=interpret,
     )(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Sc, Lc, gc)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "r", "d", "max_iters", "kappa", "theta", "max_rejections", "interpret"))
+def rtr_refine_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, rho_rot, rho_trn,
+                    Rc, Dc, Dzc, Sc, Lc, gc, radius, *, r: int, d: int,
+                    max_iters: int, kappa: float, theta: float,
+                    max_rejections: int, interpret: bool = False):
+    """Invoke the re-centered single-step RTR kernel for one agent.
+
+    ``radius`` is the per-agent initial trust radius, [1, 1].
+    Returns (D_out_c [rk, n], stats [1, 4] = (attempts, accepted, df0, df)).
+    """
+    rk, n = Dc.shape
+    kern = functools.partial(_rtr_refine_kernel, r=r, d=d,
+                             max_iters=max_iters, kappa=kappa, theta=theta,
+                             max_rejections=max_rejections)
+    vspec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kern,
+        out_shape=(
+            jax.ShapeDtypeStruct((rk, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, 4), jnp.float32),
+        ),
+        in_specs=[vspec] * 15,
+        out_specs=(vspec, vspec),
+        interpret=interpret,
+    )(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, rho_rot, rho_trn,
+      Rc, Dc, Dzc, Sc, Lc, gc, radius)
