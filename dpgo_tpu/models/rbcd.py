@@ -1054,6 +1054,19 @@ def run_rbcd(
             num_weight_updates += int(update_w)
             state = step(state, update_w, restart)
             it += 1
+            if update_w and num_weight_updates >= 2:
+                # Freeze the weights once the GNC inlier/outlier decision has
+                # converged (fraction of LC weights in {0,1} >= the
+                # reference's min ratio, ``computeConvergedLoopClosure-
+                # Ratio``, PGOAgent.cpp:1247-1289): further updates would
+                # keep annealing mu and flip borderline edges, destabilizing
+                # the now-fixed-weight descent.  >= 2 updates required — the
+                # all-ones initialization is trivially "converged".
+                ratio = _converged_weight_ratio(
+                    graph.edges._replace(weight=state.weights), params)
+                if ratio is not None and float(jnp.min(ratio)) >= \
+                        params.robust_opt_min_convergence_ratio:
+                    robust_on = False
         else:
             # Fuse the plain rounds up to (exclusive) the next flagged round
             # and (inclusive) the next eval boundary into one device call.

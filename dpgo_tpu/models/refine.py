@@ -176,7 +176,7 @@ def recenter(Xg64: np.ndarray, graph, meta, params: AgentParams,
                 for f in ("R", "t", "kappa", "tau", "weight", "mask")}
     edges_np["i"], edges_np["j"] = np.asarray(e.i), np.asarray(e.j)
 
-    G_ref, _, _, _ = _np_egrad(Rbuf, edges_np, meta.n_max)
+    G_ref, rrR, rrt, _ = _np_egrad(Rbuf, edges_np, meta.n_max)
     RY = R_loc[..., :d]
     GY = G_ref[..., :d]
     S0 = _np_sym(np.swapaxes(RY, -1, -2) @ GY)
@@ -198,8 +198,6 @@ def recenter(Xg64: np.ndarray, graph, meta, params: AgentParams,
         # during refinement).
         A, nt, _, T = graph.eidx_i.shape
         E = edges_np["kappa"].shape[1]
-        rrR, rrt = _np_edge_terms(Rbuf, edges_np["i"], edges_np["j"],
-                                  edges_np["R"], edges_np["t"])
         r = rrR.shape[-2]
         pad = nt * T - E
 
@@ -236,7 +234,7 @@ def recenter(Xg64: np.ndarray, graph, meta, params: AgentParams,
     return RefineRef(Xg=Xg64, f_ref=f_ref, consts=consts)
 
 
-def global_x(ref: RefineRef, D, graph, n_total: int) -> np.ndarray:
+def global_x(ref: RefineRef, D, graph) -> np.ndarray:
     """Assemble the current global f64 iterate R + D (owners' D)."""
     Dg = np.zeros_like(ref.Xg)
     gi_np = np.asarray(graph.global_index)
@@ -385,7 +383,7 @@ def _agent_refine(D, Dz, consts_a, edges, inc, params: AgentParams,
     eps = jnp.asarray(1e-30, D.dtype)
 
     def attempt_body(s):
-        k_att, radius, D_best, df_best, accepted = s
+        k_att, radius, D_best, accepted = s
         res = solver.truncated_cg(Y, g, hvp, pre, radius,
                                   sp.max_inner_iters, sp.tcg_kappa,
                                   sp.tcg_theta)
@@ -397,16 +395,15 @@ def _agent_refine(D, Dz, consts_a, edges, inc, params: AgentParams,
         rho = (df0 - df_prop) / jnp.maximum(mdec, eps)
         ok = (rho > 0.1) & (df_prop <= df0)
         return (k_att + 1, jnp.where(ok, radius, radius / 4.0),
-                jnp.where(ok, D_prop, D_best),
-                jnp.where(ok, df_prop, df_best), accepted | ok)
+                jnp.where(ok, D_prop, D_best), accepted | ok)
 
     def attempt_cond(s):
-        k_att, _, _, _, accepted = s
+        k_att, _, _, accepted = s
         return (k_att < sp.max_rejections) & ~accepted
 
-    init = (jnp.asarray(0, jnp.int32), radius0.astype(D.dtype), D, df0,
+    init = (jnp.asarray(0, jnp.int32), radius0.astype(D.dtype), D,
             jnp.asarray(False))
-    _, _, D_out, _, _ = jax.lax.while_loop(attempt_cond, attempt_body, init)
+    _, _, D_out, _ = jax.lax.while_loop(attempt_cond, attempt_body, init)
     below = gn0 < sp.grad_norm_tol
     return jnp.where(below, D, D_out), gn0
 
@@ -466,7 +463,7 @@ def solve_refine(Xg64: np.ndarray, graph, meta, params: AgentParams,
         D = jnp.zeros(ref.consts.R.shape, jnp.float32)
         D = _refine_rounds_jit(D, ref.consts, graph, meta, params,
                                rounds_per_cycle)
-        Xg64 = global_x(ref, np.asarray(D), graph, Xg64.shape[0])
+        Xg64 = global_x(ref, np.asarray(D), graph)
     # Exhaustion path: report the gap at the PROJECTED (feasible) point —
     # the raw R + D sits off-manifold by the f32/series error, and an
     # infeasible point's cost can undercut every feasible one's.
