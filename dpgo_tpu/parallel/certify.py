@@ -1,0 +1,224 @@
+"""Distributed solution certification over the agent mesh.
+
+``models.certify`` evaluates the dual certificate on the assembled global
+solution (centralized).  This module is the decentralized counterpart — the
+certification half of "Distributed Certifiably Correct Pose-Graph
+Optimization" (T-RO 2021) that the reference never implemented (no
+certificate code exists in ``/root/reference/src``): the minimum eigenvalue
+of the dual-certificate operator ``S = Q - Lambda`` is computed by
+distributed subspace (simultaneous orthogonal) iteration over the same
+``"agent"`` mesh axis the RBCD solver runs on, with no agent ever holding
+the global problem:
+
+* ``S``'s matvec shards exactly like the RBCD gradient: each agent applies
+  its local edge list to its own pose rows after a public-pose exchange of
+  the probe block (same ``all_gather`` + neighbor-buffer machinery as the
+  solver round; shared edges appear in both endpoint agents' lists with the
+  remote endpoint in a neighbor slot, so local rows accumulate exactly the
+  global ``Q V`` rows with no double counting).
+* The dual blocks ``Lambda_i = sym(Y_i^T (XQ)_i)`` are per-pose quantities
+  each agent computes from its own complete gradient rows.
+* Every global scalar the eigensolver needs (norms, p x p Gram and
+  Rayleigh-Ritz matrices) is a ``psum`` over the mesh axis of local masked
+  contractions; the tiny p x p factorizations run replicated on every
+  shard, so all shards stay in lockstep deterministically.
+
+The result matches ``models.certify.certify_solution``'s LOBPCG value on
+the assembled problem (asserted in tests/test_dist_certify.py on the
+virtual 8-device CPU mesh).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import manifold, quadratic
+from ..models import rbcd
+from ..models.rbcd import MultiAgentGraph
+from .sharded import AXIS, _specs, make_mesh  # noqa: F401  (re-export mesh)
+
+
+def _egrad_local(V, Vz, graph: MultiAgentGraph):
+    """Complete local gradient rows of the global map ``V Q`` for every
+    agent: per-agent edge list applied to the [local | neighbor] buffer
+    (``quadratic.egrad`` is linear, so it doubles as the ``Q`` matvec on
+    probe blocks — the trailing axes just ride along)."""
+    n = V.shape[1]
+
+    def one(vl, vz, e):
+        return quadratic.egrad(jnp.concatenate([vl, vz]), e, n_out=n)
+
+    return jax.vmap(one)(V, Vz, graph.edges)
+
+
+def _certificate_shard(X, graph: MultiAgentGraph, key, *, axis_name,
+                       num_probe: int, power_iters: int, sub_iters: int):
+    """shard_map body: distributed lambda_min(S) at the iterate X.
+
+    X: [A_loc, n, r, dh] local agents' poses.  Returns per-shard-identical
+    (lambda_min, sigma, stat, direction [A_loc, n, dh]).
+    """
+    A_loc, n, r, dh = X.shape
+    d = dh - 1
+    dtype = X.dtype
+    mask = graph.pose_mask[..., None, None]  # [A, n, 1, 1]
+
+    gather = lambda t: jax.lax.all_gather(t, axis_name, axis=0, tiled=True)
+    psum = lambda v: jax.lax.psum(v, axis_name)
+    exchange = lambda Vl: rbcd.neighbor_buffer(
+        gather(rbcd.public_table(Vl, graph)), graph)
+
+    # Dual blocks from each agent's complete local gradient rows.
+    Z = exchange(X)
+    G = _egrad_local(X, Z, graph)
+    lam = manifold.sym(
+        jnp.einsum("xnra,xnrb->xnab", X[..., :d], G[..., :d]))
+
+    def S(V):  # [A, n, p, dh] -> [A, n, p, dh]
+        Vz = exchange(V)
+        QV = _egrad_local(V, Vz, graph)
+        LV_rot = jnp.einsum("xnpa,xnab->xnpb", V[..., :-1], lam)
+        LV = jnp.concatenate([LV_rot, jnp.zeros_like(V[..., -1:])], axis=-1)
+        return (QV - LV) * mask
+
+    def inner_block(U, W):  # local contribution to the [p, q] Gram
+        return jnp.einsum("anpd,anqd->pq", U * mask, W)
+
+    # Per-shard deterministic randomness: fold the mesh position in.
+    key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+
+    # --- spectral shift: power iteration on S for the dominant |lambda| ---
+    v = jax.random.normal(key, (A_loc, n, 1, dh), dtype) * mask
+
+    def power_body(_, v):
+        w = S(v)
+        nrm = jnp.sqrt(psum(jnp.sum(w * w)))
+        return w / jnp.maximum(nrm, 1e-30)
+
+    v = power_body(0, v)  # normalize the random start
+    v = jax.lax.fori_loop(0, power_iters, power_body, v)
+    lam_dom = psum(jnp.sum(v * S(v)))
+    sigma = 1.1 * jnp.abs(lam_dom) + 1e-3
+
+    # --- subspace iteration on sigma I - S (largest = sigma - lambda_min) --
+    def Aop(V):  # sigma I - S, PSD with top eigenvalue sigma - lambda_min(S)
+        return (sigma * V - S(V)) * mask
+
+    def ortho_block(V, p):
+        gram = psum(inner_block(V, V))
+        # dtype-scaled jitter: at LOBPCG convergence the [V, R, P] Gram is
+        # numerically singular, and in f32 an absolute 1e-12 ridge is below
+        # the Gram's own rounding noise — cholesky would go NaN silently.
+        ridge = 10 * jnp.finfo(dtype).eps * jnp.trace(gram) + 1e-30
+        C = jnp.linalg.cholesky(gram + ridge * jnp.eye(p, dtype=dtype))
+        Vm = V.transpose(0, 1, 3, 2).reshape(-1, p)
+        sol = jax.scipy.linalg.solve_triangular(C, Vm.T, lower=True).T
+        return sol.reshape(A_loc, n, dh, p).transpose(0, 1, 3, 2)
+
+    def rotate(V, C):  # apply a [p_in, p_out] coefficient matrix
+        return jnp.einsum("xnpd,pq->xnqd", V, C)
+
+    # Distributed block LOBPCG (no preconditioner): basis [V, R, P] per
+    # iteration, every reduction a psum'd Gram, the 3p x 3p Rayleigh-Ritz
+    # replicated on all shards.  Plain subspace iteration stalls on the
+    # clustered bottom spectrum of S (gauge near-zeros); the conjugate
+    # block makes the sphere2500 certificate match the centralized LOBPCG
+    # in a few hundred matvecs.
+    key2 = jax.random.fold_in(key, 1)
+    p = num_probe
+    V = ortho_block(
+        jax.random.normal(key2, (A_loc, n, p, dh), dtype) * mask, p)
+    P = ortho_block(
+        jax.random.normal(jax.random.fold_in(key, 2),
+                          (A_loc, n, p, dh), dtype) * mask, p)
+
+    def lobpcg_body(_, VP):
+        V, P = VP
+        W = Aop(V)
+        Hv = psum(inner_block(V, W))
+        R = W - rotate(V, Hv)            # block residual
+        Zb = jnp.concatenate([V, R, P], axis=2)
+        Zb = ortho_block(Zb, 3 * p)
+        Hz = psum(inner_block(Zb, Aop(Zb)))
+        Hz = 0.5 * (Hz + Hz.T)
+        _, C = jnp.linalg.eigh(Hz)       # ascending
+        Ctop = C[:, -p:]
+        V_new = ortho_block(rotate(Zb, Ctop), p)
+        # Conjugate block: the R/P components of the new Ritz vectors.
+        Ctail = Ctop.at[:p].set(0.0)
+        P_new = ortho_block(rotate(Zb, Ctail), p)
+        return V_new, P_new
+
+    V, P = jax.lax.fori_loop(0, sub_iters, lobpcg_body, (V, P))
+
+    # Final Rayleigh-Ritz on the converged block.
+    H = psum(inner_block(V, Aop(V)))
+    H = 0.5 * (H + H.T)
+    theta, Q = jnp.linalg.eigh(H)          # ascending
+    lam_min = sigma - theta[-1]
+    direction = jnp.einsum("xnpd,p->xnd", V, Q[:, -1])
+
+    # Stationarity residual ||X S|| (X's r rows ride as probe rows).
+    XS = S(X)
+    stat = jnp.sqrt(psum(jnp.sum(XS * XS)))
+    return lam_min, sigma, stat, direction
+
+
+def make_sharded_certificate(mesh, num_probe: int = 4,
+                             power_iters: int = 50, sub_iters: int = 100):
+    """Compile the distributed certificate: one shard_map program computing
+    lambda_min(S) (plus shift, stationarity residual and the minimal
+    eigendirection) for an agent-sharded iterate."""
+
+    @partial(jax.jit, static_argnames=())
+    def cert(X, graph: MultiAgentGraph, key):
+        body = partial(_certificate_shard, axis_name=AXIS,
+                       num_probe=num_probe, power_iters=power_iters,
+                       sub_iters=sub_iters)
+        in_specs = (_specs(mesh, X), _specs(mesh, graph),
+                    jax.sharding.PartitionSpec())
+        from jax.sharding import PartitionSpec as P
+        out_specs = (P(), P(), P(), P(AXIS))
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs,
+                             check_vma=False)(X, graph, key)
+
+    return cert
+
+
+def certify_sharded(X, graph: MultiAgentGraph, mesh=None,
+                    eta: float = 1e-5, seed: int = 0, num_probe: int = 4,
+                    power_iters: int = 50, sub_iters: int = 100):
+    """Distributed dual certificate of an agent-partitioned iterate.
+
+    ``X [A, n_max, r, d+1]`` and ``graph`` may be host or mesh-placed; they
+    are sharded over ``mesh`` (default: all devices).  Returns a
+    ``models.certify.CertificateResult`` whose ``direction`` is the
+    per-agent [A, n_max, d+1] eigendirection.
+    """
+    from jax.sharding import NamedSharding
+    from ..models.certify import CertificateResult
+
+    mesh = mesh or make_mesh()
+    put = lambda t: jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        t, _specs(mesh, t))
+    X = put(X)
+    graph = put(graph)
+    cert = make_sharded_certificate(mesh, num_probe=num_probe,
+                                    power_iters=power_iters,
+                                    sub_iters=sub_iters)
+    lam_min, sigma, stat, direction = cert(X, graph,
+                                           jax.random.PRNGKey(seed))
+    lam_min_f = float(lam_min)
+    tol = eta * max(1.0, float(sigma))
+    return CertificateResult(
+        certified=lam_min_f >= -tol,
+        lambda_min=lam_min_f,
+        direction=direction,
+        stationarity_gap=float(stat),
+        sigma=float(sigma),
+    )

@@ -108,6 +108,11 @@ SLOW_TESTS = {
     "test_solve_local_noiseless_exact",
     "test_dense_q_problem_matches_edges",
     "test_edge_tiles_layout",
+    "test_sharded_certificate_matches_centralized",
+    "test_sharded_certificate_sphere2500",
+    "test_solve_refine_beats_f32_floor",
+    "test_kernel_refine_matches_xla_refine",
+    "test_recentered_gradient_error_scales_with_d",
 }
 
 

@@ -1,0 +1,91 @@
+"""Distributed certification (parallel.certify) vs the centralized
+dual-certificate eigensolve (models.certify) on the virtual 8-device mesh.
+
+The T-RO 2021 capability the reference never implemented: lambda_min of
+S = Q - Lambda computed with every agent holding only its own edges, via
+psum'd Gram matrices and a distributed block LOBPCG.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpgo_tpu.config import AgentParams
+from dpgo_tpu.models import certify, rbcd
+from dpgo_tpu.parallel import certify as dcert
+from dpgo_tpu.parallel.sharded import make_mesh
+from dpgo_tpu.types import edge_set_from_measurements
+from dpgo_tpu.utils.g2o import read_g2o
+from dpgo_tpu.utils.partition import partition_contiguous
+from synthetic import make_measurements
+
+
+def _setup(meas, A, r, rounds):
+    params = AgentParams(d=meas.d, r=r, num_robots=A)
+    part = partition_contiguous(meas, A)
+    graph, meta = rbcd.build_graph(part, r, jnp.float64)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float64)
+    state = rbcd.init_state(graph, meta, X0, params=params)
+    if rounds:
+        state = rbcd.rbcd_steps(state, graph, rounds, meta, params)
+    Xg = rbcd.gather_to_global(state.X, graph, meas.num_poses)
+    edges_g = edge_set_from_measurements(part.meas_global, dtype=jnp.float64)
+    return state, graph, meta, part, Xg, edges_g
+
+
+def test_sharded_certificate_matches_centralized(rng):
+    """Certified case: a well-converged iterate of a clean synthetic graph —
+    lambda_min ~ 0 on both paths, matching to eigensolver tolerance."""
+    meas, _ = make_measurements(rng, n=48, d=3, num_lc=24,
+                                rot_noise=0.01, trans_noise=0.01)
+    state, graph, meta, part, Xg, edges_g = _setup(meas, 8, 5, rounds=150)
+    c = certify.certify_solution(Xg, edges_g)
+    cd = dcert.certify_sharded(state.X, graph, mesh=make_mesh(8))
+    assert abs(cd.sigma - c.sigma) < 0.2 * max(1.0, c.sigma)
+    assert abs(cd.stationarity_gap - c.stationarity_gap) \
+        < 1e-6 * max(1.0, c.sigma)
+    assert abs(cd.lambda_min - c.lambda_min) < 1e-3 * max(1.0, c.sigma)
+    assert cd.certified == c.certified
+
+
+def test_sharded_certificate_detects_suboptimality():
+    """Uncertified case: the classic winding-cycle local minimum (rank-2
+    critical point of an identity cycle, test_certify.py) partitioned over
+    8 agents — both paths must report the same clearly negative lambda_min.
+    """
+    from test_certify import _winding_cycle
+
+    meas, Xw = _winding_cycle(n=16)
+    part = partition_contiguous(meas, 8)
+    graph, meta = rbcd.build_graph(part, 2, jnp.float64)
+    Xa = rbcd.scatter_to_agents(jnp.asarray(Xw, jnp.float64), graph)
+    Xg = rbcd.gather_to_global(Xa, graph, meas.num_poses)
+    edges_g = edge_set_from_measurements(part.meas_global, dtype=jnp.float64)
+    c = certify.certify_solution(Xg, edges_g)
+    assert not c.certified and c.lambda_min < -1e-3
+    cd = dcert.certify_sharded(Xa, graph, mesh=make_mesh(8))
+    assert not cd.certified
+    assert abs(cd.lambda_min - c.lambda_min) < 1e-2 * abs(c.lambda_min)
+
+
+def test_sharded_certificate_sphere2500(rng, data_dir):
+    """BASELINE config #5 capability on the real dataset: the sharded
+    lambda_min matches the centralized LOBPCG value on sphere2500 over the
+    8-device CPU mesh (VERDICT round-1 item 6)."""
+    meas = read_g2o(f"{data_dir}/sphere2500.g2o")
+    state, graph, meta, part, Xg, edges_g = _setup(meas, 8, 5, rounds=150)
+    c = certify.certify_solution(Xg, edges_g)
+    cd = dcert.certify_sharded(state.X, graph, mesh=make_mesh(8))
+    assert abs(cd.lambda_min - c.lambda_min) < 1e-3 * max(1.0, c.sigma)
+    assert cd.certified == c.certified
+    # the eigendirection is a genuine unit near-null direction of S:
+    # its Rayleigh quotient matches lambda_min.
+    v = cd.direction  # [A, n, dh]
+    Vp = v[:, :, None, :]
+    # evaluate <v, S v> / <v, v> centrally via the certificate operator
+    vg = rbcd.gather_to_global(Vp[:, :, 0, :], graph, meas.num_poses)
+    lam = certify.dual_blocks(Xg, edges_g)
+    Sv = certify.certificate_matvec(vg[:, None, :], edges_g, lam)
+    rq = float(jnp.sum(vg[:, None, :] * Sv) / jnp.sum(vg * vg))
+    assert abs(rq - cd.lambda_min) < 1e-3 * max(1.0, c.sigma)
