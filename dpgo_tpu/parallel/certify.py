@@ -189,6 +189,9 @@ def make_sharded_certificate(mesh, num_probe: int = 4,
     return cert
 
 
+_CERT_CACHE: dict = {}
+
+
 def certify_sharded(X, graph: MultiAgentGraph, mesh=None,
                     eta: float = 1e-5, seed: int = 0, num_probe: int = 4,
                     power_iters: int = 50, sub_iters: int = 100):
@@ -208,9 +211,15 @@ def certify_sharded(X, graph: MultiAgentGraph, mesh=None,
         t, _specs(mesh, t))
     X = put(X)
     graph = put(graph)
-    cert = make_sharded_certificate(mesh, num_probe=num_probe,
-                                    power_iters=power_iters,
-                                    sub_iters=sub_iters)
+    # Cache the compiled certificate per configuration: jax.jit caches by
+    # function identity, so a fresh closure per call would recompile the
+    # shard_map LOBPCG every invocation (staircase re-certifies repeatedly).
+    cfg = (mesh, num_probe, power_iters, sub_iters)
+    cert = _CERT_CACHE.get(cfg)
+    if cert is None:
+        cert = _CERT_CACHE[cfg] = make_sharded_certificate(
+            mesh, num_probe=num_probe, power_iters=power_iters,
+            sub_iters=sub_iters)
     lam_min, sigma, stat, direction = cert(X, graph,
                                            jax.random.PRNGKey(seed))
     lam_min_f = float(lam_min)

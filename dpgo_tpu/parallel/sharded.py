@@ -160,7 +160,8 @@ def make_sharded_multi_step(mesh: Mesh, meta: GraphMeta, params: AgentParams,
 
 def comm_bytes_per_round(meta: GraphMeta, mesh_size: int,
                          shifts: tuple | None = None,
-                         accel: bool = False, itemsize: int = 4) -> int:
+                         accel: bool = False, itemsize: int = 4,
+                         greedy: bool = False) -> int:
     """Modeled per-device ICI/DCN bytes for one round's pose exchange —
     the mesh analog of the reference driver's hand-counted communication
     bytes (``MultiRobotExample.cpp:60,143,195,209,274-279``; the in-process
@@ -169,8 +170,11 @@ def comm_bytes_per_round(meta: GraphMeta, mesh_size: int,
     all_gather (``shifts=None``) moves each device's public table to every
     other device: ``mesh_size - 1`` table hops on a ring.  The ppermute
     route moves it once per planned shift (``len(shifts)`` hops).  Nesterov
-    acceleration doubles the volume (aux poses Y exchanged too); the greedy
-    schedule's [A]-float gradient-norm all_gather is included.
+    acceleration doubles the volume (aux poses Y exchanged too);
+    ``greedy`` adds the greedy schedule's [A]-float gradient-norm
+    all_gather (absent from the compiled Jacobi/async rounds —
+    tests/test_sharded.py validates the model against the collectives XLA
+    actually emits).
     """
     if meta.num_robots % mesh_size != 0:
         raise ValueError(
@@ -180,7 +184,7 @@ def comm_bytes_per_round(meta: GraphMeta, mesh_size: int,
     table = A_loc * meta.p_max * meta.rank * (meta.d + 1) * itemsize
     hops = (mesh_size - 1) if shifts is None else len(shifts)
     exchanges = 2 if accel else 1
-    greedy_gather = (mesh_size - 1) * A_loc * itemsize
+    greedy_gather = (mesh_size - 1) * A_loc * itemsize if greedy else 0
     return exchanges * hops * table + greedy_gather
 
 

@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""Two-process TCP deployment demo: the message vocabulary over a real wire.
+
+The reference defers multi-process transport to the external ``dpgo_ros``
+wrapper (``/root/reference/README.md:40-42``); the in-repo demos (ours and
+the reference's) drive agents in one process.  This example goes one step
+further than the reference's in-repo story: each robot is its own OS
+process holding one ``PGOAgent``, and the deployment message set —
+``get_shared_pose_dict`` / ``update_neighbor_poses``, status gossip,
+lifting-matrix and global-anchor broadcast — travels over a localhost TCP
+socket as length-prefixed ``npz`` frames.  This proves the agent API's
+payloads actually serialize: nothing in the vocabulary needs shared
+memory.
+
+Usage (launcher spawns both robot processes and assembles the result):
+    python examples/tcp_deployment_example.py DATASET.g2o \
+        [--rank 5] [--rounds 120] [--port 0] [--out-dir DIR]
+
+Internal per-robot entry (what the launcher spawns):
+    ... --robot {0,1} --port P
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import socket
+import struct
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import setup_jax  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# Wire format: length-prefixed npz frames (arrays only — no pickle)
+# ---------------------------------------------------------------------------
+
+def send_frame(sock: socket.socket, arrays: dict) -> int:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    data = buf.getvalue()
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+    return 8 + len(data)
+
+
+def recv_frame(sock: socket.socket) -> dict:
+    def recv_exact(k):
+        chunks = []
+        while k:
+            c = sock.recv(k)
+            if not c:
+                raise ConnectionError("peer closed")
+            chunks.append(c)
+            k -= len(c)
+        return b"".join(chunks)
+
+    (length,) = struct.unpack("<Q", recv_exact(8))
+    return dict(np.load(io.BytesIO(recv_exact(length))))
+
+
+def pack_pose_dict(prefix: str, pose_dict: dict) -> dict:
+    return {f"{prefix}_{r}_{p}": np.asarray(block)
+            for (r, p), block in pose_dict.items()}
+
+
+def unpack_pose_dict(frame: dict, prefix: str) -> dict:
+    out = {}
+    for key, arr in frame.items():
+        if key.startswith(prefix + "_"):
+            _, r, p = key.rsplit("_", 2)
+            out[(int(r), int(p))] = arr
+    return out
+
+
+# ---------------------------------------------------------------------------
+# One robot process
+# ---------------------------------------------------------------------------
+
+def run_robot(robot_id: int, dataset: str, rank: int, rounds: int,
+              port: int, out_dir: str) -> None:
+    setup_jax()
+    from dpgo_tpu.agent import AgentState, PGOAgent, PGOAgentStatus
+    from dpgo_tpu.config import AgentParams
+    from dpgo_tpu.utils.g2o import read_g2o
+    from dpgo_tpu.utils.partition import agent_measurements, \
+        partition_contiguous
+
+    meas = read_g2o(dataset)
+    params = AgentParams(d=meas.d, r=rank, num_robots=2)
+    part = partition_contiguous(meas, 2)
+    agent = PGOAgent(robot_id, params)
+
+    # Robot 0 listens, robot 1 dials (with retries while 0 boots).
+    if robot_id == 0:
+        srv = socket.create_server(("127.0.0.1", port))
+        conn, _ = srv.accept()
+    else:
+        for attempt in range(100):
+            try:
+                conn = socket.create_connection(("127.0.0.1", port))
+                break
+            except ConnectionRefusedError:
+                time.sleep(0.1)
+        else:
+            raise ConnectionError(f"robot 1 could not reach port {port}")
+    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    # Lifting-matrix broadcast (robot 0 self-generates; reference
+    # MultiRobotExample.cpp:139-146).
+    if robot_id == 0:
+        send_frame(conn, {"ylift": agent.get_lifting_matrix()})
+    else:
+        agent.set_lifting_matrix(recv_frame(conn)["ylift"])
+    agent.set_pose_graph(*agent_measurements(part, robot_id))
+
+    peer = 1 - robot_id
+    bytes_sent = 0
+    for it in range(rounds):
+        st = agent.get_status()
+        frame = {"status": np.asarray(
+            [st.robot_id, st.state.value, st.instance_number,
+             st.iteration_number, int(st.ready_to_terminate)], np.int64),
+            "relchange": np.asarray(st.relative_change, np.float64)}
+        frame.update(pack_pose_dict("pose", agent.get_shared_pose_dict()))
+        if robot_id == 0:
+            anchor = agent.get_global_anchor()
+            if anchor is not None:
+                frame["anchor"] = np.asarray(anchor)
+        # Asymmetric order (0 sends first, 1 receives first): a symmetric
+        # send-then-recv deadlocks once a pose frame outgrows the loopback
+        # socket buffers (both peers blocked in sendall).
+        if robot_id == 0:
+            bytes_sent += send_frame(conn, frame)
+            peer_frame = recv_frame(conn)
+        else:
+            peer_frame = recv_frame(conn)
+            bytes_sent += send_frame(conn, frame)
+        ps = peer_frame["status"]
+        agent.set_neighbor_status(PGOAgentStatus(
+            robot_id=int(ps[0]), state=AgentState(int(ps[1])),
+            instance_number=int(ps[2]), iteration_number=int(ps[3]),
+            ready_to_terminate=bool(ps[4]),
+            relative_change=float(peer_frame["relchange"])))
+        agent.update_neighbor_poses(peer, unpack_pose_dict(peer_frame,
+                                                           "pose"))
+        if robot_id == 1 and "anchor" in peer_frame:
+            agent.set_global_anchor(peer_frame["anchor"])
+
+        agent.iterate(do_optimization=True)
+
+    # Final anchor sync so both trajectories live in the same frame.
+    if robot_id == 0:
+        send_frame(conn, {"anchor": np.asarray(agent.get_global_anchor())})
+    else:
+        agent.set_global_anchor(recv_frame(conn)["anchor"])
+    conn.close()
+
+    st = agent.get_status()
+    np.savez(os.path.join(out_dir, f"robot{robot_id}.npz"),
+             T=agent.trajectory_in_global_frame(),
+             state=np.asarray(st.state.value),
+             iterations=np.asarray(st.iteration_number),
+             bytes_sent=np.asarray(bytes_sent))
+
+
+# ---------------------------------------------------------------------------
+# Launcher: spawn both robots, wait, assemble, report
+# ---------------------------------------------------------------------------
+
+def launch(args) -> int:
+    import subprocess
+
+    out_dir = args.out_dir or tempfile.mkdtemp(prefix="dpgo_tcp_")
+    os.makedirs(out_dir, exist_ok=True)
+    port = args.port
+    if port == 0:  # pick a free port up front so both children agree
+        with socket.create_server(("127.0.0.1", 0)) as s:
+            port = s.getsockname()[1]
+
+    # Robot processes always run on CPU unless told otherwise: two python
+    # processes cannot share the single tunneled-TPU grant (they would
+    # deadlock at backend init), and the per-agent problems are tiny.
+    child_env = dict(os.environ,
+                     DPGO_PLATFORM=os.environ.get("DPGO_PLATFORM", "cpu"))
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), args.dataset,
+         "--robot", str(rid), "--port", str(port), "--rank", str(args.rank),
+         "--rounds", str(args.rounds), "--out-dir", out_dir],
+        env=child_env) for rid in (0, 1)]
+    rcs = [p.wait(timeout=600) for p in procs]
+    if any(rcs):
+        print(f"robot processes failed: {rcs}", file=sys.stderr)
+        return 1
+
+    # Assemble the global trajectory and evaluate the SE(d) cost.
+    setup_jax()
+    from dpgo_tpu.ops import quadratic
+    from dpgo_tpu.types import edge_set_from_measurements
+    from dpgo_tpu.utils.g2o import read_g2o
+    from dpgo_tpu.utils.partition import partition_contiguous
+    import jax.numpy as jnp
+
+    meas = read_g2o(args.dataset)
+    part = partition_contiguous(meas, 2)
+    outs = [np.load(os.path.join(out_dir, f"robot{r}.npz")) for r in (0, 1)]
+    d = meas.d
+    T = np.zeros((meas.num_poses, d, d + 1))
+    for r, o in enumerate(outs):
+        ids = part.global_index[r][part.global_index[r] >= 0]
+        T[ids] = o["T"]
+    edges_g = edge_set_from_measurements(part.meas_global)
+    X = jnp.asarray(T)
+    cost = float(quadratic.cost(X, edges_g))
+    result = {
+        "cost": cost,
+        "states": [int(o["state"]) for o in outs],
+        "iterations": [int(o["iterations"]) for o in outs],
+        "bytes_sent": [int(o["bytes_sent"]) for o in outs],
+        "out_dir": out_dir,
+    }
+    print(json.dumps(result))
+    return 0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dataset")
+    ap.add_argument("--rank", type=int, default=5)
+    ap.add_argument("--rounds", type=int, default=120)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--out-dir", default=None)
+    ap.add_argument("--robot", type=int, default=None,
+                    help="internal: run as this robot instead of launching")
+    args = ap.parse_args()
+    if args.robot is None:
+        sys.exit(launch(args))
+    run_robot(args.robot, args.dataset, args.rank, args.rounds, args.port,
+              args.out_dir)
+
+
+if __name__ == "__main__":
+    main()

@@ -113,6 +113,8 @@ SLOW_TESTS = {
     "test_solve_refine_beats_f32_floor",
     "test_kernel_refine_matches_xla_refine",
     "test_recentered_gradient_error_scales_with_d",
+    "test_two_process_tcp_solve_converges",
+    "test_comm_model_matches_compiled_collectives",
 }
 
 
