@@ -67,7 +67,8 @@ TILE = 256
 
 
 def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
-                X, S, L, *, r, d, max_iters, kappa, theta, refine=None):
+                X, S, L, *, r, d, max_iters, kappa, theta, refine=None,
+                hoist_scratch=None):
     """Closures over the per-agent VMEM refs (component-major layout).
 
     Edge data arrives as tile-major refs (see module docstring) read
@@ -117,6 +118,24 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
     def stack(rlist):
         return jnp.stack(rlist, axis=0)
 
+    if hoist_scratch is not None:
+        # Small-shape fast path: materialize the local one-hot tiles once
+        # per kernel invocation into VMEM scratch ([nt, n, T] refs, which
+        # support the tile loop's dynamic index) instead of rebuilding them
+        # in every tCG iteration — the compare/convert VPU work is ~10% of
+        # a small-problem round.
+        si_scr, sj_scr = hoist_scratch
+        for t in range(nt):  # static-index stores, once per invocation
+            si_scr[t] = onehot(idx_i_ref[t], n, 0)
+            sj_scr[t] = onehot(idx_j_ref[t], n, 0)
+        local_sel = lambda ti: (si_scr[ti], sj_scr[ti])
+    else:
+        local_sel = lambda ti: (onehot(idx_i_ref[ti], n, 0),
+                                onehot(idx_j_ref[ti], n, 0))
+
+    def tile_loop(tile_fn, init):
+        return jax.lax.fori_loop(0, nt, tile_fn, init)
+
     Xr = rows(X)
     Sr = rows(S)
     Lr = rows(L)
@@ -138,8 +157,7 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         (``quadratic.hessvec``)."""
 
         def tile(ti, acc):
-            sel_i = onehot(idx_i_ref[ti], n, 0)
-            sel_j = onehot(idx_j_ref[ti], n, 0)
+            sel_i, sel_j = local_sel(ti)
             R = rows(rot_ref[ti])
             t = rows(trn_ref[ti])
             wk = wk_ref[ti][0]
@@ -160,7 +178,7 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                 gi[q(a, d)] = -wt * rt[a]
             return acc + scatter(stack(gi), sel_i) + scatter(stack(gj), sel_j)
 
-        return jax.lax.fori_loop(0, nt, tile, jnp.zeros((rk, n), f32))
+        return tile_loop(tile, jnp.zeros((rk, n), f32))
 
     def cost(V, Z):
         """f over the full buffer: local candidate V plus fixed neighbors Z
@@ -174,8 +192,7 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         def tile(ti, acc):
             ii = idx_i_ref[ti]
             jj = idx_j_ref[ti]
-            sel_i = onehot(ii, n, 0)
-            sel_j = onehot(jj, n, 0)
+            sel_i, sel_j = local_sel(ti)
             seln_i = onehot(ii, s, n)
             seln_j = onehot(jj, s, n)
             R = rows(rot_ref[ti])
@@ -197,7 +214,7 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                 return acc + jnp.sum(cross + 0.5 * quad)
             return acc + 0.5 * jnp.sum(quad)
 
-        return jax.lax.fori_loop(0, nt, tile, jnp.asarray(0.0, f32))
+        return tile_loop(tile, jnp.asarray(0.0, f32))
 
     def tangent_project(W):
         """W_Y - Y sym(Y^T W_Y) per pose; translation rows unchanged."""
@@ -386,12 +403,13 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
 
 def _tcg_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                 x_ref, scorr_ref, chol_ref, g_ref, radius_ref,
-                eta_ref, heta_ref, stats_ref,
-                *, r: int, d: int, max_iters: int, kappa: float,
+                eta_ref, heta_ref, stats_ref, *scratch,
+                r: int, d: int, max_iters: int, kappa: float,
                 theta: float):
     m = _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                     x_ref[...], scorr_ref[...], chol_ref[...],
-                    r=r, d=d, max_iters=max_iters, kappa=kappa, theta=theta)
+                    r=r, d=d, max_iters=max_iters, kappa=kappa, theta=theta,
+                    hoist_scratch=scratch or None)
     eta, Heta, kit, hit = m.tcg(g_ref[...], radius_ref[0, 0])
     eta_ref[...] = eta
     heta_ref[...] = Heta
@@ -400,8 +418,8 @@ def _tcg_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
 
 def _rtr_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                 x_ref, z_ref, scorr_ref, chol_ref, g_ref,
-                x_out_ref, stats_ref,
-                *, r: int, d: int, max_iters: int, kappa: float,
+                x_out_ref, stats_ref, *scratch,
+                r: int, d: int, max_iters: int, kappa: float,
                 theta: float, initial_radius: float, max_rejections: int):
     """Full single-step RTR (reference ``QuadraticOptimizer.cpp:92-110``):
     repeat {tCG at current radius; retract; evaluate cost; accept when
@@ -413,7 +431,8 @@ def _rtr_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
     g = g_ref[...]
     m = _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                     X, scorr_ref[...], chol_ref[...],
-                    r=r, d=d, max_iters=max_iters, kappa=kappa, theta=theta)
+                    r=r, d=d, max_iters=max_iters, kappa=kappa, theta=theta,
+                    hoist_scratch=scratch or None)
 
     f0 = m.cost(X, Z)
     eps = jnp.asarray(1e-30, f32)
@@ -448,8 +467,8 @@ def _rtr_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
 def _rtr_refine_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref,
                        wt_ref, rho_rot_ref, rho_trn_ref, rc_ref,
                        d_ref, dz_ref, scorr_ref, chol_ref, g_ref,
-                       radius_ref, d_out_ref, stats_ref,
-                       *, r: int, d: int, max_iters: int, kappa: float,
+                       radius_ref, d_out_ref, stats_ref, *scratch,
+                       r: int, d: int, max_iters: int, kappa: float,
                        theta: float, max_rejections: int):
     """Re-centered single-step RTR (``models.refine`` semantics): state is
     the small correction D at host-held f64 reference R; same attempt loop
@@ -467,7 +486,8 @@ def _rtr_refine_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref,
     m = _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                     Y, scorr_ref[...], chol_ref[...],
                     r=r, d=d, max_iters=max_iters, kappa=kappa, theta=theta,
-                    refine=(rho_rot_ref, rho_trn_ref, Rc, D))
+                    refine=(rho_rot_ref, rho_trn_ref, Rc, D),
+                    hoist_scratch=scratch or None)
 
     f0 = m.cost(D, Dz)
     eps = jnp.asarray(1e-30, f32)
@@ -518,10 +538,10 @@ def edge_tiles(w: jax.Array, nt: int, tile: int = TILE) -> jax.Array:
 
 
 @functools.partial(jax.jit, static_argnames=("r", "d", "max_iters", "kappa",
-                                             "theta", "interpret"))
+                                             "theta", "interpret", "hoist"))
 def tcg_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Sc, Lc, gc, radius,
              *, r: int, d: int, max_iters: int, kappa: float, theta: float,
-             interpret: bool = False):
+             interpret: bool = False, hoist: bool | None = None):
     """Invoke the tCG kernel for one agent (vmap adds the agent grid axis).
 
     Edge operands are tile-major (module docstring); pose operands are
@@ -532,6 +552,10 @@ def tcg_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Sc, Lc, gc, radius,
     kern = functools.partial(_tcg_kernel, r=r, d=d, max_iters=max_iters,
                              kappa=kappa, theta=theta)
     vspec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    nt, T = idx_i.shape[0], idx_i.shape[-1]
+    if hoist is None:
+        hoist = should_hoist(nt, T, n)
+    scratch = [pltpu.VMEM((nt, n, T), jnp.float32)] * 2 if hoist else []
     return pl.pallas_call(
         kern,
         out_shape=(
@@ -541,17 +565,18 @@ def tcg_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Sc, Lc, gc, radius,
         ),
         in_specs=[vspec] * 11,
         out_specs=(vspec, vspec, vspec),
+        scratch_shapes=scratch,
         interpret=interpret,
     )(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Sc, Lc, gc, radius)
 
 
 @functools.partial(jax.jit, static_argnames=(
     "r", "d", "max_iters", "kappa", "theta", "initial_radius",
-    "max_rejections", "interpret"))
+    "max_rejections", "interpret", "hoist"))
 def rtr_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Sc, Lc,
              gc, *, r: int, d: int, max_iters: int, kappa: float,
              theta: float, initial_radius: float, max_rejections: int,
-             interpret: bool = False):
+             interpret: bool = False, hoist: bool | None = None):
     """Invoke the full single-step RTR kernel for one agent.
 
     Returns (X_out_c [rk, n], stats [1, 4] = (attempts, accepted, f0, f)).
@@ -562,6 +587,10 @@ def rtr_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Sc, Lc,
                              initial_radius=initial_radius,
                              max_rejections=max_rejections)
     vspec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    nt, T = idx_i.shape[0], idx_i.shape[-1]
+    if hoist is None:
+        hoist = should_hoist(nt, T, n)
+    scratch = [pltpu.VMEM((nt, n, T), jnp.float32)] * 2 if hoist else []
     return pl.pallas_call(
         kern,
         out_shape=(
@@ -570,16 +599,19 @@ def rtr_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Sc, Lc,
         ),
         in_specs=[vspec] * 11,
         out_specs=(vspec, vspec),
+        scratch_shapes=scratch,
         interpret=interpret,
     )(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Sc, Lc, gc)
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "r", "d", "max_iters", "kappa", "theta", "max_rejections", "interpret"))
+    "r", "d", "max_iters", "kappa", "theta", "max_rejections", "interpret",
+    "hoist"))
 def rtr_refine_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, rho_rot, rho_trn,
                     Rc, Dc, Dzc, Sc, Lc, gc, radius, *, r: int, d: int,
                     max_iters: int, kappa: float, theta: float,
-                    max_rejections: int, interpret: bool = False):
+                    max_rejections: int, interpret: bool = False,
+                    hoist: bool | None = None):
     """Invoke the re-centered single-step RTR kernel for one agent.
 
     ``radius`` is the per-agent initial trust radius, [1, 1].
@@ -590,6 +622,10 @@ def rtr_refine_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, rho_rot, rho_trn,
                              max_iters=max_iters, kappa=kappa, theta=theta,
                              max_rejections=max_rejections)
     vspec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    nt, T = idx_i.shape[0], idx_i.shape[-1]
+    if hoist is None:
+        hoist = should_hoist(nt, T, n)
+    scratch = [pltpu.VMEM((nt, n, T), jnp.float32)] * 2 if hoist else []
     return pl.pallas_call(
         kern,
         out_shape=(
@@ -598,6 +634,17 @@ def rtr_refine_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, rho_rot, rho_trn,
         ),
         in_specs=[vspec] * 15,
         out_specs=(vspec, vspec),
+        scratch_shapes=scratch,
         interpret=interpret,
     )(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, rho_rot, rho_trn,
       Rc, Dc, Dzc, Sc, Lc, gc, radius)
+
+
+#: Hoisted one-hot budget: materialize the [nt, n, T] local selection
+#: stacks once per kernel invocation when they fit alongside the rest of
+#: the working set.
+HOIST_BUDGET_BYTES = 4 << 20
+
+
+def should_hoist(nt: int, tile: int, n: int) -> bool:
+    return 2 * nt * tile * n * 4 <= HOIST_BUDGET_BYTES

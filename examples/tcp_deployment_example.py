@@ -194,7 +194,13 @@ def launch(args) -> int:
          "--robot", str(rid), "--port", str(port), "--rank", str(args.rank),
          "--rounds", str(args.rounds), "--out-dir", out_dir],
         env=child_env) for rid in (0, 1)]
-    rcs = [p.wait(timeout=600) for p in procs]
+    try:
+        rcs = [p.wait(timeout=600) for p in procs]
+    finally:
+        # A hung/killed robot must not orphan its sibling.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     if any(rcs):
         print(f"robot processes failed: {rcs}", file=sys.stderr)
         return 1
