@@ -194,7 +194,7 @@ def recenter(Xg64: np.ndarray, graph, meta, params: AgentParams,
     pallas_fields = {}
     if graph.eidx_i is not None:
         # Kernel-layout constants: reference residuals at R over the edge
-        # tiles, R/E0 component-major, weight tiles (weights are fixed
+        # tiles, R component-major, weight tiles (weights are fixed
         # during refinement).
         A, nt, _, T = graph.eidx_i.shape
         E = edges_np["kappa"].shape[1]
