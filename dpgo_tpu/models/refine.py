@@ -432,8 +432,9 @@ def refine_round(D, consts: RefineConstants, graph, meta,
 
 
 def refine_rounds(D, consts: RefineConstants, graph, meta,
-                  params: AgentParams, num_rounds: int):
-    """``num_rounds`` fused re-centered rounds (one device dispatch)."""
+                  params: AgentParams, num_rounds):
+    """``num_rounds`` fused re-centered rounds (one device dispatch).
+    ``num_rounds`` is traced, so one compile serves every cycle length."""
 
     def body(_, DD):
         return refine_round(DD, consts, graph, meta, params)[0]
@@ -442,7 +443,7 @@ def refine_rounds(D, consts: RefineConstants, graph, meta,
 
 
 _refine_rounds_jit = jax.jit(refine_rounds,
-                             static_argnames=("meta", "params", "num_rounds"))
+                             static_argnames=("meta", "params"))
 
 
 def solve_refine(Xg64: np.ndarray, graph, meta, params: AgentParams,
