@@ -189,6 +189,99 @@ def make_sharded_certificate(mesh, num_probe: int = 4,
     return cert
 
 
+def solve_staircase_sharded(meas, num_robots: int, mesh=None,
+                            r_min: int | None = None, r_max: int = 10,
+                            rounds_per_rank: int = 300,
+                            grad_norm_tol: float = 1e-8,
+                            eta: float = 1e-5, dtype=None, X0=None,
+                            verbose: bool = False):
+    """Distributed certifiably correct PGO, end to end on the mesh.
+
+    The full loop of the T-RO 2021 title: RBCD solve sharded over the agent
+    mesh, the dual certificate via the distributed block LOBPCG, and — on
+    failure — the saddle escape to rank r+1 applied per agent (the lift
+    ``X+ = [[X], [alpha v^T]]`` is a per-pose operation; only the
+    backtracking line search consults the global cost, a scalar consensus).
+    ``models.certify.solve_staircase`` is the centralized counterpart.
+
+    Returns ``(T, X_agents, rank, CertificateResult, history)`` with ``T``
+    the rounded global trajectory.
+    """
+    import numpy as np
+
+    from ..config import AgentParams, SolverParams
+    from ..models import refine
+    from ..models.certify import _recover_rounding_basis
+    from ..models.local_pgo import round_solution
+    from ..types import edge_set_from_measurements
+    from ..utils.partition import partition_contiguous
+    from .sharded import make_sharded_multi_step, shard_problem
+
+    mesh = mesh or make_mesh()
+    d = meas.d
+    r_min = d + 1 if r_min is None else r_min
+    dtype = dtype or jnp.float32
+    part = partition_contiguous(meas, num_robots)
+    edges_g = edge_set_from_measurements(part.meas_global, dtype=jnp.float64)
+    n_total = part.meas_global.num_poses
+
+    Xa = X0
+    history = []
+    for r in range(r_min, r_max + 1):
+        params = AgentParams(
+            d=d, r=r, num_robots=num_robots, rel_change_tol=0.0,
+            solver=SolverParams(grad_norm_tol=grad_norm_tol,
+                                max_inner_iters=10))
+        graph, meta = rbcd.build_graph(part, r, dtype)
+        if Xa is None:
+            Xa = rbcd.centralized_chordal_init(part, meta, graph, dtype)
+        state = rbcd.init_state(graph, meta, jnp.asarray(Xa, dtype),
+                                params=params)
+        state, graph_s = shard_problem(mesh, state, graph)
+        steps = make_sharded_multi_step(mesh, meta, params)
+        state = steps(state, graph_s, rounds_per_rank)
+        Xa = state.X
+
+        cert = certify_sharded(Xa, graph_s, mesh=mesh, eta=eta, seed=r)
+        Xg = np.asarray(rbcd.gather_to_global(Xa, graph, n_total),
+                        np.float64)
+        f = refine.global_cost(Xg, edges_g)
+        history.append((r, f, cert.lambda_min))
+        if verbose:
+            print(f"[staircase-sharded] rank {r}: cost {f:.6f}, "
+                  f"lambda_min {cert.lambda_min:.3e}, "
+                  f"certified={cert.certified}")
+        if cert.certified or r == r_max:
+            X64 = jnp.asarray(Xg)
+            ylift = _recover_rounding_basis(X64, d)
+            T = round_solution(X64, ylift)
+            return T, Xa, r, cert, history
+
+        # Saddle escape per agent: append the negative-curvature row, pick
+        # alpha by backtracking on the global cost (scalar consensus).
+        v = np.asarray(cert.direction)                    # [A, n, dh]
+        Xa_np = np.asarray(Xa, np.float64)
+        f0 = f
+
+        def lifted(alpha):
+            rows = alpha * v[:, :, None, :]
+            Xp = np.concatenate([Xa_np, rows], axis=2)    # [A, n, r+1, dh]
+            return np.asarray(jax.vmap(manifold.project)(
+                jnp.asarray(Xp)), np.float64)
+
+        alpha, ok = 1e-2, False
+        for _ in range(20):
+            Xp = lifted(alpha)  # on-manifold: lifted() projects per pose
+            Xg_p = np.asarray(rbcd.gather_to_global(
+                jnp.asarray(Xp), graph, n_total), np.float64)
+            if refine.global_cost(Xg_p, edges_g) < f0:
+                ok = True
+                break
+            alpha *= 0.5
+        Xa = Xp if ok else lifted(0.0)
+    raise AssertionError("unreachable")
+
+
 _CERT_CACHE: dict = {}
 
 

@@ -115,6 +115,7 @@ SLOW_TESTS = {
     "test_recentered_gradient_error_scales_with_d",
     "test_two_process_tcp_solve_converges",
     "test_comm_model_matches_compiled_collectives",
+    "test_sharded_staircase_escapes_winding_minimum",
 }
 
 
