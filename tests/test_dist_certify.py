@@ -93,6 +93,20 @@ def test_sharded_staircase_escapes_winding_minimum():
     assert T.shape == (meas.num_poses, meas.d, meas.d + 1)
 
 
+def test_sharded_staircase_certifies_clean_graph(rng):
+    """Default path (chordal init, X0=None): a clean synthetic graph
+    certifies at the starting rank without any escape."""
+    meas, _ = make_measurements(rng, n=32, d=3, num_lc=16,
+                                rot_noise=0.01, trans_noise=0.01)
+    T, Xa, rank, cert, hist = dcert.solve_staircase_sharded(
+        meas, 8, mesh=make_mesh(8), r_max=6, rounds_per_rank=200,
+        dtype=jnp.float64)
+    assert cert.certified
+    assert rank == meas.d + 1          # r_min, no escapes needed
+    assert len(hist) == 1
+    assert T.shape == (meas.num_poses, meas.d, meas.d + 1)
+
+
 def test_sharded_certificate_sphere2500(rng, data_dir):
     """BASELINE config #5 capability on the real dataset: the sharded
     lambda_min matches the centralized LOBPCG value on sphere2500 over the
