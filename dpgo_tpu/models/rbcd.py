@@ -239,6 +239,15 @@ def build_graph(part: Partition, rank: int, dtype=jnp.float32,
 # Global <-> per-agent layout
 # ---------------------------------------------------------------------------
 
+def with_weights(graph: MultiAgentGraph, weights) -> MultiAgentGraph:
+    """Graph with ``edges.weight`` replaced by ``weights [A, E_max]`` —
+    use to evaluate/refine/certify the objective a robust (GNC) solve
+    actually minimized (``RBCDState.weights``), since weight updates live
+    in the state, not the build-time graph."""
+    return graph._replace(
+        edges=graph.edges._replace(weight=jnp.asarray(weights)))
+
+
 def scatter_to_agents(Xg: jax.Array, graph: MultiAgentGraph) -> jax.Array:
     """Global pose array [N, ...] -> per-agent [A, n_max, ...]."""
     return Xg[graph.global_index]
@@ -456,14 +465,22 @@ def _pallas_vmem_ok(meta: GraphMeta, graph) -> bool:
     design's ~765-edge Mosaic compile ceiling is gone (e_max 1906 /
     n_max 1000 verified compiling and running on v5e); the remaining
     ceiling tracks real VMEM pressure (e_max 3793 / n_max 2000 at T=256
-    crashes the compile helper, consistent with this estimate)."""
+    crashes the compile helper, consistent with this estimate).  The
+    hoisted one-hot scratch (``pallas_tcg.should_hoist``) counts toward the
+    same budget when the kernel will allocate it — both gates derive from
+    one estimate, so a shape cannot pass here and then overflow VMEM by
+    adding the hoist scratch."""
+    from ..ops.pallas_tcg import should_hoist
+
     T = graph.eidx_i.shape[-1]
     nt = graph.eidx_i.shape[1]
     rk = meta.rank * (meta.d + 1)
     edge_tiles = nt * T * (meta.d * meta.d + meta.d + 4)
     onehots = 4 * T * (meta.n_max + meta.s_max)
     vecs = 12 * rk * meta.n_max
-    return (edge_tiles + onehots + vecs) * 4 <= PALLAS_TCG_VMEM_BUDGET_BYTES
+    hoist = 2 * nt * T * meta.n_max if should_hoist(nt, T, meta.n_max) else 0
+    return (edge_tiles + onehots + vecs + hoist) * 4 \
+        <= PALLAS_TCG_VMEM_BUDGET_BYTES
 
 
 def _formulation(meta: GraphMeta, params: AgentParams | None, graph,

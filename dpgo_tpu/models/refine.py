@@ -152,13 +152,26 @@ def _np_project_manifold(Xg64: np.ndarray, d: int) -> np.ndarray:
 
 
 def recenter(Xg64: np.ndarray, graph, meta, params: AgentParams,
-             edges_global, chol=None) -> RefineRef:
+             edges_global, chol=None, weights=None) -> RefineRef:
     """Build the f64 reference and its device constants from a global
     iterate.  ``Xg64 [N, r, k]`` is projected to the manifold in f64 first;
     ``edges_global`` is the global EdgeSet (host arrays ok) for ``f_ref``.
     ``chol`` (device [A, n, k, k]) is reused across recenters when given —
-    the factors depend only on the (fixed) edge weights.
+    the factors depend only on the edge weights, which are fixed during
+    refinement, so a ``chol`` is only reusable if it was built from the
+    SAME weights this call refines under (as ``solve_refine``'s internal
+    reuse guarantees); passing a unit-weight ``chol`` together with GNC
+    ``weights`` silently preconditions for the wrong objective.
+
+    ``weights [A, E]``, when given, replaces ``graph.edges.weight`` — pass
+    the final GNC weights (``RBCDState.weights``) when refining a robust
+    solve, since the solver applies weight updates to the state, not the
+    build-time graph; ``edges_global`` must then carry the matching
+    per-measurement weights (``rbcd.global_weights``) so ``f_ref`` is the
+    same objective.
     """
+    if weights is not None:
+        graph = rbcd.with_weights(graph, weights)
     d = meta.d
     Xg64 = _np_project_manifold(Xg64, d)
 
@@ -448,10 +461,17 @@ _refine_rounds_jit = jax.jit(refine_rounds,
 
 def solve_refine(Xg64: np.ndarray, graph, meta, params: AgentParams,
                  edges_global, f_opt: float, rel_gap: float = 1e-6,
-                 rounds_per_cycle: int = 50, max_cycles: int = 12):
+                 rounds_per_cycle: int = 50, max_cycles: int = 12,
+                 weights=None):
     """Drive re-centered refinement until the f64 global gap reaches
     ``rel_gap`` (or ``max_cycles`` recenters).  Returns
-    (X64, gap, cycles, history)."""
+    (X64, gap, cycles, history).
+
+    ``weights [A, E]``: final GNC weights of the solve being refined (see
+    ``recenter``); ``edges_global`` must carry the matching global weights.
+    """
+    if weights is not None:
+        graph = rbcd.with_weights(graph, weights)
     history = []
     target = f_opt * (1.0 + rel_gap)
     chol = None

@@ -282,23 +282,35 @@ def solve_staircase_sharded(meas, num_robots: int, mesh=None,
     raise AssertionError("unreachable")
 
 
+#: Compiled-certificate cache, FIFO-bounded: each entry pins a shard_map
+#: executable and its Mesh, so an unbounded dict would leak stale meshes in
+#: long-lived processes that rebuild meshes (e.g. test suites).
 _CERT_CACHE: dict = {}
+_CERT_CACHE_MAX = 8
 
 
 def certify_sharded(X, graph: MultiAgentGraph, mesh=None,
                     eta: float = 1e-5, seed: int = 0, num_probe: int = 4,
-                    power_iters: int = 50, sub_iters: int = 100):
+                    power_iters: int = 50, sub_iters: int = 100,
+                    weights=None):
     """Distributed dual certificate of an agent-partitioned iterate.
 
     ``X [A, n_max, r, d+1]`` and ``graph`` may be host or mesh-placed; they
     are sharded over ``mesh`` (default: all devices).  Returns a
     ``models.certify.CertificateResult`` whose ``direction`` is the
     per-agent [A, n_max, d+1] eigendirection.
+
+    ``weights [A, E]``, when given, replaces ``graph.edges.weight`` — pass
+    the final GNC weights (``RBCDState.weights``) when certifying a robust
+    solve: the certificate is of the weighted objective the solver actually
+    minimized, not the build-time unit-weight one.
     """
     from jax.sharding import NamedSharding
     from ..models.certify import CertificateResult
 
     mesh = mesh or make_mesh()
+    if weights is not None:
+        graph = rbcd.with_weights(graph, weights)
     put = lambda t: jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         t, _specs(mesh, t))
@@ -310,6 +322,8 @@ def certify_sharded(X, graph: MultiAgentGraph, mesh=None,
     cfg = (mesh, num_probe, power_iters, sub_iters)
     cert = _CERT_CACHE.get(cfg)
     if cert is None:
+        while len(_CERT_CACHE) >= _CERT_CACHE_MAX:
+            _CERT_CACHE.pop(next(iter(_CERT_CACHE)))
         cert = _CERT_CACHE[cfg] = make_sharded_certificate(
             mesh, num_probe=num_probe, power_iters=power_iters,
             sub_iters=sub_iters)

@@ -97,19 +97,37 @@ def run_robot(robot_id: int, dataset: str, rank: int, rounds: int,
     part = partition_contiguous(meas, 2)
     agent = PGOAgent(robot_id, params)
 
-    # Robot 0 listens, robot 1 dials (with retries while 0 boots).
+    # Robot 0 listens, robot 1 dials (with retries while 0 boots).  With
+    # port 0 robot 0 binds an OS-assigned port itself and publishes the
+    # choice through out_dir — no separate pick-then-bind window for
+    # another process to steal the port (TOCTOU).
+    port_file = os.path.join(out_dir, "port.txt")
     if robot_id == 0:
         srv = socket.create_server(("127.0.0.1", port))
+        port = srv.getsockname()[1]
+        tmp = port_file + ".tmp"
+        with open(tmp, "w") as fh:  # atomic publish: no partial reads
+            fh.write(str(port))
+        os.replace(tmp, port_file)
         conn, _ = srv.accept()
     else:
         for attempt in range(100):
+            if port == 0:
+                try:
+                    with open(port_file) as fh:
+                        port = int(fh.read())
+                except (FileNotFoundError, ValueError):
+                    time.sleep(0.1)
+                    continue
             try:
                 conn = socket.create_connection(("127.0.0.1", port))
                 break
             except ConnectionRefusedError:
                 time.sleep(0.1)
         else:
-            raise ConnectionError(f"robot 1 could not reach port {port}")
+            where = f"port {port}" if port else f"port file {port_file}"
+            raise ConnectionError(
+                f"robot 1 could not reach robot 0 ({where})")
     conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     # Lifting-matrix broadcast (robot 0 self-generates; reference
@@ -179,10 +197,13 @@ def launch(args) -> int:
 
     out_dir = args.out_dir or tempfile.mkdtemp(prefix="dpgo_tcp_")
     os.makedirs(out_dir, exist_ok=True)
+    # port 0 flows through to robot 0, which binds it and publishes the
+    # OS-assigned choice via out_dir/port.txt (read by robot 1) — binding
+    # in the child avoids the pick-then-rebind TOCTOU window.
     port = args.port
-    if port == 0:  # pick a free port up front so both children agree
-        with socket.create_server(("127.0.0.1", 0)) as s:
-            port = s.getsockname()[1]
+    stale = os.path.join(out_dir, "port.txt")
+    if os.path.exists(stale):  # reused --out-dir: drop the previous run's
+        os.unlink(stale)
 
     # Robot processes always run on CPU unless told otherwise: two python
     # processes cannot share the single tunneled-TPU grant (they would

@@ -183,3 +183,33 @@ def test_solve_refine_beats_f32_floor(rng):
     YY = X64[..., :meta.d]
     gram = np.swapaxes(YY, -1, -2) @ YY
     assert np.allclose(gram, np.eye(meta.d), atol=1e-8)
+
+
+def test_solve_refine_uses_given_weights(rng):
+    """Refining a robust (GNC) solve must optimize the weighted objective:
+    with down-weighted loop closures passed via ``weights``, the refined
+    point improves the weighted global cost, and the recenter's f_ref is
+    the weighted cost (not the build-time unit-weight one)."""
+    meas, part, graph, meta, params, edges_g, Xg = _problem(rng, n=40,
+                                                           rounds=120)
+    # Down-weight every loop-closure edge (as a converged GNC would).
+    is_lc = np.asarray(graph.edges.is_lc)
+    wA = np.where(is_lc > 0, 0.25, 1.0) * np.asarray(graph.edges.mask)
+    wA = jnp.asarray(wA, jnp.float32)
+    wg = rbcd.global_weights(wA, graph, len(part.meas_global))
+    edges_w = edges_g._replace(weight=wg.astype(edges_g.weight.dtype))
+
+    ref = refine.recenter(Xg, graph, meta, params, edges_w, weights=wA)
+    f_w = refine.global_cost(refine._np_project_manifold(Xg, meta.d),
+                             edges_w)
+    assert ref.f_ref == pytest.approx(f_w, rel=1e-12)
+    f_u = refine.global_cost(refine._np_project_manifold(Xg, meta.d),
+                             edges_g)
+    assert abs(f_w - f_u) > 1e-6 * max(1.0, f_u)  # the two objectives differ
+
+    X64, gap, cycles, hist = refine.solve_refine(
+        Xg, graph, meta, params, edges_w, f_opt=1.0, rel_gap=-1.0,
+        rounds_per_cycle=30, max_cycles=2, weights=wA)
+    assert refine.global_cost(X64, edges_w) < f_w
+    # monotone in the WEIGHTED objective across recenters
+    assert all(b <= a + 1e-15 for a, b in zip(hist, hist[1:]))
