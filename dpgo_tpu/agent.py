@@ -445,6 +445,79 @@ class PGOAgent:
         assert X is not None, "agent not initialized"
         return np.asarray(round_solution(jnp.asarray(X), jnp.asarray(self._ylift)))
 
+    # -- fine-grained pose getters (PGOAgent.h:312-364) ---------------------
+
+    def get_neighbors(self) -> list[int]:
+        """Sorted neighbor robot IDs (``getNeighbors``,
+        ``PGOAgent.cpp:577-581``)."""
+        with self._lock:
+            return sorted({r for (r, _p) in self._nbr_slot})
+
+    def get_neighbor_public_poses(self, neighbor_id: int) -> list[int]:
+        """Pose indices needed from ``neighbor_id``
+        (``getNeighborPublicPoses``, ``PGOAgent.cpp:564-575``)."""
+        with self._lock:
+            return sorted(p for (r, p) in self._nbr_slot if r == neighbor_id)
+
+    def get_shared_pose(self, index: int) -> np.ndarray | None:
+        """Single pose block of X by local index, or None when the agent is
+        uninitialized / the index is out of range (``getSharedPose``,
+        ``PGOAgent.cpp:76-83``; like the reference, the index is not checked
+        to be a public pose)."""
+        with self._lock:
+            if self._status.state != AgentState.INITIALIZED \
+                    or not 0 <= index < self.n:
+                return None
+            return self.X[index].copy()
+
+    def get_aux_shared_pose(self, index: int) -> np.ndarray | None:
+        """Single pose block of the Nesterov aux sequence Y
+        (``getAuxSharedPose``, ``PGOAgent.cpp:85-93``)."""
+        assert self.params.acceleration, \
+            "aux poses exist only with acceleration enabled"
+        with self._lock:
+            if self._status.state != AgentState.INITIALIZED \
+                    or self._Y is None or not 0 <= index < self.n:
+                return None
+            return self._Y[index].copy()
+
+    def _to_global_frame(self, Xi: np.ndarray) -> np.ndarray | None:
+        """Anchor-frame [d, d+1] of one lifted block: ``Ya^T Xi`` with the
+        anchor translation subtracted — the reference's linear map
+        (``getPoseInGlobalFrame``, ``PGOAgent.cpp:521-538``), deliberately
+        without an SO(d) projection."""
+        anchor = self.get_global_anchor()
+        if anchor is None:
+            return None
+        d = self.d
+        Ya, pa = anchor[:, :d], anchor[:, d]
+        Ti = Ya.T @ Xi
+        Ti[:, d] -= Ya.T @ pa
+        return Ti
+
+    def get_pose_in_global_frame(self, pose_id: int) -> np.ndarray | None:
+        """One of this robot's poses in the global (anchor) frame, or None
+        when the anchor/initialization/index is missing
+        (``getPoseInGlobalFrame``, ``PGOAgent.cpp:521-538``)."""
+        with self._lock:
+            if self._status.state != AgentState.INITIALIZED \
+                    or not 0 <= pose_id < self.n:
+                return None
+            return self._to_global_frame(self.X[pose_id])
+
+    def get_neighbor_pose_in_global_frame(self, neighbor_id: int,
+                                          pose_id: int) -> np.ndarray | None:
+        """A cached neighbor public pose in the global frame, or None when
+        it has not been received (``getNeighborPoseInGlobalFrame``,
+        ``PGOAgent.cpp:540-562``)."""
+        with self._lock:
+            if self._status.state != AgentState.INITIALIZED:
+                return None
+            Xi = self._neighbor_poses.get((neighbor_id, pose_id))
+            if Xi is None:
+                return None
+            return self._to_global_frame(Xi)
+
     # -- GNC weights --------------------------------------------------------
 
     def _update_loop_closure_weights(self) -> bool:

@@ -244,8 +244,8 @@ def with_weights(graph: MultiAgentGraph, weights) -> MultiAgentGraph:
     use to evaluate/refine/certify the objective a robust (GNC) solve
     actually minimized (``RBCDState.weights``), since weight updates live
     in the state, not the build-time graph."""
-    return graph._replace(
-        edges=graph.edges._replace(weight=jnp.asarray(weights)))
+    return graph._replace(edges=graph.edges._replace(
+        weight=jnp.asarray(weights, graph.edges.weight.dtype)))
 
 
 def scatter_to_agents(Xg: jax.Array, graph: MultiAgentGraph) -> jax.Array:
@@ -470,7 +470,7 @@ def _pallas_vmem_ok(meta: GraphMeta, graph) -> bool:
     same budget when the kernel will allocate it — both gates derive from
     one estimate, so a shape cannot pass here and then overflow VMEM by
     adding the hoist scratch."""
-    from ..ops.pallas_tcg import should_hoist
+    from ..ops.pallas_tcg import hoist_scratch_bytes, should_hoist
 
     T = graph.eidx_i.shape[-1]
     nt = graph.eidx_i.shape[1]
@@ -478,8 +478,9 @@ def _pallas_vmem_ok(meta: GraphMeta, graph) -> bool:
     edge_tiles = nt * T * (meta.d * meta.d + meta.d + 4)
     onehots = 4 * T * (meta.n_max + meta.s_max)
     vecs = 12 * rk * meta.n_max
-    hoist = 2 * nt * T * meta.n_max if should_hoist(nt, T, meta.n_max) else 0
-    return (edge_tiles + onehots + vecs + hoist) * 4 \
+    hoist = hoist_scratch_bytes(nt, T, meta.n_max) \
+        if should_hoist(nt, T, meta.n_max) else 0
+    return (edge_tiles + onehots + vecs) * 4 + hoist \
         <= PALLAS_TCG_VMEM_BUDGET_BYTES
 
 

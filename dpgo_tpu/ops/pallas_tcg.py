@@ -646,5 +646,12 @@ def rtr_refine_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, rho_rot, rho_trn,
 HOIST_BUDGET_BYTES = 4 << 20
 
 
+def hoist_scratch_bytes(nt: int, tile: int, n: int) -> int:
+    """Bytes of the two [nt, n, T] f32 one-hot scratch stacks — the single
+    source for ``should_hoist``, the kernels' ``scratch_shapes``, and the
+    dispatch gate's VMEM estimate (``rbcd._pallas_vmem_ok``)."""
+    return 2 * nt * tile * n * 4
+
+
 def should_hoist(nt: int, tile: int, n: int) -> bool:
-    return 2 * nt * tile * n * 4 <= HOIST_BUDGET_BYTES
+    return hoist_scratch_bytes(nt, tile, n) <= HOIST_BUDGET_BYTES

@@ -103,6 +103,8 @@ def run_robot(robot_id: int, dataset: str, rank: int, rounds: int,
     # another process to steal the port (TOCTOU).
     port_file = os.path.join(out_dir, "port.txt")
     if robot_id == 0:
+        if os.path.exists(port_file):  # reused out_dir: drop the stale one
+            os.unlink(port_file)
         srv = socket.create_server(("127.0.0.1", port))
         port = srv.getsockname()[1]
         tmp = port_file + ".tmp"
@@ -111,21 +113,24 @@ def run_robot(robot_id: int, dataset: str, rank: int, rounds: int,
         os.replace(tmp, port_file)
         conn, _ = srv.accept()
     else:
+        dial = port
         for attempt in range(100):
             if port == 0:
+                # Re-read every attempt: a stale file from a previous run
+                # may be consumed before this run's robot 0 republishes.
                 try:
                     with open(port_file) as fh:
-                        port = int(fh.read())
+                        dial = int(fh.read())
                 except (FileNotFoundError, ValueError):
                     time.sleep(0.1)
                     continue
             try:
-                conn = socket.create_connection(("127.0.0.1", port))
+                conn = socket.create_connection(("127.0.0.1", dial))
                 break
             except ConnectionRefusedError:
                 time.sleep(0.1)
         else:
-            where = f"port {port}" if port else f"port file {port_file}"
+            where = f"port {dial}" if dial else f"port file {port_file}"
             raise ConnectionError(
                 f"robot 1 could not reach robot 0 ({where})")
     conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
