@@ -41,12 +41,20 @@ class Schedule(enum.Enum):
     RBCD admits parallel updates, and the reference's async mode realizes the
     same delay-tolerant semantics).  ASYNC updates an independent random
     subset per round, the on-device analog of the reference's Poisson-clock
-    threads (``PGOAgent.cpp:876-898``).
+    threads (``PGOAgent.cpp:876-898``).  COLORED fires one color class of a
+    greedy coloring of the agent-adjacency graph per round — simultaneous
+    updates only of NON-adjacent blocks, which is exactly the parallelism
+    the RBCD theory licenses (Tian et al., T-RO 2021: blocks sharing no
+    edge have independent subproblems): a deterministic multi-color
+    Gauss-Seidel sweep that cannot oscillate the way JACOBI does on
+    strongly-coupled graphs (measured on ais2klinik, BASELINE.md), at the
+    cost of advancing only ~A/num_colors agents per round.
     """
 
     GREEDY = "greedy"
     JACOBI = "jacobi"
     ASYNC = "async"
+    COLORED = "colored"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -64,6 +64,9 @@ class GraphMeta:
     p_max: int  # public poses per agent
     d: int
     rank: int
+    # Chromatic size of the greedy agent coloring (Schedule.COLORED fires
+    # color class (iteration mod num_colors) each round).
+    num_colors: int = 1
 
 
 class MultiAgentGraph(NamedTuple):
@@ -96,6 +99,9 @@ class MultiAgentGraph(NamedTuple):
     eidx_j: jax.Array | None = None  # [A, nt, 1, T]
     rot_t: jax.Array | None = None   # [A, nt, d*d, T]
     trn_t: jax.Array | None = None   # [A, nt, d, T]
+    # Greedy agent coloring (``utils.graph_plan.color_agents``): agents of
+    # one color share no edge; Schedule.COLORED fires one class per round.
+    color: jax.Array | None = None   # [A] int32
 
 
 class RBCDState(NamedTuple):
@@ -215,6 +221,9 @@ def build_graph(part: Partition, rank: int, dtype=jnp.float32,
         mask=jnp.asarray(valid.astype(np.float64), dtype),
         is_lc=jnp.asarray(eis_lc, dtype), fixed_weight=jnp.asarray(efix, dtype),
     )
+    from ..utils.graph_plan import color_agents
+    color, num_colors = color_agents(plan.nbr_robot, plan.nbr_mask, A)
+
     graph = MultiAgentGraph(
         edges=edges,
         meas_id=jnp.asarray(plan.meas_id.astype(np.int32)),
@@ -228,10 +237,11 @@ def build_graph(part: Partition, rank: int, dtype=jnp.float32,
         global_index=jnp.asarray(np.maximum(part.global_index, 0), jnp.int32),
         inc_slot=jnp.asarray(plan.inc_slot),
         inc_mask=jnp.asarray(plan.inc_mask.astype(np.float64), dtype),
+        color=jnp.asarray(color),
         **pallas_fields,
     )
     meta = GraphMeta(num_robots=A, n_max=n_max, e_max=e_max, s_max=s_max,
-                     p_max=p_max, d=d, rank=rank)
+                     p_max=p_max, d=d, rank=rank, num_colors=num_colors)
     return graph, meta
 
 
@@ -494,13 +504,21 @@ def _formulation(meta: GraphMeta, params: AgentParams | None, graph,
     if params is None:
         return "ell"
     rtr = params.solver.algorithm == ROptAlg.RTR
-    pallas_ok = rtr and graph.eidx_i is not None and _pallas_vmem_ok(meta, graph)
+    # The kernel is f32-only: routing an f64 problem through it would
+    # silently clamp the iterate (and the gn0 convergence metric) to f32
+    # every round, so a converged f64 block never stays at its fixed point
+    # and tight grad_norm_tols become unreachable.
+    pallas_ok = rtr and itemsize == 4 and graph.eidx_i is not None \
+        and _pallas_vmem_ok(meta, graph)
     if params.solver.pallas_tcg is True:
         if not pallas_ok:
             # An explicit force that cannot be honored must not silently
             # downgrade — the caller believes the kernel is being covered.
             if not rtr:
                 reason = "algorithm is not RTR"
+            elif itemsize != 4:
+                reason = ("the kernel is float32-only and the problem is "
+                          "float64 — build the graph/state in float32")
             elif graph.eidx_i is None:
                 reason = ("the graph was built without edge tiles "
                           "(build_graph(pallas_sel=True))")
@@ -562,33 +580,26 @@ def _agent_update(X_local, z, edges, params: AgentParams, chol=None, inc=None,
         wk = ptcg.edge_tiles((w * edges.kappa).astype(jnp.float32), nt, tile)
         wt = ptcg.edge_tiles((w * edges.tau).astype(jnp.float32), nt, tile)
         Lc = chol.transpose(1, 2, 0).reshape(k * k, n_max)
-        # Gradient at the start point (ELL path) -> the kernel runs the
-        # whole single-step RTR (tCG + retraction + acceptance + radius
-        # retries) in VMEM; the early-exit below the solver's gradient
-        # tolerance (QuadraticOptimizer.cpp:65-69) stays out here.
-        buf = jnp.concatenate([X_local, z], axis=0)
-        eg = quadratic.egrad_ell(buf, edges, inc[0], inc[1]) if inc is not None \
-            else quadratic.egrad(buf, edges, n_out=n_max)
-        g = manifold.rgrad(X_local, eg)
-        gn0 = manifold.norm(g)
-        Y, GY = X_local[..., :d], eg[..., :d]
-        M = jnp.einsum("nab,nac->nbc", Y, GY)
-        S = 0.5 * (M + jnp.swapaxes(M, -1, -2))
-        Sc = S.transpose(1, 2, 0).reshape(d * d, n_max)
-        X_out_c, stats = ptcg.rtr_call(
+        # Fully-fused kernel: gradient, curvature term, gradient norm, tCG,
+        # retraction, acceptance and radius retries all in VMEM, including
+        # the below-tolerance early exit (QuadraticOptimizer.cpp:65-69) —
+        # the per-round XLA work is just the exchange and these layout
+        # transposes (measured: the old out-of-kernel ELL gradient pass was
+        # ~65% of a sphere2500 round).
+        X_out_c, stats = ptcg.rtr_full_call(
             eidx_i, eidx_j, rot_t, trn_t, wk, wt,
             ptcg.comp_major(X_local.astype(jnp.float32)),
             ptcg.comp_major(z.astype(jnp.float32)),
-            Sc.astype(jnp.float32), Lc.astype(jnp.float32),
-            ptcg.comp_major(g.astype(jnp.float32)),
+            Lc.astype(jnp.float32),
             r=r, d=d, max_iters=params.solver.max_inner_iters,
             kappa=params.solver.tcg_kappa, theta=params.solver.tcg_theta,
             initial_radius=params.solver.initial_radius,
             max_rejections=params.solver.max_rejections,
+            grad_tol=params.solver.grad_norm_tol,
             interpret=interpret)
         X_new = ptcg.comp_minor(X_out_c, r, k).astype(X_local.dtype)
-        below_tol = gn0 < params.solver.grad_norm_tol
-        return jnp.where(below_tol, X_local, X_new), gn0
+        gn0 = stats[0, 4].astype(X_local.dtype)
+        return X_new, gn0
     problem = _agent_local_problem(z, edges, chol, n_max, inc=inc, qbuf=qbuf)
     out = solver.rtr_single_step(problem, X_local, params.solver, None,
                                  final_grad_norm=False)
@@ -813,6 +824,16 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
     elif schedule == Schedule.ASYNC:
         fired = jax.vmap(
             lambda k: jax.random.bernoulli(k, params.async_update_prob))(sub)
+    elif schedule == Schedule.COLORED:
+        if graph.color is None:
+            raise ValueError(
+                "Schedule.COLORED requires a colored graph — rebuild it "
+                "with build_graph (colors are always computed there)")
+        # Multi-color Gauss-Seidel: fire one class of mutually non-adjacent
+        # agents per round, cycling classes — state.iteration counts the
+        # PREVIOUS rounds, so class (iteration mod C) is deterministic and
+        # identical on every shard.
+        fired = graph.color == (state.iteration % meta.num_colors)
     else:
         raise ValueError(f"unknown schedule {schedule}")
     fired_b = fired[:, None, None, None]

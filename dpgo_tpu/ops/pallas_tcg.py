@@ -68,7 +68,7 @@ TILE = 256
 
 def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                 X, S, L, *, r, d, max_iters, kappa, theta, refine=None,
-                hoist_scratch=None):
+                hoist_scratch=None, Z=None):
     """Closures over the per-agent VMEM refs (component-major layout).
 
     Edge data arrives as tile-major refs (see module docstring) read
@@ -76,6 +76,12 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
     during a solve): tangent projection and the Riemannian curvature
     correction are taken at ``X``; ``S = sym(Y^T G_Y)`` per pose; ``L`` the
     preconditioner Cholesky components.
+
+    ``S = None`` (requires ``Z``) switches to the fully-fused mode: the
+    Euclidean gradient at the buffer point [X | Z], the curvature term S,
+    the Riemannian gradient and its norm are all computed IN-kernel
+    (``m.g``, ``m.gn0``) — the XLA pre-pass that previously produced
+    S and g per round (65% of a small-problem round, measured) disappears.
 
     ``refine = (rho_rot_ref [nt, r*d, T], rho_trn_ref [nt, r, T],
     Rc [rk, n], D [rk, n])`` switches the kernel to the
@@ -137,7 +143,6 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         return jax.lax.fori_loop(0, nt, tile_fn, init)
 
     Xr = rows(X)
-    Sr = rows(S)
     Lr = rows(L)
 
     def edge_residuals(Vi, Vj, R, t):
@@ -150,6 +155,22 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                                               for b in range(d))
               for a in range(r)]
         return rR, rt
+
+    def edge_grad_rows(rR, rt, R, t, wk, wt):
+        """Per-edge endpoint gradient rows gi/gj from residual components
+        (``quadratic._edge_grad_terms``)."""
+        gj = [None] * rk
+        gi = [None] * rk
+        for a in range(r):
+            for c in range(d):
+                gj[q(a, c)] = wk * rR[a][c]
+                # gi_Y[a,c] = -wk (rR R^T)[a,c] - wt rt[a] t[c]
+                gi[q(a, c)] = -wk * sum(rR[a][b] * R[c * d + b]
+                                        for b in range(d)) \
+                    - wt * rt[a] * t[c]
+            gj[q(a, d)] = wt * rt[a]
+            gi[q(a, d)] = -wt * rt[a]
+        return gi, gj
 
     def hess_euclidean(V):
         """(V Q)_local on the buffer graph, accumulated over edge tiles:
@@ -165,17 +186,33 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
             Vi = rows(gather(V, sel_i))
             Vj = rows(gather(V, sel_j))
             rR, rt = edge_residuals(Vi, Vj, R, t)
-            gj = [None] * rk
-            gi = [None] * rk
-            for a in range(r):
-                for c in range(d):
-                    gj[q(a, c)] = wk * rR[a][c]
-                    # gi_Y[a,c] = -wk (rR R^T)[a,c] - wt rt[a] t[c]
-                    gi[q(a, c)] = -wk * sum(rR[a][b] * R[c * d + b]
-                                            for b in range(d)) \
-                        - wt * rt[a] * t[c]
-                gj[q(a, d)] = wt * rt[a]
-                gi[q(a, d)] = -wt * rt[a]
+            gi, gj = edge_grad_rows(rR, rt, R, t, wk, wt)
+            return acc + scatter(stack(gi), sel_i) + scatter(stack(gj), sel_j)
+
+        return tile_loop(tile, jnp.zeros((rk, n), f32))
+
+    def grad_euclidean():
+        """Euclidean gradient rows of the LOCAL poses at the buffer point
+        [X | Z]: same tile loop as ``hess_euclidean`` with the fixed
+        neighbor values folded into the gathers (``quadratic.egrad``) —
+        neighbor-slot contributions scatter to all-zero one-hot columns
+        and vanish, exactly the n_out=n truncation."""
+        s = Z.shape[-1]
+
+        def tile(ti, acc):
+            ii = idx_i_ref[ti]
+            jj = idx_j_ref[ti]
+            sel_i, sel_j = local_sel(ti)
+            seln_i = onehot(ii, s, n)
+            seln_j = onehot(jj, s, n)
+            R = rows(rot_ref[ti])
+            t = rows(trn_ref[ti])
+            wk = wk_ref[ti][0]
+            wt = wt_ref[ti][0]
+            Vi = rows(gather(X, sel_i) + gather(Z, seln_i))
+            Vj = rows(gather(X, sel_j) + gather(Z, seln_j))
+            rR, rt = edge_residuals(Vi, Vj, R, t)
+            gi, gj = edge_grad_rows(rR, rt, R, t, wk, wt)
             return acc + scatter(stack(gi), sel_i) + scatter(stack(gj), sel_j)
 
         return tile_loop(tile, jnp.zeros((rk, n), f32))
@@ -230,6 +267,28 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                     Xr[q(a, b)] * sym[b][c] for b in range(d))
             out[q(a, d)] = Wr[q(a, d)]
         return stack(out)
+
+    g_k = gn0_k = None
+    if S is None:
+        # Fused mode: gradient, curvature term, Riemannian gradient and its
+        # norm from one in-VMEM tile sweep (replaces the per-round XLA
+        # egrad_ell + rgrad + S pre-pass of ``rbcd._agent_update``).
+        G = grad_euclidean()
+        Gr = rows(G)
+        M = [[sum(Xr[q(a, b)] * Gr[q(a, c)] for a in range(r))
+              for c in range(d)] for b in range(d)]
+        Ssym = [[0.5 * (M[b][c] + M[c][b]) for c in range(d)]
+                for b in range(d)]
+        S = stack([Ssym[b][c] for b in range(d) for c in range(d)])
+        gl = [None] * rk
+        for a in range(r):
+            for c in range(d):
+                gl[q(a, c)] = Gr[q(a, c)] - sum(
+                    Xr[q(a, b)] * Ssym[b][c] for b in range(d))
+            gl[q(a, d)] = Gr[q(a, d)]
+        g_k = stack(gl)
+        gn0_k = jnp.sqrt(jnp.sum(g_k * g_k))
+    Sr = rows(S)
 
     def hess_riemannian(V):
         """P_X(EucHess[V] - [V_Y sym(Y^T G_Y) | 0])
@@ -398,7 +457,8 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
             out[q(a, d)] = Xr[q(a, d)] + Vr[q(a, d)]
         return stack(out)
 
-    return SimpleNamespace(tcg=tcg, inner=inner, retract=retract, cost=cost)
+    return SimpleNamespace(tcg=tcg, inner=inner, retract=retract, cost=cost,
+                           g=g_k, gn0=gn0_k)
 
 
 def _tcg_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
@@ -462,6 +522,57 @@ def _rtr_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
     x_out_ref[...] = X_out
     stats_ref[...] = jnp.stack(
         [k_att, accepted.astype(f32), f0, f_out]).reshape(1, 4)
+
+
+def _rtr_full_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
+                     x_ref, z_ref, chol_ref, x_out_ref, stats_ref, *scratch,
+                     r: int, d: int, max_iters: int, kappa: float,
+                     theta: float, initial_radius: float,
+                     max_rejections: int, grad_tol: float):
+    """Fully-fused single-step RTR: the start-point gradient, curvature
+    term, gradient norm, AND the attempt loop of ``_rtr_kernel`` in one
+    kernel — one invocation is the complete local solve of
+    ``QuadraticOptimizer::optimize`` (reference ``QuadraticOptimizer.cpp:
+    34-59``), including the below-tolerance early exit (``:65-69``)."""
+    f32 = jnp.float32
+    X = x_ref[...]
+    Z = z_ref[...]
+    m = _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
+                    X, None, chol_ref[...],
+                    r=r, d=d, max_iters=max_iters, kappa=kappa, theta=theta,
+                    hoist_scratch=scratch or None, Z=Z)
+    g = m.g
+    gn0 = m.gn0
+
+    f0 = m.cost(X, Z)
+    eps = jnp.asarray(1e-30, f32)
+
+    def attempt_body(s):
+        k_att, radius, X_best, f_best, accepted = s
+        eta, Heta, _, _ = m.tcg(g, radius)
+        X_prop = m.retract(eta)
+        f_prop = m.cost(X_prop, Z)
+        mdec = -(m.inner(g, eta) + 0.5 * m.inner(eta, Heta))
+        rho = (f0 - f_prop) / jnp.maximum(mdec, eps)
+        ok = (rho > 0.1) & (f_prop <= f0)
+        return (k_att + 1.0, jnp.where(ok, radius, radius / 4.0),
+                jnp.where(ok, X_prop, X_best),
+                jnp.where(ok, f_prop, f_best), accepted | ok)
+
+    def attempt_cond(s):
+        k_att, _, _, _, accepted = s
+        return (k_att < max_rejections) & ~accepted
+
+    below = gn0 < grad_tol  # early exit: X returned unchanged
+    init = (jnp.where(below, jnp.asarray(float(max_rejections), f32),
+                      jnp.asarray(0.0, f32)),
+            jnp.asarray(initial_radius, f32), X, f0, jnp.asarray(False))
+    k_att, _, X_out, f_out, accepted = jax.lax.while_loop(
+        attempt_cond, attempt_body, init)
+
+    x_out_ref[...] = X_out
+    stats_ref[...] = jnp.stack(
+        [k_att, accepted.astype(f32), f0, f_out, gn0]).reshape(1, 5)
 
 
 def _rtr_refine_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref,
@@ -602,6 +713,45 @@ def rtr_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Sc, Lc,
         scratch_shapes=scratch,
         interpret=interpret,
     )(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Sc, Lc, gc)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "r", "d", "max_iters", "kappa", "theta", "initial_radius",
+    "max_rejections", "grad_tol", "interpret", "hoist"))
+def rtr_full_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Lc,
+                  *, r: int, d: int, max_iters: int, kappa: float,
+                  theta: float, initial_radius: float, max_rejections: int,
+                  grad_tol: float = 0.0, interpret: bool = False,
+                  hoist: bool | None = None):
+    """Invoke the fully-fused single-step RTR kernel for one agent: only
+    the pose buffer halves [Xc | Zc], the preconditioner factors and the
+    edge tiles go in — gradient, curvature and norm are computed in-kernel.
+
+    Returns (X_out_c [rk, n],
+             stats [1, 5] = (attempts, accepted, f0, f, gn0)).
+    """
+    rk, n = Xc.shape
+    kern = functools.partial(_rtr_full_kernel, r=r, d=d,
+                             max_iters=max_iters, kappa=kappa, theta=theta,
+                             initial_radius=initial_radius,
+                             max_rejections=max_rejections,
+                             grad_tol=grad_tol)
+    vspec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    nt, T = idx_i.shape[0], idx_i.shape[-1]
+    if hoist is None:
+        hoist = should_hoist(nt, T, n)
+    scratch = [pltpu.VMEM((nt, n, T), jnp.float32)] * 2 if hoist else []
+    return pl.pallas_call(
+        kern,
+        out_shape=(
+            jax.ShapeDtypeStruct((rk, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, 5), jnp.float32),
+        ),
+        in_specs=[vspec] * 9,
+        out_specs=(vspec, vspec),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Lc)
 
 
 @functools.partial(jax.jit, static_argnames=(

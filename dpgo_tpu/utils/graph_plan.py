@@ -238,3 +238,35 @@ def plan_topology(r1, p1, r2, p2, num_robots: int, n_max: int,
     if _graph_lib() is not None:
         return plan_native(r1, p1, r2, p2, num_robots, n_max)
     return plan_python(r1, p1, r2, p2, num_robots, n_max)
+
+
+def color_agents(nbr_robot: np.ndarray, nbr_mask: np.ndarray,
+                 num_robots: int) -> tuple[np.ndarray, int]:
+    """Greedy (largest-degree-first) coloring of the agent-adjacency graph.
+
+    Agents are adjacent when they share an inter-robot measurement (the
+    planner's neighbor-slot tables already encode exactly this).  Returns
+    ``(color [A] int32, num_colors)``: same-colored agents have no shared
+    edge, so updating a whole color class simultaneously is the
+    parallelism the RBCD convergence theory actually licenses (blocks of
+    non-adjacent agents have independent local subproblems) — the
+    ``Schedule.COLORED`` multi-color Gauss-Seidel sweep.
+    """
+    adj = [set() for _ in range(num_robots)]
+    nr = np.asarray(nbr_robot)
+    nm = np.asarray(nbr_mask) > 0
+    for a in range(num_robots):
+        for b in np.unique(nr[a][nm[a]]):
+            b = int(b)
+            if b != a:
+                adj[a].add(b)
+                adj[b].add(a)
+    order = sorted(range(num_robots), key=lambda a: -len(adj[a]))
+    color = np.full(num_robots, -1, np.int32)
+    for a in order:
+        used = {color[b] for b in adj[a] if color[b] >= 0}
+        c = 0
+        while c in used:
+            c += 1
+        color[a] = c
+    return color, int(color.max()) + 1 if num_robots else 1

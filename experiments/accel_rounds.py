@@ -1,0 +1,54 @@
+"""Rounds-to-gap on sphere2500/8 r=5: acceleration on vs off (CPU f64 —
+round counts are backend-independent; wall-clock is measured on TPU later).
+"""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+from dpgo_tpu.config import AgentParams, SolverParams
+from dpgo_tpu.models import rbcd
+from dpgo_tpu.ops import quadratic
+from dpgo_tpu.types import edge_set_from_measurements
+from dpgo_tpu.utils.g2o import read_g2o
+from dpgo_tpu.utils.partition import partition_contiguous
+
+F_OPT = 843.5029071  # certified f* (bench_convergence cache)
+meas = read_g2o("/root/reference/data/sphere2500.g2o")
+part = partition_contiguous(meas, 8)
+edges_g = edge_set_from_measurements(part.meas_global, dtype=jnp.float64)
+n_total = part.meas_global.num_poses
+
+for accel, ri in [(False, 30), (True, 30), (True, 60), (True, 100)]:
+    params = AgentParams(d=3, r=5, num_robots=8, rel_change_tol=0.0,
+                         acceleration=accel, restart_interval=ri,
+                         solver=SolverParams(grad_norm_tol=1e-9,
+                                             max_inner_iters=10))
+    graph, meta = rbcd.build_graph(part, 5, jnp.float64)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float64)
+    state = rbcd.init_state(graph, meta, X0, params=params)
+
+    @jax.jit
+    def cost_of(s):
+        return quadratic.cost(rbcd.gather_to_global(s.X, graph, n_total),
+                              edges_g)
+
+    ladder = [1e-3, 1e-4, 1e-5, 1e-6]
+    crossed = {}
+    it = 0
+    while it < 800 and len(crossed) < len(ladder):
+        # step 5 rounds, honoring restart flags
+        for _ in range(5):
+            restart = accel and (it + 1) % ri == 0
+            state = rbcd.rbcd_step(state, graph, meta, params,
+                                   update_weights=False, restart=restart)
+            it += 1
+        f = float(cost_of(state))
+        for g in ladder:
+            if g not in crossed and f <= F_OPT * (1 + g):
+                crossed[g] = it
+    print(f"accel={accel} restart={ri}: " +
+          ", ".join(f"{g:.0e}@{crossed.get(g, '>800')}" for g in ladder),
+          flush=True)

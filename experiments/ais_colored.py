@@ -1,0 +1,41 @@
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+from dpgo_tpu.config import AgentParams, Schedule, SolverParams
+from dpgo_tpu.models import rbcd
+from dpgo_tpu.ops import quadratic
+from dpgo_tpu.types import edge_set_from_measurements
+from dpgo_tpu.utils.g2o import read_g2o
+from dpgo_tpu.utils.partition import partition_contiguous
+
+meas = read_g2o("/root/reference/data/ais2klinik.g2o")
+A = 32
+part = partition_contiguous(meas, A)
+edges_g = edge_set_from_measurements(part.meas_global, dtype=jnp.float64)
+n = meas.num_poses
+for sched in (Schedule.JACOBI, Schedule.COLORED):
+    params = AgentParams(d=2, r=3, num_robots=A, schedule=sched,
+                         rel_change_tol=0.0,
+                         solver=SolverParams(grad_norm_tol=1e-12,
+                                             max_inner_iters=10))
+    graph, meta = rbcd.build_graph(part, 3, jnp.float64)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float64)
+    state = rbcd.init_state(graph, meta, X0, params=params)
+    costs = []
+    t0 = time.time()
+    rounds = 40 if sched == Schedule.JACOBI else 40 * meta.num_colors
+    for it in range(rounds):
+        state = rbcd.rbcd_step(state, graph, meta, params)
+        if (it + 1) % (1 if sched == Schedule.JACOBI else meta.num_colors) == 0:
+            f = float(quadratic.cost(
+                rbcd.gather_to_global(state.X, graph, n), edges_g))
+            costs.append(f)
+    inc = sum(1 for a, b in zip(costs, costs[1:]) if b > a + 1e-9)
+    print(f"{sched.value}: C={meta.num_colors} rounds={rounds} "
+          f"f0={costs[0]:.0f} f_end={costs[-1]:.0f} increases={inc} "
+          f"({time.time()-t0:.0f}s)  first5={[round(c) for c in costs[:5]]}",
+          flush=True)

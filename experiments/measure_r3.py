@@ -1,0 +1,224 @@
+"""Round-3 perf calibration: measure today's tunnel throughput on every
+benchmark config plus an ablation breakdown of one fused sphere2500 round.
+
+Usage: python experiments/measure_r3.py [sphere kitti city 100k ablate] ...
+(one process — the tunneled TPU has a single grant).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DATA = "/root/reference/data"
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build(meas, A, r, dtype, schedule=None):
+    import jax.numpy as jnp
+    from dpgo_tpu.config import AgentParams, Schedule
+    from dpgo_tpu.models import rbcd
+    from dpgo_tpu.utils.partition import partition_contiguous
+
+    kw = {}
+    if schedule is not None:
+        kw["schedule"] = Schedule[schedule]
+    params = AgentParams(d=meas.d, r=r, num_robots=A, **kw)
+    part = partition_contiguous(meas, A)
+    graph, meta = rbcd.build_graph(part, r, dtype)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, dtype)
+    state = rbcd.init_state(graph, meta, X0, params=params)
+    return state, graph, meta, params
+
+
+def time_config(name, meas, A, r, rounds, schedule=None, trials=3):
+    import jax.numpy as jnp
+    from dpgo_tpu.models import rbcd
+
+    state, graph, meta, params = build(meas, A, r, jnp.float32,
+                                       schedule=schedule)
+    form = rbcd._formulation(meta, params, graph)
+    steps = lambda s, k: rbcd.rbcd_steps(s, graph, k, meta, params)
+    t0 = time.perf_counter()
+    st = steps(state, 1)
+    _ = np.asarray(st.X)
+    log(f"[{name}] form={form} n_max={meta.n_max} e_max={meta.e_max} "
+        f"s_max={meta.s_max} compile {time.perf_counter()-t0:.1f}s")
+    _ = np.asarray(steps(st, min(20, rounds)).X)  # warm
+    rates = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        out = steps(state, rounds)
+        _ = np.asarray(out.X)
+        dt = time.perf_counter() - t0
+        rates.append(rounds / dt)
+        log(f"[{name}] {rounds / dt:.1f} rounds/s")
+    log(f"[{name}] median {np.median(rates):.1f} rounds/s")
+    return float(np.median(rates))
+
+
+def sphere():
+    from dpgo_tpu.utils.g2o import read_g2o
+    meas = read_g2o(f"{DATA}/sphere2500.g2o")
+    return time_config("sphere2500/8 r5", meas, 8, 5, 200)
+
+
+def kitti():
+    from dpgo_tpu.utils.g2o import read_g2o
+    meas = read_g2o(f"{DATA}/kitti_00.g2o")
+    return time_config("kitti00/16 r3 async", meas, 16, 3, 200,
+                       schedule="ASYNC")
+
+
+def city():
+    from dpgo_tpu.utils.g2o import read_g2o
+    meas = read_g2o(f"{DATA}/city10000.g2o")
+    return time_config("city10000/32 r3", meas, 32, 3, 100)
+
+
+def synth100k():
+    from dpgo_tpu.utils.synthetic import make_measurements
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+    meas, _ = make_measurements(rng, n=100000, d=3, num_lc=20000,
+                                rot_noise=0.01, trans_noise=0.01)
+    log(f"[100k] synthesized in {time.perf_counter()-t0:.1f}s")
+    return time_config("100k/64 r5", meas, 64, 5, 20, trials=3)
+
+
+def ablate():
+    """Break one sphere2500 fused round into pieces: exchange+gradient ELL
+    pass vs the RTR kernel, plus kernel tCG stats."""
+    import jax
+    import jax.numpy as jnp
+    from dpgo_tpu.models import rbcd
+    from dpgo_tpu.ops import manifold, quadratic
+    from dpgo_tpu.ops import pallas_tcg as ptcg
+    from dpgo_tpu.utils.g2o import read_g2o
+
+    meas = read_g2o(f"{DATA}/sphere2500.g2o")
+    state, graph, meta, params = build(meas, 8, 5, jnp.float32)
+    d, r = meta.d, meta.rank
+    k = d + 1
+
+    def grad_part(X):
+        """Everything _rbcd_round does before the kernel: exchange + ELL
+        gradient + S + chol transforms."""
+        Z = rbcd.neighbor_buffer(rbcd.public_table(X, graph), graph)
+
+        def one(x, z, e, s, m):
+            buf = jnp.concatenate([x, z], axis=0)
+            eg = quadratic.egrad_ell(buf, e, s, m)
+            g = manifold.rgrad(x, eg)
+            gn0 = manifold.norm(g)
+            Y, GY = x[..., :d], eg[..., :d]
+            M = jnp.einsum("nab,nac->nbc", Y, GY)
+            S = 0.5 * (M + jnp.swapaxes(M, -1, -2))
+            return g, gn0, S
+
+        return jax.vmap(one)(X, Z, graph.edges, graph.inc_slot,
+                             graph.inc_mask)
+
+    @jax.jit
+    def grad_rounds(X, n):
+        def body(_, x):
+            g, gn0, S = grad_part(x)
+            return x + 0.0 * g  # keep the dependency
+        return jax.lax.fori_loop(0, n, body, X)
+
+    w = graph.edges.mask * graph.edges.weight
+    nt, T = graph.eidx_i.shape[1], graph.eidx_i.shape[-1]
+    wk = jax.vmap(lambda ww: ptcg.edge_tiles(ww, nt, T))(
+        (w * graph.edges.kappa).astype(jnp.float32))
+    wt = jax.vmap(lambda ww: ptcg.edge_tiles(ww, nt, T))(
+        (w * graph.edges.tau).astype(jnp.float32))
+
+    @jax.jit
+    def kernel_rounds(X, n):
+        g, gn0, S = grad_part(X)
+        Z = rbcd.neighbor_buffer(rbcd.public_table(X, graph), graph)
+        chol = state.chol
+        Xc = jax.vmap(ptcg.comp_major)(X)
+        Zc = jax.vmap(ptcg.comp_major)(Z)
+        gc = jax.vmap(ptcg.comp_major)(g)
+        Sc = jax.vmap(lambda s: s.transpose(1, 2, 0).reshape(d * d, -1))(S)
+        Lc = jax.vmap(lambda c: c.transpose(1, 2, 0).reshape(k * k, -1))(chol)
+
+        def body(_, xc):
+            out, stats = jax.vmap(
+                lambda ii, ij, rc, tc, wk1, wt1, xc1, zc1, sc1, lc1, gc1:
+                ptcg.rtr_call(
+                    ii, ij, rc, tc, wk1, wt1, xc1, zc1, sc1, lc1, gc1,
+                    r=r, d=d, max_iters=params.solver.max_inner_iters,
+                    kappa=params.solver.tcg_kappa,
+                    theta=params.solver.tcg_theta,
+                    initial_radius=params.solver.initial_radius,
+                    max_rejections=params.solver.max_rejections))(
+                graph.eidx_i, graph.eidx_j, graph.rot_t, graph.trn_t,
+                wk, wt, xc, Zc, Sc, Lc, gc)
+            return out
+        return jax.lax.fori_loop(0, n, body, Xc), None
+
+    N = 200
+    # full round reference
+    steps = lambda s, n: rbcd.rbcd_steps(s, graph, n, meta, params)
+    _ = np.asarray(steps(state, 1).X)
+    _ = np.asarray(steps(state, 50).X)
+    t0 = time.perf_counter()
+    _ = np.asarray(steps(state, N).X)
+    t_full = time.perf_counter() - t0
+    log(f"[ablate] full round: {t_full/N*1e3:.3f} ms/round "
+        f"({N/t_full:.0f} r/s)")
+
+    X = state.X
+    _ = np.asarray(grad_rounds(X, 1))
+    t0 = time.perf_counter()
+    _ = np.asarray(grad_rounds(X, N))
+    t_grad = time.perf_counter() - t0
+    log(f"[ablate] exchange+grad only: {t_grad/N*1e3:.3f} ms/round")
+
+    out, _ = kernel_rounds(X, 1)
+    _ = np.asarray(out)
+    t0 = time.perf_counter()
+    out, _ = kernel_rounds(X, N)
+    _ = np.asarray(out)
+    t_kern = time.perf_counter() - t0
+    log(f"[ablate] grad+kernel (no schedule/status): "
+        f"{t_kern/N*1e3:.3f} ms/round")
+
+    # kernel stats from one un-fused call
+    g, gn0, S = grad_part(X)
+    Z = rbcd.neighbor_buffer(rbcd.public_table(X, graph), graph)
+    Xc = jax.vmap(ptcg.comp_major)(X)
+    Zc = jax.vmap(ptcg.comp_major)(Z)
+    gc = jax.vmap(ptcg.comp_major)(g)
+    Sc = jax.vmap(lambda s: s.transpose(1, 2, 0).reshape(d * d, -1))(S)
+    Lc = jax.vmap(lambda c: c.transpose(1, 2, 0).reshape(k * k, -1))(
+        state.chol)
+    _, stats = jax.vmap(
+        lambda ii, ij, rc, tc, wk1, wt1, xc1, zc1, sc1, lc1, gc1:
+        ptcg.rtr_call(
+            ii, ij, rc, tc, wk1, wt1, xc1, zc1, sc1, lc1, gc1,
+            r=r, d=d, max_iters=params.solver.max_inner_iters,
+            kappa=params.solver.tcg_kappa, theta=params.solver.tcg_theta,
+            initial_radius=params.solver.initial_radius,
+            max_rejections=params.solver.max_rejections))(
+        graph.eidx_i, graph.eidx_j, graph.rot_t, graph.trn_t,
+        wk, wt, Xc, Zc, Sc, Lc, gc)
+    log(f"[ablate] kernel stats per agent (attempts, accepted, f0, f): "
+        f"{np.asarray(stats).squeeze()}")
+
+
+if __name__ == "__main__":
+    which = sys.argv[1:] or ["sphere", "ablate"]
+    for w in which:
+        {"sphere": sphere, "kitti": kitti, "city": city,
+         "100k": synth100k, "ablate": ablate}[w]()

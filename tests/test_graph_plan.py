@@ -76,3 +76,41 @@ def test_build_graph_planner_backends_agree(rng):
     assert m1 == m2
     for t1, t2 in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
         assert np.array_equal(np.asarray(t1), np.asarray(t2))
+
+
+def test_color_agents_valid_coloring():
+    """Greedy coloring: adjacent agents never share a color; chain
+    partitions 2-color; colors are compact [0, C)."""
+    import numpy as np
+    from dpgo_tpu.utils.graph_plan import color_agents
+
+    # Chain adjacency: robot a neighbors a-1 and a+1 (contiguous-partition
+    # odometry crossings) -> 2 colors.
+    A, S = 6, 4
+    nbr_robot = np.zeros((A, S), np.int32)
+    nbr_mask = np.zeros((A, S))
+    for a in range(A):
+        s = 0
+        for b in (a - 1, a + 1):
+            if 0 <= b < A:
+                nbr_robot[a, s] = b
+                nbr_mask[a, s] = 1.0
+                s += 1
+    color, C = color_agents(nbr_robot, nbr_mask, A)
+    assert C == 2
+    for a in range(A):
+        for sth in range(S):
+            if nbr_mask[a, sth] > 0:
+                assert color[a] != color[nbr_robot[a, sth]]
+    assert set(color) == set(range(C))
+
+
+def test_color_agents_triangle():
+    import numpy as np
+    from dpgo_tpu.utils.graph_plan import color_agents
+
+    nbr_robot = np.array([[1, 2], [0, 2], [0, 1]], np.int32)
+    nbr_mask = np.ones((3, 2))
+    color, C = color_agents(nbr_robot, nbr_mask, 3)
+    assert C == 3
+    assert sorted(color) == [0, 1, 2]

@@ -329,3 +329,84 @@ def test_egrad_ell_matches_scatter(rng):
         h_ell = quadratic.hessvec_ell(V, e, graph.inc_slot[a],
                                       graph.inc_mask[a], n_buf=n_buf)
         assert np.allclose(h_ell, h_ref, atol=1e-12), f"agent {a} hessvec"
+
+
+def test_colored_schedule_converges_and_matches_structure(rng):
+    """Schedule.COLORED: one color class fires per round (non-adjacent
+    agents only), the sweep cycles deterministically, and the solve reaches
+    the same optimum as JACOBI on a well-behaved graph."""
+    from dpgo_tpu.config import Schedule
+
+    meas, _ = make_measurements(rng, n=24, d=3, num_lc=10,
+                                rot_noise=0.01, trans_noise=0.01)
+    part = partition_contiguous(meas, 4)
+    graph, meta = rbcd.build_graph(part, 5, jnp.float64)
+    assert meta.num_colors >= 2  # contiguous partitions couple neighbors
+    color = np.asarray(graph.color)
+    # valid coloring vs the neighbor tables
+    nr, nm = np.asarray(graph.nbr_robot), np.asarray(graph.nbr_mask) > 0
+    for a in range(4):
+        for b in nr[a][nm[a]]:
+            assert color[a] != color[b]
+
+    params = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.COLORED,
+                         rel_change_tol=0.0)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float64)
+    state = rbcd.init_state(graph, meta, X0, params=params)
+    prev = state.X
+    # Round k must change only poses of color (k mod C).
+    for k in range(meta.num_colors):
+        state = rbcd.rbcd_step(state, graph, meta, params)
+        changed = np.asarray(jnp.any(state.X != prev, axis=(1, 2, 3)))
+        assert not np.any(changed & (color != k % meta.num_colors))
+        prev = state.X
+    # And the full solve converges like JACOBI does.
+    res = rbcd.solve_rbcd(meas, 4, params=params, max_iters=120,
+                          grad_norm_tol=0.05, eval_every=meta.num_colors,
+                          dtype=jnp.float64)
+    assert res.grad_norm_history[-1] < 0.05
+
+
+def test_colored_fixes_jacobi_oscillation_ais2klinik(data_dir):
+    """The VERDICT r2 finding: JACOBI (simultaneous updates of adjacent
+    blocks) oscillates on ais2klinik even in plain L2, while the colored
+    Gauss-Seidel sweep — the parallelism the RBCD theory actually licenses
+    — descends monotonically and ends far below Jacobi's oscillation band.
+    """
+    import os
+    from dpgo_tpu.config import Schedule
+    from dpgo_tpu.ops import quadratic
+    from dpgo_tpu.types import edge_set_from_measurements
+    from dpgo_tpu.utils.g2o import read_g2o
+
+    path = os.path.join(data_dir, "ais2klinik.g2o")
+    if not os.path.exists(path):
+        pytest.skip("dataset not available")
+    meas = read_g2o(path)
+    A = 32
+    part = partition_contiguous(meas, A)
+    edges_g = edge_set_from_measurements(part.meas_global,
+                                         dtype=jnp.float64)
+    n = meas.num_poses
+
+    def costs_for(sched, sweeps):
+        params = AgentParams(d=2, r=3, num_robots=A, schedule=sched,
+                             rel_change_tol=0.0)
+        graph, meta = rbcd.build_graph(part, 3, jnp.float64)
+        X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float64)
+        state = rbcd.init_state(graph, meta, X0, params=params)
+        per = 1 if sched == Schedule.JACOBI else meta.num_colors
+        out = []
+        for _ in range(sweeps):
+            state = rbcd.rbcd_steps(state, graph, per, meta, params)
+            out.append(float(quadratic.cost(
+                rbcd.gather_to_global(state.X, graph, n), edges_g)))
+        return out
+
+    cj = costs_for(Schedule.JACOBI, 25)
+    cc = costs_for(Schedule.COLORED, 25)
+    inc_j = sum(1 for a, b in zip(cj, cj[1:]) if b > a + 1e-9)
+    inc_c = sum(1 for a, b in zip(cc, cc[1:]) if b > a + 1e-9)
+    assert inc_j >= 5          # Jacobi genuinely oscillates here
+    assert inc_c == 0          # the colored sweep is monotone
+    assert cc[-1] < 0.5 * cj[-1]  # and ends far below the oscillation band

@@ -172,13 +172,17 @@ def test_solve_refine_beats_f32_floor(rng):
     # hist[0] is the cost at the (projected) f32 floor; every cycle must
     # strictly descend and the total descent must be visible (the floor
     # point is stationary only for f32 arithmetic).
-    f_before = (1.0 + hist[0])  # hist entries are f/f_opt - 1 with f_opt=1
+    f_before = (1.0 + hist[0][0])  # entries are (f/f_opt - 1, elapsed_s)
     f_after = refine.global_cost(X64, edges_g)
     assert f_after < f_before
     drop = f_before - f_after
     assert drop > 1e-9 * f_before
-    # monotone across recenters
-    assert all(b <= a + 1e-15 for a, b in zip(hist, hist[1:]))
+    # descent across recenters: every VERIFIED entry improves on the
+    # start (the final accelerated segment may overshoot slightly, which
+    # solve_refine absorbs by returning the best point)
+    gaps = [h[0] for h in hist]
+    assert min(gaps) < gaps[0]
+    assert gap <= min(gaps) + 1e-15
     # the refined point is on the manifold to f64 tightness
     YY = X64[..., :meta.d]
     gram = np.swapaxes(YY, -1, -2) @ YY
@@ -211,5 +215,7 @@ def test_solve_refine_uses_given_weights(rng):
         Xg, graph, meta, params, edges_w, f_opt=1.0, rel_gap=-1.0,
         rounds_per_cycle=30, max_cycles=2, weights=wA)
     assert refine.global_cost(X64, edges_w) < f_w
-    # monotone in the WEIGHTED objective across recenters
-    assert all(b <= a + 1e-15 for a, b in zip(hist, hist[1:]))
+    # the returned point carries the best verified WEIGHTED gap (the final
+    # accelerated segment may overshoot; solve_refine returns the best)
+    gaps = [h[0] for h in hist]
+    assert gap <= min(gaps) + 1e-15

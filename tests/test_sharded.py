@@ -30,6 +30,8 @@ def _setup(meas, num_robots, params, dtype=jnp.float64):
     (8, Schedule.GREEDY),
     (4, Schedule.JACOBI),   # 2 agents per device
     (8, Schedule.ASYNC),
+    (8, Schedule.COLORED),
+    (4, Schedule.COLORED),
 ])
 def test_sharded_matches_single_device(rng, n_dev, schedule):
     """The sharded round body is the same math as the single-device one, so
