@@ -99,6 +99,46 @@ def time_rounds(device, dtype, rounds):
     return float(np.median(rates))
 
 
+def kernel_parity_check(device) -> float:
+    """On-device Pallas-vs-XLA drift guard (VERDICT r2 item 5): run ONE
+    full RBCD round through the compiled Mosaic kernel and through the ELL
+    formulation ON THE BENCH DEVICE and return the max-abs iterate
+    difference.  The kernels are parity-tested in interpreter mode on CPU
+    (tests/test_pallas_tcg.py); this closes the remaining hole — a Mosaic
+    compile difference would otherwise surface only as silent perf or
+    accuracy drift.  Caller asserts the bound and records the number."""
+    import dataclasses
+
+    import jax
+    from dpgo_tpu.models import rbcd
+
+    state, graph, meta, params = build(jnp_f32())
+    state = jax.device_put(state, device)
+    graph = jax.device_put(graph, device)
+    params_ell = dataclasses.replace(
+        params, solver=dataclasses.replace(params.solver, pallas_tcg=False))
+    s_kernel = rbcd.rbcd_step(state, graph, meta, params,
+                              update_weights=False, restart=False)
+    s_ell = rbcd.rbcd_step(state, graph, meta, params_ell,
+                           update_weights=False, restart=False)
+    dx = np.abs(np.asarray(s_kernel.X) - np.asarray(s_ell.X)).max()
+    dg = np.abs(np.asarray(s_kernel.rel_change)
+                - np.asarray(s_ell.rel_change)).max()
+    return float(max(dx, dg))
+
+
+def jnp_f32():
+    import jax.numpy as jnp
+    return jnp.float32
+
+
+#: On-device kernel-vs-XLA bound for one RBCD round: both paths run the
+#: same f32 math, so the difference is reduction order + the kernel's
+#: Newton-Schulz (vs SVD) retraction — observed ~1e-6..1e-5 scale; 5e-4
+#: flags a genuine Mosaic lowering change without tripping on noise.
+KERNEL_PARITY_BOUND = 5e-4
+
+
 def cpu_baseline_subprocess() -> float:
     """Measure the f64 CPU baseline in a clean subprocess (x64 must be on
     for a true double-precision run, but enabling it in the TPU process
@@ -140,6 +180,18 @@ def main():
         # TPU tunnel in this process; enabling x64 under the tunnel is what
         # breaks its compiler).
         jax.config.update("jax_enable_x64", True)
+
+    parity = None
+    if dev.platform != "cpu":
+        # Drift guard BEFORE timing: the compiled Mosaic kernel must match
+        # the XLA formulation on this device.
+        parity = kernel_parity_check(dev)
+        log(f"  on-device kernel-vs-XLA parity: max-abs-diff {parity:.2e} "
+            f"(bound {KERNEL_PARITY_BOUND:.0e})")
+        assert parity < KERNEL_PARITY_BOUND, (
+            f"Mosaic kernel drifted from the XLA formulation: "
+            f"{parity:.3e} >= {KERNEL_PARITY_BOUND}")
+
     ips = time_rounds(dev, getattr(jnp, bench_dtype), ROUNDS)
     log(f"  {ips:.2f} RBCD rounds/s ({bench_dtype})")
 
@@ -148,12 +200,15 @@ def main():
     else:
         cpu_ips = cpu_baseline_subprocess()
 
-    print(json.dumps({
+    out = {
         "metric": "rbcd_rounds_per_sec_sphere2500_8agents_r5",
         "value": round(ips, 3),
         "unit": "rounds/s",
         "vs_baseline": round(ips / cpu_ips, 3),
-    }))
+    }
+    if parity is not None:
+        out["kernel_parity_max_abs_diff"] = parity
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -110,6 +110,13 @@ class SolverParams:
     # and the graph carries the kernel's selection matrices; True forces it
     # (interpreter mode off-TPU — slow, for testing); False disables.
     pallas_tcg: bool | None = None
+    # Run the kernel's one-hot gather/scatter matmuls as two bf16 passes
+    # (hi/lo split of the gathered vectors; the 0/1 selection matrices are
+    # bf16-exact) instead of f32 — ~2x on the MXU-bound large-problem
+    # shapes, at ~2^-16 relative hessvec/cost error.  Opt-in: appropriate
+    # when running the reference's loose per-step budget (tol 1e-2); keep
+    # off for certified-gap pipelines (the refine kernel never uses it).
+    pallas_bf16_select: bool = False
     # Materialize each agent's buffer connection Laplacian and run
     # cost/gradient/Hessian as dense matmuls (``quadratic.dense_q``).
     # Opt-in: the dense products are HBM-bandwidth-bound reading the

@@ -596,7 +596,8 @@ def _agent_update(X_local, z, edges, params: AgentParams, chol=None, inc=None,
             initial_radius=params.solver.initial_radius,
             max_rejections=params.solver.max_rejections,
             grad_tol=params.solver.grad_norm_tol,
-            interpret=interpret)
+            interpret=interpret,
+            bf16_select=params.solver.pallas_bf16_select)
         X_new = ptcg.comp_minor(X_out_c, r, k).astype(X_local.dtype)
         gn0 = stats[0, 4].astype(X_local.dtype)
         return X_new, gn0

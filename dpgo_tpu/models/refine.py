@@ -61,13 +61,17 @@ class RefineConstants(NamedTuple):
     S0: jax.Array      # [A, n, d, d] sym(R_Y^T G_Y(R))
     chol: jax.Array    # [A, n, k, k] block-Jacobi factors
     # Kernel-mode extras (None when the graph has no edge tiles): reference
-    # residuals + point in the tile-major / component-major layouts of
-    # ``ops.pallas_tcg.rtr_refine_call``.
+    # residuals + point + gradient constants in the tile-major /
+    # component-major layouts of ``ops.pallas_tcg.rtr_refine_full_call``.
     rho_rot_t: jax.Array | None = None  # [A, nt, r*d, T]
     rho_trn_t: jax.Array | None = None  # [A, nt, r, T]
     Rc: jax.Array | None = None         # [A, r*k, n]
     wk_t: jax.Array | None = None       # [A, nt, 1, T]
     wt_t: jax.Array | None = None       # [A, nt, 1, T]
+    g0_c: jax.Array | None = None       # [A, r*k, n]
+    Gref_c: jax.Array | None = None     # [A, r*k, n]
+    S0_c: jax.Array | None = None       # [A, d*d, n]
+    Lc: jax.Array | None = None         # [A, k*k, n] preconditioner factors
 
 
 class RefineRef(NamedTuple):
@@ -225,14 +229,25 @@ def recenter(Xg64: np.ndarray, graph, meta, params: AgentParams,
             p = np.pad(vals, ((0, 0), (0, pad)))
             return p.reshape(A, nt, 1, T)
 
+        def cm(arr):  # [A, n, r, k] -> [A, r*k, n] component-major
+            return jnp.asarray(
+                arr.transpose(0, 2, 3, 1).reshape(A, -1, meta.n_max),
+                jnp.float32)
+
         pallas_fields = dict(
             rho_rot_t=jnp.asarray(tile_cm(rrR, r * d), jnp.float32),
             rho_trn_t=jnp.asarray(tile_cm(rrt, r), jnp.float32),
-            Rc=jnp.asarray(
-                R_loc.transpose(0, 2, 3, 1).reshape(A, -1, meta.n_max),
-                jnp.float32),
+            Rc=cm(R_loc),
             wk_t=jnp.asarray(wtile(w * edges_np["kappa"]), jnp.float32),
             wt_t=jnp.asarray(wtile(w * edges_np["tau"]), jnp.float32),
+            g0_c=cm(g0),
+            Gref_c=cm(G_ref),
+            S0_c=jnp.asarray(
+                S0.transpose(0, 2, 3, 1).reshape(A, d * d, meta.n_max),
+                jnp.float32),
+            Lc=jnp.transpose(jnp.asarray(chol, jnp.float32),
+                             (0, 2, 3, 1)).reshape(
+                A, (d + 1) * (d + 1), meta.n_max),
         )
 
     consts = RefineConstants(
@@ -323,9 +338,10 @@ def _agent_refine(D, Dz, consts_a, edges, inc, params: AgentParams,
     Mirrors ``rbcd._agent_update``'s RTR semantics (tCG, retraction,
     acceptance rho > 0.1 with non-increase, radius /= 4 on rejection,
     ``QuadraticOptimizer.cpp:92-110``) on the correction variable D.
-    With ``eidx = (eidx_i, eidx_j, rot_t, trn_t)`` the solve runs in the
-    re-centered VMEM kernel (``pallas_tcg.rtr_refine_call``); the
-    re-centered gradient is computed out here either way.
+    With ``eidx = (eidx_i, eidx_j, rot_t, trn_t)`` the ENTIRE solve —
+    recentered gradient included — runs in the fused VMEM kernel
+    (``pallas_tcg.rtr_refine_full_call``); the XLA path below computes
+    the gradient out here and is the off-TPU/test formulation.
     """
     consts_a = RefineConstants(*consts_a)
     R, Rz, G_ref, g0, S0, chol = consts_a[:6]
@@ -336,6 +352,25 @@ def _agent_refine(D, Dz, consts_a, edges, inc, params: AgentParams,
     r = R.shape[-2]
     k = d + 1
     sp = params.solver
+
+    if eidx is not None:
+        # Fully-fused kernel path: the recentered gradient, curvature
+        # corrections, adaptive radius, and the attempt loop all run in
+        # VMEM (``pallas_tcg.rtr_refine_full_call``) — no XLA pre-pass.
+        from ..ops import pallas_tcg as ptcg
+
+        D_out_c, stats = ptcg.rtr_refine_full_call(
+            eidx[0], eidx[1], eidx[2], eidx[3],
+            consts_a.wk_t, consts_a.wt_t,
+            consts_a.rho_rot_t, consts_a.rho_trn_t,
+            consts_a.Rc,
+            ptcg.comp_major(D), ptcg.comp_major(Dz),
+            consts_a.g0_c, consts_a.Gref_c, consts_a.S0_c, consts_a.Lc,
+            r=r, d=d, max_iters=sp.max_inner_iters, kappa=sp.tcg_kappa,
+            theta=sp.tcg_theta, initial_radius=sp.initial_radius,
+            max_rejections=sp.max_rejections,
+            grad_tol=sp.grad_norm_tol, interpret=interpret)
+        return ptcg.comp_minor(D_out_c, r, k), stats[0, 4]
 
     Dbuf = jnp.concatenate([D, Dz], axis=0)
     Y = R + D
@@ -362,25 +397,6 @@ def _agent_refine(D, Dz, consts_a, edges, inc, params: AgentParams,
     pg = manifold.tangent_project(Y, quadratic.precond_apply(chol, g))
     radius0 = jnp.minimum(jnp.asarray(sp.initial_radius, g.dtype),
                           10.0 * manifold.norm(pg))
-
-    if eidx is not None:
-        from ..ops import pallas_tcg as ptcg
-
-        Sc = S.transpose(1, 2, 0).reshape(d * d, n)
-        Lc = chol.transpose(1, 2, 0).reshape(k * k, n)
-        D_out_c, _stats = ptcg.rtr_refine_call(
-            eidx[0], eidx[1], eidx[2], eidx[3],
-            consts_a.wk_t, consts_a.wt_t,
-            consts_a.rho_rot_t, consts_a.rho_trn_t,
-            consts_a.Rc,
-            ptcg.comp_major(D), ptcg.comp_major(Dz),
-            Sc, Lc, ptcg.comp_major(g), radius0.reshape(1, 1),
-            r=r, d=d, max_iters=sp.max_inner_iters, kappa=sp.tcg_kappa,
-            theta=sp.tcg_theta,
-            max_rejections=sp.max_rejections, interpret=interpret)
-        D_new = ptcg.comp_minor(D_out_c, r, k)
-        below = gn0 < sp.grad_norm_tol
-        return jnp.where(below, D, D_new), gn0
 
     rhoR, rhot = quadratic._edge_terms(jnp.concatenate([R, Rz]), edges)
 
@@ -565,7 +581,10 @@ def solve_refine(Xg64: np.ndarray, graph, meta, params: AgentParams,
         if best is None or gap_now < best[0]:
             best = (gap_now, ref.Xg)
         if ref.f_ref <= target:
-            return ref.Xg, gap_now, cyc, history
+            # best may be marginally below gap_now (safeguard tolerance
+            # band) — honor the "returns the best verified point" contract
+            # on the success path too.
+            return best[1], best[0], cyc, history
         rounds_fn = _refine_rounds_accel_jit if accel_on \
             else _refine_rounds_jit
         D = jnp.zeros(ref.consts.R.shape, jnp.float32)

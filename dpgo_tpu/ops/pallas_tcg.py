@@ -26,15 +26,19 @@ operand in VMEM:
   Newton-Schulz retraction) is the same closed-form unrolled style as
   ``ops.smallmat``.
 
-Two kernels share the math:
+The kernels share one math module (``_build_math``):
 
 * ``tcg_call`` — the truncated-CG subproblem alone (used by tests as the
   parity harness against ``ops.solver.truncated_cg``).
-* ``rtr_call`` — the full single-step RTR: the Steihaug-Toint solve plus
-  retraction, cost evaluation, acceptance test, and the
-  shrink-radius-until-accepted retry (reference
-  ``QuadraticOptimizer.cpp:92-110``), all in one kernel invocation per
-  round.
+* ``rtr_call`` — single-step RTR from a precomputed gradient: the
+  Steihaug-Toint solve plus retraction, cost evaluation, acceptance test,
+  and the shrink-radius-until-accepted retry (reference
+  ``QuadraticOptimizer.cpp:92-110``).
+* ``rtr_full_call`` — the production round: ``rtr_call`` plus the
+  start-point Euclidean/Riemannian gradient, curvature term, gradient
+  norm and below-tolerance early exit computed IN-kernel.
+* ``rtr_refine_full_call`` — the re-centered equivalent for
+  ``models.refine`` (correction variable D at a host-held f64 reference).
 
 Numerics match the XLA solver (same stopping rules, same epsilons);
 equivalence is asserted in tests/test_pallas_tcg.py, which runs the kernels
@@ -68,7 +72,7 @@ TILE = 256
 
 def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                 X, S, L, *, r, d, max_iters, kappa, theta, refine=None,
-                hoist_scratch=None, Z=None):
+                hoist_scratch=None, Z=None, bf16_select=False):
     """Closures over the per-agent VMEM refs (component-major layout).
 
     Edge data arrives as tile-major refs (see module docstring) read
@@ -104,11 +108,46 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
     def q(a, c):  # component row of pose-block entry (a, c)
         return a * k + c
 
+    bf16 = jnp.bfloat16
+    sel_t = bf16 if bf16_select else f32
+
+    def _split(V):  # f32 -> (hi, lo) bf16 pair with hi + lo ~ V (2^-16 rel)
+        hi = V.astype(bf16)
+        return hi, (V - hi.astype(f32)).astype(bf16)
+
     def gather(V, Sel):  # [rk, m] x [m, T] -> [rk, T]
+        if bf16_select:
+            # One-hots are EXACT in bf16 (entries 0/1); V splits into two
+            # bf16 passes at the MXU's native bf16 rate — 2 passes instead
+            # of the f32 emulation's 3+, with ~2^-16 relative error from
+            # the hi/lo split.  Only enabled via the static flag (large-
+            # scale configs running the reference's loose per-step budget).
+            hi, lo = _split(V)
+            # precision must be DEFAULT explicitly: with bf16 operands and
+            # no precision, Mosaic resolves contract precision to fp32 and
+            # rejects the matmul ("Bad lhs type").
+            return (jax.lax.dot_general(
+                        hi, Sel, (((1,), (0,)), ((), ())),
+                        precision=jax.lax.Precision.DEFAULT,
+                        preferred_element_type=f32)
+                    + jax.lax.dot_general(
+                        lo, Sel, (((1,), (0,)), ((), ())),
+                        precision=jax.lax.Precision.DEFAULT,
+                        preferred_element_type=f32))
         return jax.lax.dot_general(V, Sel, (((1,), (0,)), ((), ())),
                                    precision=HI, preferred_element_type=f32)
 
     def scatter(G, Sel):  # [rk, T] x [m, T] -> [rk, m]  (scatter-add)
+        if bf16_select:
+            hi, lo = _split(G)
+            return (jax.lax.dot_general(
+                        hi, Sel, (((1,), (1,)), ((), ())),
+                        precision=jax.lax.Precision.DEFAULT,
+                        preferred_element_type=f32)
+                    + jax.lax.dot_general(
+                        lo, Sel, (((1,), (1,)), ((), ())),
+                        precision=jax.lax.Precision.DEFAULT,
+                        preferred_element_type=f32))
         return jax.lax.dot_general(G, Sel, (((1,), (1,)), ((), ())),
                                    precision=HI, preferred_element_type=f32)
 
@@ -116,7 +155,7 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         """[m, T] one-hot of (idx - base): column e selects row idx[e]-base,
         all-zero when the shifted index falls outside [0, m)."""
         io = jax.lax.broadcasted_iota(jnp.int32, (m, T), 0)
-        return ((idx_row - base) == io).astype(f32)
+        return ((idx_row - base) == io).astype(sel_t)
 
     def rows(mat):
         return [mat[i] for i in range(mat.shape[0])]
@@ -191,13 +230,16 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
 
         return tile_loop(tile, jnp.zeros((rk, n), f32))
 
-    def grad_euclidean():
+    def grad_euclidean(Xv, Zv):
         """Euclidean gradient rows of the LOCAL poses at the buffer point
-        [X | Z]: same tile loop as ``hess_euclidean`` with the fixed
+        [Xv | Zv]: same tile loop as ``hess_euclidean`` with the fixed
         neighbor values folded into the gathers (``quadratic.egrad``) —
         neighbor-slot contributions scatter to all-zero one-hot columns
-        and vanish, exactly the n_out=n truncation."""
-        s = Z.shape[-1]
+        and vanish, exactly the n_out=n truncation.  (In refine mode this
+        is called on the correction [D | Dz]: the residual map is affine
+        with exactly this linear part, so the same loop yields the
+        increment gradient dG.)"""
+        s = Zv.shape[-1]
 
         def tile(ti, acc):
             ii = idx_i_ref[ti]
@@ -209,8 +251,8 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
             t = rows(trn_ref[ti])
             wk = wk_ref[ti][0]
             wt = wt_ref[ti][0]
-            Vi = rows(gather(X, sel_i) + gather(Z, seln_i))
-            Vj = rows(gather(X, sel_j) + gather(Z, seln_j))
+            Vi = rows(gather(Xv, sel_i) + gather(Zv, seln_i))
+            Vj = rows(gather(Xv, sel_j) + gather(Zv, seln_j))
             rR, rt = edge_residuals(Vi, Vj, R, t)
             gi, gj = edge_grad_rows(rR, rt, R, t, wk, wt)
             return acc + scatter(stack(gi), sel_i) + scatter(stack(gj), sel_j)
@@ -269,11 +311,11 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         return stack(out)
 
     g_k = gn0_k = None
-    if S is None:
+    if S is None and refine is None:
         # Fused mode: gradient, curvature term, Riemannian gradient and its
         # norm from one in-VMEM tile sweep (replaces the per-round XLA
         # egrad_ell + rgrad + S pre-pass of ``rbcd._agent_update``).
-        G = grad_euclidean()
+        G = grad_euclidean(X, Z)
         Gr = rows(G)
         M = [[sum(Xr[q(a, b)] * Gr[q(a, c)] for a in range(r))
               for c in range(d)] for b in range(d)]
@@ -286,6 +328,41 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                 gl[q(a, c)] = Gr[q(a, c)] - sum(
                     Xr[q(a, b)] * Ssym[b][c] for b in range(d))
             gl[q(a, d)] = Gr[q(a, d)]
+        g_k = stack(gl)
+        gn0_k = jnp.sqrt(jnp.sum(g_k * g_k))
+    elif S is None:
+        # Fused RE-CENTERED mode (``models.refine._agent_refine`` math,
+        # in-kernel): refine = (rho_rot, rho_trn, Rc, D, Dz, g0, Gref, S0)
+        # with the last four the extra per-recenter constants.
+        #   dG = increment gradient at [D | Dz]
+        #   S1 = sym(D_Y^T Gref_Y + Y_Y^T dG_Y),  S = S0 + S1
+        #   g  = g0 + dG;  g_Y -= R S1 + D (S0 + S1)
+        Dst, Dz_k, g0_k, Gref_k, S0_k = (refine[3], refine[4], refine[5],
+                                         refine[6], refine[7])
+        Rc_k = refine[2]
+        dG = grad_euclidean(Dst, Dz_k)
+        dGr = rows(dG)
+        Dr = rows(Dst)
+        Grefr = rows(Gref_k)
+        S0r = rows(S0_k)
+        # Y = X here (the caller passes Y = Rc + D as the expansion point).
+        M1 = [[sum(Dr[q(a, b)] * Grefr[q(a, c)]
+                   + Xr[q(a, b)] * dGr[q(a, c)] for a in range(r))
+               for c in range(d)] for b in range(d)]
+        S1 = [[0.5 * (M1[b][c] + M1[c][b]) for c in range(d)]
+              for b in range(d)]
+        Stot = [[S0r[b * d + c] + S1[b][c] for c in range(d)]
+                for b in range(d)]
+        S = stack([Stot[b][c] for b in range(d) for c in range(d)])
+        Rr_k = rows(Rc_k)
+        g0r = rows(g0_k)
+        gl = [None] * rk
+        for a in range(r):
+            for c in range(d):
+                gl[q(a, c)] = g0r[q(a, c)] + dGr[q(a, c)] - sum(
+                    Rr_k[q(a, b)] * S1[b][c]
+                    + Dr[q(a, b)] * Stot[b][c] for b in range(d))
+            gl[q(a, d)] = g0r[q(a, d)] + dGr[q(a, d)]
         g_k = stack(gl)
         gn0_k = jnp.sqrt(jnp.sum(g_k * g_k))
     Sr = rows(S)
@@ -458,7 +535,7 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         return stack(out)
 
     return SimpleNamespace(tcg=tcg, inner=inner, retract=retract, cost=cost,
-                           g=g_k, gn0=gn0_k)
+                           precond=precond, g=g_k, gn0=gn0_k)
 
 
 def _tcg_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
@@ -528,7 +605,8 @@ def _rtr_full_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                      x_ref, z_ref, chol_ref, x_out_ref, stats_ref, *scratch,
                      r: int, d: int, max_iters: int, kappa: float,
                      theta: float, initial_radius: float,
-                     max_rejections: int, grad_tol: float):
+                     max_rejections: int, grad_tol: float,
+                     bf16_select: bool):
     """Fully-fused single-step RTR: the start-point gradient, curvature
     term, gradient norm, AND the attempt loop of ``_rtr_kernel`` in one
     kernel — one invocation is the complete local solve of
@@ -540,7 +618,8 @@ def _rtr_full_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
     m = _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                     X, None, chol_ref[...],
                     r=r, d=d, max_iters=max_iters, kappa=kappa, theta=theta,
-                    hoist_scratch=scratch or None, Z=Z)
+                    hoist_scratch=scratch or None, Z=Z,
+                    bf16_select=bf16_select)
     g = m.g
     gn0 = m.gn0
 
@@ -575,30 +654,37 @@ def _rtr_full_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         [k_att, accepted.astype(f32), f0, f_out, gn0]).reshape(1, 5)
 
 
-def _rtr_refine_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref,
-                       wt_ref, rho_rot_ref, rho_trn_ref, rc_ref,
-                       d_ref, dz_ref, scorr_ref, chol_ref, g_ref,
-                       radius_ref, d_out_ref, stats_ref, *scratch,
-                       r: int, d: int, max_iters: int, kappa: float,
-                       theta: float, max_rejections: int):
-    """Re-centered single-step RTR (``models.refine`` semantics): state is
-    the small correction D at host-held f64 reference R; same attempt loop
-    as ``_rtr_kernel``, but the initial radius arrives as a per-agent
-    operand — refinement steps live at the |D| scale, where a fixed large
-    radius would let the cubic model error reject every attempt before the
-    shrink schedule bites."""
+def _rtr_refine_full_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref,
+                            wt_ref, rho_rot_ref, rho_trn_ref, rc_ref,
+                            d_ref, dz_ref, g0_ref, gref_ref, s0_ref,
+                            chol_ref, d_out_ref, stats_ref, *scratch,
+                            r: int, d: int, max_iters: int, kappa: float,
+                            theta: float, initial_radius: float,
+                            max_rejections: int, grad_tol: float):
+    """Fully-fused re-centered single-step RTR: the recentered gradient
+    (g0 + dG with the S0/S1 curvature corrections), the adaptive initial
+    radius, and the shrink-radius attempt loop in one kernel —
+    the XLA pre-pass of ``models.refine._agent_refine`` disappears, same
+    as ``_rtr_full_kernel`` did for the plain round."""
     f32 = jnp.float32
     D = d_ref[...]
     Dz = dz_ref[...]
     Rc = rc_ref[...]
-    g = g_ref[...]
-    initial_radius = radius_ref[0, 0]
     Y = Rc + D
     m = _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
-                    Y, scorr_ref[...], chol_ref[...],
+                    Y, None, chol_ref[...],
                     r=r, d=d, max_iters=max_iters, kappa=kappa, theta=theta,
-                    refine=(rho_rot_ref, rho_trn_ref, Rc, D),
+                    refine=(rho_rot_ref, rho_trn_ref, Rc, D, Dz,
+                            g0_ref[...], gref_ref[...], s0_ref[...]),
                     hoist_scratch=scratch or None)
+    g = m.g
+    gn0 = m.gn0
+
+    # Refinement steps live at the |D| scale: start the trust region near
+    # the preconditioned-gradient (Cauchy) scale (models.refine rationale).
+    pg = m.precond(g)
+    radius0 = jnp.minimum(jnp.asarray(initial_radius, f32),
+                          10.0 * jnp.sqrt(m.inner(pg, pg)))
 
     f0 = m.cost(D, Dz)
     eps = jnp.asarray(1e-30, f32)
@@ -619,14 +705,16 @@ def _rtr_refine_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref,
         k_att, _, _, _, accepted = s
         return (k_att < max_rejections) & ~accepted
 
-    init = (jnp.asarray(0.0, f32), initial_radius,
-            D, f0, jnp.asarray(False))
+    below = gn0 < grad_tol
+    init = (jnp.where(below, jnp.asarray(float(max_rejections), f32),
+                      jnp.asarray(0.0, f32)),
+            radius0, D, f0, jnp.asarray(False))
     k_att, _, D_out, f_out, accepted = jax.lax.while_loop(
         attempt_cond, attempt_body, init)
 
     d_out_ref[...] = D_out
     stats_ref[...] = jnp.stack(
-        [k_att, accepted.astype(f32), f0, f_out]).reshape(1, 4)
+        [k_att, accepted.astype(f32), f0, f_out, gn0]).reshape(1, 5)
 
 
 def comp_major(X: jax.Array) -> jax.Array:
@@ -717,12 +805,12 @@ def rtr_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Sc, Lc,
 
 @functools.partial(jax.jit, static_argnames=(
     "r", "d", "max_iters", "kappa", "theta", "initial_radius",
-    "max_rejections", "grad_tol", "interpret", "hoist"))
+    "max_rejections", "grad_tol", "interpret", "hoist", "bf16_select"))
 def rtr_full_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Lc,
                   *, r: int, d: int, max_iters: int, kappa: float,
                   theta: float, initial_radius: float, max_rejections: int,
                   grad_tol: float = 0.0, interpret: bool = False,
-                  hoist: bool | None = None):
+                  hoist: bool | None = None, bf16_select: bool = False):
     """Invoke the fully-fused single-step RTR kernel for one agent: only
     the pose buffer halves [Xc | Zc], the preconditioner factors and the
     edge tiles go in — gradient, curvature and norm are computed in-kernel.
@@ -732,6 +820,47 @@ def rtr_full_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Lc,
     """
     rk, n = Xc.shape
     kern = functools.partial(_rtr_full_kernel, r=r, d=d,
+                             max_iters=max_iters, kappa=kappa, theta=theta,
+                             initial_radius=initial_radius,
+                             max_rejections=max_rejections,
+                             grad_tol=grad_tol, bf16_select=bf16_select)
+    vspec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    nt, T = idx_i.shape[0], idx_i.shape[-1]
+    if hoist is None:
+        hoist = should_hoist(nt, T, n)
+    sel_t = jnp.bfloat16 if bf16_select else jnp.float32
+    scratch = [pltpu.VMEM((nt, n, T), sel_t)] * 2 if hoist else []
+    return pl.pallas_call(
+        kern,
+        out_shape=(
+            jax.ShapeDtypeStruct((rk, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, 5), jnp.float32),
+        ),
+        in_specs=[vspec] * 9,
+        out_specs=(vspec, vspec),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Lc)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "r", "d", "max_iters", "kappa", "theta", "initial_radius",
+    "max_rejections", "grad_tol", "interpret", "hoist"))
+def rtr_refine_full_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, rho_rot,
+                         rho_trn, Rc, Dc, Dzc, g0c, Grefc, S0c, Lc, *,
+                         r: int, d: int, max_iters: int, kappa: float,
+                         theta: float, initial_radius: float,
+                         max_rejections: int, grad_tol: float = 0.0,
+                         interpret: bool = False, hoist: bool | None = None):
+    """Invoke the fully-fused re-centered RTR kernel for one agent: the
+    recenter constants go in (reference point, residuals, g0, G_ref, S0 in
+    component-major/tile layouts), the updated correction comes out.
+
+    Returns (D_out_c [rk, n],
+             stats [1, 5] = (attempts, accepted, df0, df, gn0)).
+    """
+    rk, n = Dc.shape
+    kern = functools.partial(_rtr_refine_full_kernel, r=r, d=d,
                              max_iters=max_iters, kappa=kappa, theta=theta,
                              initial_radius=initial_radius,
                              max_rejections=max_rejections,
@@ -747,47 +876,12 @@ def rtr_full_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Lc,
             jax.ShapeDtypeStruct((rk, n), jnp.float32),
             jax.ShapeDtypeStruct((1, 5), jnp.float32),
         ),
-        in_specs=[vspec] * 9,
-        out_specs=(vspec, vspec),
-        scratch_shapes=scratch,
-        interpret=interpret,
-    )(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Lc)
-
-
-@functools.partial(jax.jit, static_argnames=(
-    "r", "d", "max_iters", "kappa", "theta", "max_rejections", "interpret",
-    "hoist"))
-def rtr_refine_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, rho_rot, rho_trn,
-                    Rc, Dc, Dzc, Sc, Lc, gc, radius, *, r: int, d: int,
-                    max_iters: int, kappa: float, theta: float,
-                    max_rejections: int, interpret: bool = False,
-                    hoist: bool | None = None):
-    """Invoke the re-centered single-step RTR kernel for one agent.
-
-    ``radius`` is the per-agent initial trust radius, [1, 1].
-    Returns (D_out_c [rk, n], stats [1, 4] = (attempts, accepted, df0, df)).
-    """
-    rk, n = Dc.shape
-    kern = functools.partial(_rtr_refine_kernel, r=r, d=d,
-                             max_iters=max_iters, kappa=kappa, theta=theta,
-                             max_rejections=max_rejections)
-    vspec = pl.BlockSpec(memory_space=pltpu.VMEM)
-    nt, T = idx_i.shape[0], idx_i.shape[-1]
-    if hoist is None:
-        hoist = should_hoist(nt, T, n)
-    scratch = [pltpu.VMEM((nt, n, T), jnp.float32)] * 2 if hoist else []
-    return pl.pallas_call(
-        kern,
-        out_shape=(
-            jax.ShapeDtypeStruct((rk, n), jnp.float32),
-            jax.ShapeDtypeStruct((1, 4), jnp.float32),
-        ),
         in_specs=[vspec] * 15,
         out_specs=(vspec, vspec),
         scratch_shapes=scratch,
         interpret=interpret,
     )(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, rho_rot, rho_trn,
-      Rc, Dc, Dzc, Sc, Lc, gc, radius)
+      Rc, Dc, Dzc, g0c, Grefc, S0c, Lc)
 
 
 #: Hoisted one-hot budget: materialize the [nt, n, T] local selection

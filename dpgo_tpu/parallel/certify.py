@@ -103,20 +103,37 @@ def _certificate_shard(X, graph: MultiAgentGraph, key, *, axis_name,
     lam_dom = psum(jnp.sum(v * S(v)))
     sigma = 1.1 * jnp.abs(lam_dom) + 1e-3
 
-    # --- subspace iteration on sigma I - S (largest = sigma - lambda_min) --
-    def Aop(V):  # sigma I - S, PSD with top eigenvalue sigma - lambda_min(S)
-        return (sigma * V - S(V)) * mask
+    # --- subspace iteration on (sigma I - S)/sigma: spectrum in [0, ~1],
+    # top eigenvalue 1 - lambda_min(S)/sigma.  The normalization keeps the
+    # Rayleigh-Ritz / Gram matrices O(1) regardless of problem scale — at
+    # sigma ~ 1e7 (100k-pose synthetic) the unnormalized f32 eigh/cholesky
+    # on ~sigma-sized entries went NaN on TPU.
+    def Aop(V):
+        return (V - S(V) / sigma) * mask
+
+    def _svqb(V, p):
+        # SVQB whitening (Stathopoulos & Wu 2002): eigendecompose the
+        # psum'd Gram and rotate by U diag(lam)^{-1/2} with the spectrum
+        # clamped at eps * lam_max.  Unlike Cholesky-QR there is no
+        # factorization to fail: a rank-deficient block (converged LOBPCG
+        # basis, duplicated directions) just collapses the deficient
+        # columns onto the clamp instead of producing NaN — measured on
+        # the 100k-pose TPU run, where the f32 Cholesky path went NaN.
+        gram = psum(inner_block(V, V))
+        lam, U = jnp.linalg.eigh(0.5 * (gram + gram.T))
+        lam = jnp.maximum(lam, 100 * jnp.finfo(dtype).eps * lam[-1] + 1e-30)
+        C = U * jax.lax.rsqrt(lam)[None, :]
+        return jnp.einsum("xnpd,pq->xnqd", V, C)
 
     def ortho_block(V, p):
-        gram = psum(inner_block(V, V))
-        # dtype-scaled jitter: at LOBPCG convergence the [V, R, P] Gram is
-        # numerically singular, and in f32 an absolute 1e-12 ridge is below
-        # the Gram's own rounding noise — cholesky would go NaN silently.
-        ridge = 10 * jnp.finfo(dtype).eps * jnp.trace(gram) + 1e-30
-        C = jnp.linalg.cholesky(gram + ridge * jnp.eye(p, dtype=dtype))
-        Vm = V.transpose(0, 1, 3, 2).reshape(-1, p)
-        sol = jax.scipy.linalg.solve_triangular(C, Vm.T, lower=True).T
-        return sol.reshape(A_loc, n, dh, p).transpose(0, 1, 3, 2)
+        # Two passes: one whitening pass loses orthogonality like
+        # kappa(V)^2 * eps — in f32 at 1e5-dimensional problems the
+        # [V, R, P] basis collapses and LOBPCG stalls at an interior Ritz
+        # value (measured on city10000: distributed f32 lambda_min came
+        # out 1.3e3 vs the centralized f64 1.2e-2).  The second pass
+        # restores O(eps) orthogonality (same argument as CholeskyQR2,
+        # Yamamoto et al. 2015).
+        return _svqb(_svqb(V, p), p)
 
     def rotate(V, C):  # apply a [p_in, p_out] coefficient matrix
         return jnp.einsum("xnpd,pq->xnqd", V, C)
@@ -129,17 +146,39 @@ def _certificate_shard(X, graph: MultiAgentGraph, key, *, axis_name,
     # in a few hundred matvecs.
     key2 = jax.random.fold_in(key, 1)
     p = num_probe
-    V = ortho_block(
-        jax.random.normal(key2, (A_loc, n, p, dh), dtype) * mask, p)
+    # Warm start: at a near-stationary iterate the r rows of X^T nearly
+    # span ker(S) (S X^T ~ stationarity gap), i.e. the bottom eigenspace —
+    # seed min(p-1, r) probes with X rows (the last probe stays random so
+    # a suboptimality direction OUTSIDE span(X^T) is still found; that
+    # direction is exactly what certification is about).  From a purely
+    # random block the LOBPCG must resolve the clustered bottom spectrum
+    # unaided, which in f32 at 1e5-pose scale does not converge in any
+    # reasonable iteration budget (measured: 100k synthetic reported an
+    # interior Ritz value 6.5e6 vs the true 3.0).
+    V0 = jax.random.normal(key2, (A_loc, n, p, dh), dtype) * mask
+    n_warm = min(p - 1, X.shape[2])
+    if n_warm > 0:
+        V0 = V0.at[:, :, :n_warm, :].set(X[:, :, :n_warm, :] * mask)
+    V = ortho_block(V0, p)
     P = ortho_block(
         jax.random.normal(jax.random.fold_in(key, 2),
                           (A_loc, n, p, dh), dtype) * mask, p)
+
+    def colnorm(U):
+        # Per-probe normalization before the joint [V, R, P] Gram: the raw
+        # residual block has column norms ~sigma (1e7 at 100k scale) next
+        # to V's unit columns — the combined Gram then spans ~sigma^2
+        # dynamic range and the f32 Cholesky ridge (scaled by the trace)
+        # swamps the V block entirely, stalling LOBPCG at an interior Ritz
+        # value.  Unit columns keep the Gram O(1)-conditioned per block.
+        nrm = jnp.sqrt(psum(jnp.einsum("anpd,anpd->p", U * mask, U)))
+        return U / jnp.maximum(nrm, 1e-30)[None, None, :, None]
 
     def lobpcg_body(_, VP):
         V, P = VP
         W = Aop(V)
         Hv = psum(inner_block(V, W))
-        R = W - rotate(V, Hv)            # block residual
+        R = colnorm(W - rotate(V, Hv))   # block residual, unit columns
         Zb = jnp.concatenate([V, R, P], axis=2)
         Zb = ortho_block(Zb, 3 * p)
         Hz = psum(inner_block(Zb, Aop(Zb)))
@@ -158,7 +197,7 @@ def _certificate_shard(X, graph: MultiAgentGraph, key, *, axis_name,
     H = psum(inner_block(V, Aop(V)))
     H = 0.5 * (H + H.T)
     theta, Q = jnp.linalg.eigh(H)          # ascending
-    lam_min = sigma - theta[-1]
+    lam_min = sigma * (1.0 - theta[-1])    # Aop spectrum is lambda/sigma
     direction = jnp.einsum("xnpd,p->xnd", V, Q[:, -1])
 
     # Stationarity residual ||X S|| (X's r rows ride as probe rows).

@@ -1,0 +1,9 @@
+"""Run bench_convergence's main on the f64 CPU backend (honest A/B arm —
+same accelerated pipeline as the TPU run)."""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, "/root/repo")
+import bench_convergence
+bench_convergence.main()

@@ -22,15 +22,17 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def build(meas, A, r, dtype, schedule=None):
+def build(meas, A, r, dtype, schedule=None, bf16=False):
     import jax.numpy as jnp
-    from dpgo_tpu.config import AgentParams, Schedule
+    from dpgo_tpu.config import AgentParams, Schedule, SolverParams
     from dpgo_tpu.models import rbcd
     from dpgo_tpu.utils.partition import partition_contiguous
 
     kw = {}
     if schedule is not None:
         kw["schedule"] = Schedule[schedule]
+    if bf16:
+        kw["solver"] = SolverParams(pallas_bf16_select=True)
     params = AgentParams(d=meas.d, r=r, num_robots=A, **kw)
     part = partition_contiguous(meas, A)
     graph, meta = rbcd.build_graph(part, r, dtype)
@@ -39,12 +41,13 @@ def build(meas, A, r, dtype, schedule=None):
     return state, graph, meta, params
 
 
-def time_config(name, meas, A, r, rounds, schedule=None, trials=3):
+def time_config(name, meas, A, r, rounds, schedule=None, trials=3,
+                bf16=False):
     import jax.numpy as jnp
     from dpgo_tpu.models import rbcd
 
     state, graph, meta, params = build(meas, A, r, jnp.float32,
-                                       schedule=schedule)
+                                       schedule=schedule, bf16=bf16)
     form = rbcd._formulation(meta, params, graph)
     steps = lambda s, k: rbcd.rbcd_steps(s, graph, k, meta, params)
     t0 = time.perf_counter()
@@ -91,7 +94,9 @@ def synth100k():
     meas, _ = make_measurements(rng, n=100000, d=3, num_lc=20000,
                                 rot_noise=0.01, trans_noise=0.01)
     log(f"[100k] synthesized in {time.perf_counter()-t0:.1f}s")
-    return time_config("100k/64 r5", meas, 64, 5, 20, trials=3)
+    time_config("100k/64 r5", meas, 64, 5, 20, trials=3)
+    return time_config("100k/64 r5 bf16sel", meas, 64, 5, 20, trials=3,
+                       bf16=True)
 
 
 def ablate():

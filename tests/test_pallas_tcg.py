@@ -136,3 +136,20 @@ def test_forced_pallas_without_sel_raises(rng):
     with pytest.raises(ValueError, match="edge tiles"):
         state = rbcd.init_state(graph, meta, X0, params=pp)
         rbcd.rbcd_step(state, graph, meta, pp)
+
+
+def test_rounds_bf16_select_tracks_ell_path(rng):
+    """bf16 selection mode (hi/lo split gathers): rounds track the ELL
+    path to the split's ~2^-16 relative error budget."""
+    graph, meta, X0 = _setup(rng)
+    pp = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.JACOBI,
+                     solver=SolverParams(pallas_tcg=True,
+                                         pallas_bf16_select=True))
+    pe = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.JACOBI,
+                     solver=SolverParams(pallas_tcg=False))
+    sp = rbcd.init_state(graph, meta, X0, params=pp)
+    se = rbcd.init_state(graph, meta, X0, params=pe)
+    for _ in range(3):
+        sp = rbcd.rbcd_step(sp, graph, meta, pp)
+        se = rbcd.rbcd_step(se, graph, meta, pe)
+    assert np.allclose(sp.X, se.X, atol=3e-4)
