@@ -110,9 +110,10 @@ def kernel_parity_check(device) -> float:
     import dataclasses
 
     import jax
+    import jax.numpy as jnp
     from dpgo_tpu.models import rbcd
 
-    state, graph, meta, params = build(jnp_f32())
+    state, graph, meta, params = build(jnp.float32)
     state = jax.device_put(state, device)
     graph = jax.device_put(graph, device)
     params_ell = dataclasses.replace(
@@ -125,11 +126,6 @@ def kernel_parity_check(device) -> float:
     dg = np.abs(np.asarray(s_kernel.rel_change)
                 - np.asarray(s_ell.rel_change)).max()
     return float(max(dx, dg))
-
-
-def jnp_f32():
-    import jax.numpy as jnp
-    return jnp.float32
 
 
 #: On-device kernel-vs-XLA bound for one RBCD round: both paths run the

@@ -465,7 +465,7 @@ def _edge_tile_shape(n_max: int, s_max: int, e_max: int) -> tuple[int, int]:
     return T, max(1, -(-e_max // T))
 
 
-def _pallas_vmem_ok(meta: GraphMeta, graph) -> bool:
+def _pallas_vmem_ok(meta: GraphMeta, graph, bf16: bool = False) -> bool:
     """Whether the kernel's per-agent working set fits in VMEM.
 
     With the tile-streaming kernel the resident set is ~12 [r(d+1), n]
@@ -485,12 +485,13 @@ def _pallas_vmem_ok(meta: GraphMeta, graph) -> bool:
     T = graph.eidx_i.shape[-1]
     nt = graph.eidx_i.shape[1]
     rk = meta.rank * (meta.d + 1)
-    edge_tiles = nt * T * (meta.d * meta.d + meta.d + 4)
-    onehots = 4 * T * (meta.n_max + meta.s_max)
-    vecs = 12 * rk * meta.n_max
-    hoist = hoist_scratch_bytes(nt, T, meta.n_max) \
-        if should_hoist(nt, T, meta.n_max) else 0
-    return (edge_tiles + onehots + vecs) * 4 + hoist \
+    sel_item = 2 if bf16 else 4  # bf16_select halves the one-hot tiles
+    edge_tiles = nt * T * (meta.d * meta.d + meta.d + 4) * 4
+    onehots = 4 * T * (meta.n_max + meta.s_max) * sel_item
+    vecs = 12 * rk * meta.n_max * 4
+    hoist = hoist_scratch_bytes(nt, T, meta.n_max, sel_item) \
+        if should_hoist(nt, T, meta.n_max, sel_item) else 0
+    return edge_tiles + onehots + vecs + hoist \
         <= PALLAS_TCG_VMEM_BUDGET_BYTES
 
 
@@ -509,7 +510,8 @@ def _formulation(meta: GraphMeta, params: AgentParams | None, graph,
     # every round, so a converged f64 block never stays at its fixed point
     # and tight grad_norm_tols become unreachable.
     pallas_ok = rtr and itemsize == 4 and graph.eidx_i is not None \
-        and _pallas_vmem_ok(meta, graph)
+        and _pallas_vmem_ok(meta, graph,
+                            bf16=params.solver.pallas_bf16_select)
     if params.solver.pallas_tcg is True:
         if not pallas_ok:
             # An explicit force that cannot be honored must not silently

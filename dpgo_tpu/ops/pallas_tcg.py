@@ -827,7 +827,7 @@ def rtr_full_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Lc,
     vspec = pl.BlockSpec(memory_space=pltpu.VMEM)
     nt, T = idx_i.shape[0], idx_i.shape[-1]
     if hoist is None:
-        hoist = should_hoist(nt, T, n)
+        hoist = should_hoist(nt, T, n, itemsize=2 if bf16_select else 4)
     sel_t = jnp.bfloat16 if bf16_select else jnp.float32
     scratch = [pltpu.VMEM((nt, n, T), sel_t)] * 2 if hoist else []
     return pl.pallas_call(
@@ -890,12 +890,14 @@ def rtr_refine_full_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, rho_rot,
 HOIST_BUDGET_BYTES = 4 << 20
 
 
-def hoist_scratch_bytes(nt: int, tile: int, n: int) -> int:
-    """Bytes of the two [nt, n, T] f32 one-hot scratch stacks — the single
+def hoist_scratch_bytes(nt: int, tile: int, n: int,
+                        itemsize: int = 4) -> int:
+    """Bytes of the two [nt, n, T] one-hot scratch stacks — the single
     source for ``should_hoist``, the kernels' ``scratch_shapes``, and the
-    dispatch gate's VMEM estimate (``rbcd._pallas_vmem_ok``)."""
-    return 2 * nt * tile * n * 4
+    dispatch gate's VMEM estimate (``rbcd._pallas_vmem_ok``).  ``itemsize``
+    is 2 under ``bf16_select`` (bf16 one-hots), else 4."""
+    return 2 * nt * tile * n * itemsize
 
 
-def should_hoist(nt: int, tile: int, n: int) -> bool:
-    return hoist_scratch_bytes(nt, tile, n) <= HOIST_BUDGET_BYTES
+def should_hoist(nt: int, tile: int, n: int, itemsize: int = 4) -> bool:
+    return hoist_scratch_bytes(nt, tile, n, itemsize) <= HOIST_BUDGET_BYTES

@@ -111,7 +111,7 @@ def _certificate_shard(X, graph: MultiAgentGraph, key, *, axis_name,
     def Aop(V):
         return (V - S(V) / sigma) * mask
 
-    def _svqb(V, p):
+    def _svqb(V):
         # SVQB whitening (Stathopoulos & Wu 2002): eigendecompose the
         # psum'd Gram and rotate by U diag(lam)^{-1/2} with the spectrum
         # clamped at eps * lam_max.  Unlike Cholesky-QR there is no
@@ -125,7 +125,7 @@ def _certificate_shard(X, graph: MultiAgentGraph, key, *, axis_name,
         C = U * jax.lax.rsqrt(lam)[None, :]
         return jnp.einsum("xnpd,pq->xnqd", V, C)
 
-    def ortho_block(V, p):
+    def ortho_block(V):
         # Two passes: one whitening pass loses orthogonality like
         # kappa(V)^2 * eps — in f32 at 1e5-dimensional problems the
         # [V, R, P] basis collapses and LOBPCG stalls at an interior Ritz
@@ -133,7 +133,7 @@ def _certificate_shard(X, graph: MultiAgentGraph, key, *, axis_name,
         # out 1.3e3 vs the centralized f64 1.2e-2).  The second pass
         # restores O(eps) orthogonality (same argument as CholeskyQR2,
         # Yamamoto et al. 2015).
-        return _svqb(_svqb(V, p), p)
+        return _svqb(_svqb(V))
 
     def rotate(V, C):  # apply a [p_in, p_out] coefficient matrix
         return jnp.einsum("xnpd,pq->xnqd", V, C)
@@ -159,10 +159,10 @@ def _certificate_shard(X, graph: MultiAgentGraph, key, *, axis_name,
     n_warm = min(p - 1, X.shape[2])
     if n_warm > 0:
         V0 = V0.at[:, :, :n_warm, :].set(X[:, :, :n_warm, :] * mask)
-    V = ortho_block(V0, p)
+    V = ortho_block(V0)
     P = ortho_block(
         jax.random.normal(jax.random.fold_in(key, 2),
-                          (A_loc, n, p, dh), dtype) * mask, p)
+                          (A_loc, n, p, dh), dtype) * mask)
 
     def colnorm(U):
         # Per-probe normalization before the joint [V, R, P] Gram: the raw
@@ -180,15 +180,15 @@ def _certificate_shard(X, graph: MultiAgentGraph, key, *, axis_name,
         Hv = psum(inner_block(V, W))
         R = colnorm(W - rotate(V, Hv))   # block residual, unit columns
         Zb = jnp.concatenate([V, R, P], axis=2)
-        Zb = ortho_block(Zb, 3 * p)
+        Zb = ortho_block(Zb)
         Hz = psum(inner_block(Zb, Aop(Zb)))
         Hz = 0.5 * (Hz + Hz.T)
         _, C = jnp.linalg.eigh(Hz)       # ascending
         Ctop = C[:, -p:]
-        V_new = ortho_block(rotate(Zb, Ctop), p)
+        V_new = ortho_block(rotate(Zb, Ctop))
         # Conjugate block: the R/P components of the new Ritz vectors.
         Ctail = Ctop.at[:p].set(0.0)
-        P_new = ortho_block(rotate(Zb, Ctail), p)
+        P_new = ortho_block(rotate(Zb, Ctail))
         return V_new, P_new
 
     V, P = jax.lax.fori_loop(0, sub_iters, lobpcg_body, (V, P))

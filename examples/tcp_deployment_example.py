@@ -1,23 +1,36 @@
 #!/usr/bin/env python
-"""Two-process TCP deployment demo: the message vocabulary over a real wire.
+"""N-process TCP deployment demo: the message vocabulary over a real wire.
 
 The reference defers multi-process transport to the external ``dpgo_ros``
 wrapper (``/root/reference/README.md:40-42``); the in-repo demos (ours and
-the reference's) drive agents in one process.  This example goes one step
-further than the reference's in-repo story: each robot is its own OS
-process holding one ``PGOAgent``, and the deployment message set —
-``get_shared_pose_dict`` / ``update_neighbor_poses``, status gossip,
-lifting-matrix and global-anchor broadcast — travels over a localhost TCP
-socket as length-prefixed ``npz`` frames.  This proves the agent API's
-payloads actually serialize: nothing in the vocabulary needs shared
-memory.
+the reference's) drive agents in one process.  This example goes further
+than the reference's in-repo story: each robot is its own OS process
+holding one ``PGOAgent``, and the deployment message set —
+``get_shared_pose_dict`` / ``update_neighbor_poses``, status gossip, GNC
+weight publication (``get_shared_weight_dict`` /
+``update_shared_weights``), lifting-matrix and global-anchor broadcast —
+travels over localhost TCP as length-prefixed ``npz`` frames.  The
+launcher doubles as the message bus (the pub/sub role dpgo_ros plays):
+it accepts one connection per robot and re-broadcasts every round's
+frames to all peers, so the same code runs 2 robots or N.
 
-Usage (launcher spawns both robot processes and assembles the result):
+Modes:
+
+* ``--mode sync`` (default): each robot takes one ``iterate()`` per bus
+  round — the deterministic in-process loop of
+  ``examples/MultiRobotExample.cpp`` stretched over processes.
+* ``--mode async``: each robot runs its Poisson-clock optimization
+  thread (``start_optimization_loop``, reference ``PGOAgent.cpp:861-898``)
+  while the main thread exchanges poses at the bus cadence — the RA-L
+  2020 deployment model: iteration and communication fully decoupled.
+
+Usage (launcher spawns all robot processes and assembles the result):
     python examples/tcp_deployment_example.py DATASET.g2o \
-        [--rank 5] [--rounds 120] [--port 0] [--out-dir DIR]
+        [--robots 2] [--rank 5] [--rounds 120] [--mode sync|async] \
+        [--robust] [--port 0] [--out-dir DIR]
 
 Internal per-robot entry (what the launcher spawns):
-    ... --robot {0,1} --port P
+    ... --robot ID --port P
 """
 
 from __future__ import annotations
@@ -83,67 +96,68 @@ def unpack_pose_dict(frame: dict, prefix: str) -> dict:
 # One robot process
 # ---------------------------------------------------------------------------
 
-def run_robot(robot_id: int, dataset: str, rank: int, rounds: int,
-              port: int, out_dir: str) -> None:
+def _dial_bus(robot_id: int, port: int, out_dir: str) -> socket.socket:
+    """Connect to the launcher's bus; with ``port`` 0 the OS-assigned
+    choice is read from out_dir/port.txt (published atomically by the
+    launcher after binding — no pick-then-rebind TOCTOU window)."""
+    port_file = os.path.join(out_dir, "port.txt")
+    dial = port
+    for _ in range(100):
+        if port == 0:
+            # Re-read every attempt: a stale file from a previous run may
+            # be consumed before this run's launcher republishes.
+            try:
+                with open(port_file) as fh:
+                    dial = int(fh.read())
+            except (FileNotFoundError, ValueError):
+                time.sleep(0.1)
+                continue
+        try:
+            conn = socket.create_connection(("127.0.0.1", dial))
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            send_frame(conn, {"hello": np.asarray(robot_id, np.int64)})
+            return conn
+        except ConnectionRefusedError:
+            time.sleep(0.1)
+    where = f"port {dial}" if dial else f"port file {port_file}"
+    raise ConnectionError(f"robot {robot_id} could not reach the bus "
+                          f"({where})")
+
+
+def run_robot(robot_id: int, dataset: str, num_robots: int, rank: int,
+              rounds: int, port: int, out_dir: str, mode: str,
+              robust: bool, async_rate: float) -> None:
     setup_jax()
     from dpgo_tpu.agent import AgentState, PGOAgent, PGOAgentStatus
-    from dpgo_tpu.config import AgentParams
+    from dpgo_tpu.config import AgentParams, RobustCostParams, RobustCostType
     from dpgo_tpu.utils.g2o import read_g2o
     from dpgo_tpu.utils.partition import agent_measurements, \
         partition_contiguous
 
     meas = read_g2o(dataset)
-    params = AgentParams(d=meas.d, r=rank, num_robots=2)
-    part = partition_contiguous(meas, 2)
+    rp = RobustCostParams(cost_type=RobustCostType.GNC_TLS) if robust \
+        else RobustCostParams()
+    params = AgentParams(d=meas.d, r=rank, num_robots=num_robots, robust=rp)
+    part = partition_contiguous(meas, num_robots)
     agent = PGOAgent(robot_id, params)
 
-    # Robot 0 listens, robot 1 dials (with retries while 0 boots).  With
-    # port 0 robot 0 binds an OS-assigned port itself and publishes the
-    # choice through out_dir — no separate pick-then-bind window for
-    # another process to steal the port (TOCTOU).
-    port_file = os.path.join(out_dir, "port.txt")
-    if robot_id == 0:
-        if os.path.exists(port_file):  # reused out_dir: drop the stale one
-            os.unlink(port_file)
-        srv = socket.create_server(("127.0.0.1", port))
-        port = srv.getsockname()[1]
-        tmp = port_file + ".tmp"
-        with open(tmp, "w") as fh:  # atomic publish: no partial reads
-            fh.write(str(port))
-        os.replace(tmp, port_file)
-        conn, _ = srv.accept()
-    else:
-        dial = port
-        for attempt in range(100):
-            if port == 0:
-                # Re-read every attempt: a stale file from a previous run
-                # may be consumed before this run's robot 0 republishes.
-                try:
-                    with open(port_file) as fh:
-                        dial = int(fh.read())
-                except (FileNotFoundError, ValueError):
-                    time.sleep(0.1)
-                    continue
-            try:
-                conn = socket.create_connection(("127.0.0.1", dial))
-                break
-            except ConnectionRefusedError:
-                time.sleep(0.1)
-        else:
-            where = f"port {dial}" if dial else f"port file {port_file}"
-            raise ConnectionError(
-                f"robot 1 could not reach robot 0 ({where})")
-    conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    conn = _dial_bus(robot_id, port, out_dir)
 
     # Lifting-matrix broadcast (robot 0 self-generates; reference
-    # MultiRobotExample.cpp:139-146).
+    # MultiRobotExample.cpp:139-146) — rides the first bus round.
     if robot_id == 0:
-        send_frame(conn, {"ylift": agent.get_lifting_matrix()})
+        first = {"ylift": agent.get_lifting_matrix()}
     else:
-        agent.set_lifting_matrix(recv_frame(conn)["ylift"])
+        first = {}
+    send_frame(conn, first)
+    merged = recv_frame(conn)
+    if robot_id != 0:
+        agent.set_lifting_matrix(merged["r0|ylift"])
     agent.set_pose_graph(*agent_measurements(part, robot_id))
 
-    peer = 1 - robot_id
+    if mode == "async":
+        agent.start_optimization_loop(rate_hz=async_rate)
+
     bytes_sent = 0
     for it in range(rounds):
         st = agent.get_status()
@@ -152,37 +166,62 @@ def run_robot(robot_id: int, dataset: str, rank: int, rounds: int,
              st.iteration_number, int(st.ready_to_terminate)], np.int64),
             "relchange": np.asarray(st.relative_change, np.float64)}
         frame.update(pack_pose_dict("pose", agent.get_shared_pose_dict()))
+        if robust:
+            # GNC weight publication (reference mPublishWeightsRequested,
+            # consumed by dpgo_ros): owner pushes shared-edge weights.
+            wd = agent.get_shared_weight_dict()
+            frame.update({
+                f"wt_{r1}_{p1}_{r2}_{p2}": np.asarray(w, np.float64)
+                for ((r1, p1), (r2, p2)), w in wd.items()})
         if robot_id == 0:
             anchor = agent.get_global_anchor()
             if anchor is not None:
                 frame["anchor"] = np.asarray(anchor)
-        # Asymmetric order (0 sends first, 1 receives first): a symmetric
-        # send-then-recv deadlocks once a pose frame outgrows the loopback
-        # socket buffers (both peers blocked in sendall).
-        if robot_id == 0:
-            bytes_sent += send_frame(conn, frame)
-            peer_frame = recv_frame(conn)
+        bytes_sent += send_frame(conn, frame)
+        merged = recv_frame(conn)  # bus barrier: everyone's round frames
+
+        for peer in range(num_robots):
+            if peer == robot_id:
+                continue
+            pf = {k.split("|", 1)[1]: v for k, v in merged.items()
+                  if k.startswith(f"r{peer}|")}
+            if not pf:
+                continue
+            ps = pf["status"]
+            agent.set_neighbor_status(PGOAgentStatus(
+                robot_id=int(ps[0]), state=AgentState(int(ps[1])),
+                instance_number=int(ps[2]), iteration_number=int(ps[3]),
+                ready_to_terminate=bool(ps[4]),
+                relative_change=float(pf["relchange"])))
+            agent.update_neighbor_poses(peer, unpack_pose_dict(pf, "pose"))
+            if robust:
+                wd = {}
+                for k, v in pf.items():
+                    if k.startswith("wt_"):
+                        _, r1, p1, r2, p2 = k.split("_")
+                        wd[((int(r1), int(p1)), (int(r2), int(p2)))] = \
+                            float(v)
+                if wd:
+                    agent.update_shared_weights(wd)
+            if robot_id != 0 and "anchor" in pf and peer == 0:
+                agent.set_global_anchor(pf["anchor"])
+
+        if mode == "sync":
+            agent.iterate(do_optimization=True)
         else:
-            peer_frame = recv_frame(conn)
-            bytes_sent += send_frame(conn, frame)
-        ps = peer_frame["status"]
-        agent.set_neighbor_status(PGOAgentStatus(
-            robot_id=int(ps[0]), state=AgentState(int(ps[1])),
-            instance_number=int(ps[2]), iteration_number=int(ps[3]),
-            ready_to_terminate=bool(ps[4]),
-            relative_change=float(peer_frame["relchange"])))
-        agent.update_neighbor_poses(peer, unpack_pose_dict(peer_frame,
-                                                           "pose"))
-        if robot_id == 1 and "anchor" in peer_frame:
-            agent.set_global_anchor(peer_frame["anchor"])
+            time.sleep(1.0 / async_rate)
 
-        agent.iterate(do_optimization=True)
+    if mode == "async":
+        agent.end_optimization_loop()
 
-    # Final anchor sync so both trajectories live in the same frame.
+    # Final anchor sync so all trajectories live in the same frame.
     if robot_id == 0:
         send_frame(conn, {"anchor": np.asarray(agent.get_global_anchor())})
     else:
-        agent.set_global_anchor(recv_frame(conn)["anchor"])
+        send_frame(conn, {})
+    merged = recv_frame(conn)
+    if robot_id != 0:
+        agent.set_global_anchor(merged["r0|anchor"])
     conn.close()
 
     st = agent.get_status()
@@ -194,39 +233,77 @@ def run_robot(robot_id: int, dataset: str, rank: int, rounds: int,
 
 
 # ---------------------------------------------------------------------------
-# Launcher: spawn both robots, wait, assemble, report
+# Launcher: bind the bus, spawn robots, relay rounds, assemble, report
 # ---------------------------------------------------------------------------
+
+def serve_bus(srv: socket.socket, num_robots: int, total_rounds: int):
+    """Accept one connection per robot and relay ``total_rounds`` rounds:
+    collect one frame from every robot, then broadcast the union (keys
+    namespaced ``r{id}|...``) to all — the pub/sub role the reference
+    delegates to dpgo_ros."""
+    conns: dict[int, socket.socket] = {}
+    while len(conns) < num_robots:
+        c, _ = srv.accept()
+        c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        hello = recv_frame(c)
+        conns[int(hello["hello"])] = c
+    for _ in range(total_rounds):
+        merged = {}
+        for rid in sorted(conns):
+            frame = recv_frame(conns[rid])
+            merged.update({f"r{rid}|{k}": v for k, v in frame.items()})
+        for rid in sorted(conns):
+            send_frame(conns[rid], merged)
+    for c in conns.values():
+        c.close()
+
 
 def launch(args) -> int:
     import subprocess
+    import threading
 
     out_dir = args.out_dir or tempfile.mkdtemp(prefix="dpgo_tcp_")
     os.makedirs(out_dir, exist_ok=True)
-    # port 0 flows through to robot 0, which binds it and publishes the
-    # OS-assigned choice via out_dir/port.txt (read by robot 1) — binding
-    # in the child avoids the pick-then-rebind TOCTOU window.
-    port = args.port
-    stale = os.path.join(out_dir, "port.txt")
-    if os.path.exists(stale):  # reused --out-dir: drop the previous run's
-        os.unlink(stale)
+    port_file = os.path.join(out_dir, "port.txt")
+    if os.path.exists(port_file):  # reused --out-dir: drop the stale one
+        os.unlink(port_file)
 
-    # Robot processes always run on CPU unless told otherwise: two python
+    # Bind FIRST (port 0 = OS-assigned), then publish atomically — no
+    # pick-then-rebind TOCTOU window for another process to steal it.
+    srv = socket.create_server(("127.0.0.1", args.port))
+    port = srv.getsockname()[1]
+    tmp = port_file + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(str(port))
+    os.replace(tmp, port_file)
+
+    # ylift round + solve rounds + final anchor round
+    bus = threading.Thread(target=serve_bus,
+                           args=(srv, args.robots, args.rounds + 2),
+                           daemon=True)
+    bus.start()
+
+    # Robot processes always run on CPU unless told otherwise: N python
     # processes cannot share the single tunneled-TPU grant (they would
     # deadlock at backend init), and the per-agent problems are tiny.
     child_env = dict(os.environ,
                      DPGO_PLATFORM=os.environ.get("DPGO_PLATFORM", "cpu"))
     procs = [subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), args.dataset,
-         "--robot", str(rid), "--port", str(port), "--rank", str(args.rank),
-         "--rounds", str(args.rounds), "--out-dir", out_dir],
-        env=child_env) for rid in (0, 1)]
+         "--robot", str(rid), "--robots", str(args.robots),
+         "--port", str(port), "--rank", str(args.rank),
+         "--rounds", str(args.rounds), "--mode", args.mode,
+         "--async-rate", str(args.async_rate), "--out-dir", out_dir]
+        + (["--robust"] if args.robust else []),
+        env=child_env) for rid in range(args.robots)]
     try:
-        rcs = [p.wait(timeout=600) for p in procs]
+        rcs = [p.wait(timeout=900) for p in procs]
     finally:
-        # A hung/killed robot must not orphan its sibling.
+        # A hung/killed robot must not orphan its siblings.
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    srv.close()
     if any(rcs):
         print(f"robot processes failed: {rcs}", file=sys.stderr)
         return 1
@@ -240,8 +317,9 @@ def launch(args) -> int:
     import jax.numpy as jnp
 
     meas = read_g2o(args.dataset)
-    part = partition_contiguous(meas, 2)
-    outs = [np.load(os.path.join(out_dir, f"robot{r}.npz")) for r in (0, 1)]
+    part = partition_contiguous(meas, args.robots)
+    outs = [np.load(os.path.join(out_dir, f"robot{r}.npz"))
+            for r in range(args.robots)]
     d = meas.d
     T = np.zeros((meas.num_poses, d, d + 1))
     for r, o in enumerate(outs):
@@ -264,8 +342,14 @@ def launch(args) -> int:
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("dataset")
+    ap.add_argument("--robots", type=int, default=2)
     ap.add_argument("--rank", type=int, default=5)
     ap.add_argument("--rounds", type=int, default=120)
+    ap.add_argument("--mode", choices=("sync", "async"), default="sync")
+    ap.add_argument("--robust", action="store_true")
+    ap.add_argument("--async-rate", type=float, default=20.0,
+                    help="async mode: per-robot Poisson iterate rate (Hz) "
+                         "and the bus exchange cadence")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--out-dir", default=None)
     ap.add_argument("--robot", type=int, default=None,
@@ -273,8 +357,9 @@ def main() -> None:
     args = ap.parse_args()
     if args.robot is None:
         sys.exit(launch(args))
-    run_robot(args.robot, args.dataset, args.rank, args.rounds, args.port,
-              args.out_dir)
+    run_robot(args.robot, args.dataset, args.robots, args.rank, args.rounds,
+              args.port, args.out_dir, args.mode, args.robust,
+              args.async_rate)
 
 
 if __name__ == "__main__":
