@@ -37,7 +37,8 @@ def main() -> None:
     ap.add_argument("--rank", type=int, default=5)
     ap.add_argument("--max-iters", type=int, default=100)
     ap.add_argument("--grad-norm-tol", type=float, default=0.1)
-    ap.add_argument("--schedule", choices=["greedy", "jacobi", "async"],
+    ap.add_argument("--schedule",
+                    choices=["greedy", "jacobi", "async", "colored"],
                     default="greedy")
     ap.add_argument("--no-acceleration", action="store_true")
     ap.add_argument("--robust", action="store_true",
@@ -66,7 +67,8 @@ def main() -> None:
         d=meas.d, r=args.rank, num_robots=args.num_robots,
         acceleration=not args.no_acceleration,
         schedule={"greedy": Schedule.GREEDY, "jacobi": Schedule.JACOBI,
-                  "async": Schedule.ASYNC}[args.schedule],
+                  "async": Schedule.ASYNC,
+                  "colored": Schedule.COLORED}[args.schedule],
         robust=RobustCostParams(
             cost_type=RobustCostType.GNC_TLS if args.robust
             else RobustCostType.L2),

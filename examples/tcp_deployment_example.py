@@ -252,8 +252,13 @@ def serve_bus(srv: socket.socket, num_robots: int, total_rounds: int):
         for rid in sorted(conns):
             frame = recv_frame(conns[rid])
             merged.update({f"r{rid}|{k}": v for k, v in frame.items()})
+        # Serialize once, broadcast the same bytes — np.savez per robot
+        # would be O(N^2) redundant CPU per round.
+        buf = io.BytesIO()
+        np.savez(buf, **merged)
+        data = struct.pack("<Q", buf.getbuffer().nbytes) + buf.getvalue()
         for rid in sorted(conns):
-            send_frame(conns[rid], merged)
+            conns[rid].sendall(data)
     for c in conns.values():
         c.close()
 
