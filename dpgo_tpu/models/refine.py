@@ -157,7 +157,8 @@ def _np_project_manifold(Xg64: np.ndarray, d: int) -> np.ndarray:
 
 def recenter(Xg64: np.ndarray, graph, meta, params: AgentParams,
              edges_global, chol=None, weights=None,
-             pre_projected: bool = False) -> RefineRef:
+             pre_projected: bool = False,
+             f_ref: float | None = None) -> RefineRef:
     """Build the f64 reference and its device constants from a global
     iterate.  ``Xg64 [N, r, k]`` is projected to the manifold in f64 first;
     ``edges_global`` is the global EdgeSet (host arrays ok) for ``f_ref``.
@@ -208,8 +209,11 @@ def recenter(Xg64: np.ndarray, graph, meta, params: AgentParams,
     g0 = G_ref.copy()
     g0[..., :d] -= RY @ S0
 
-    # Global reference cost in f64 (the bench's gap oracle).
-    f_ref = global_cost(Xg64, edges_global)
+    # Global reference cost in f64 (the bench's gap oracle); reuse the
+    # caller's value when it was just computed at the same point
+    # (solve_refine's verify pass).
+    if f_ref is None:
+        f_ref = global_cost(Xg64, edges_global)
 
     if chol is None:
         chol = jnp.asarray(
@@ -553,8 +557,10 @@ def solve_refine(Xg64: np.ndarray, graph, meta, params: AgentParams,
     (``refine_rounds_accel``, the default — fewer recenter cycles) over
     plain Jacobi rounds.
 
-    ``history`` is a list of ``(rel_gap, elapsed_s)`` per recenter — each
-    entry is a *verified* f64 gap with its wall-clock offset from the call
+    ``history`` is a list of ``(rel_gap, elapsed_s)`` per VERIFY pass —
+    one at every cycle boundary, so ``len(history) == cycles_run + 1``
+    and the last entry is the final verification (not a recenter).  Each
+    entry is a verified f64 gap with its wall-clock offset from the call
     start, so drivers can credit gap-ladder crossings that happen inside
     refinement (bench_convergence.py does).
     """
@@ -599,7 +605,7 @@ def solve_refine(Xg64: np.ndarray, graph, meta, params: AgentParams,
             # on both exits.
             return best[1], best[0], cyc, history
         ref = recenter(Xg64, graph, meta, params, edges_global, chol=chol,
-                       pre_projected=True)
+                       pre_projected=True, f_ref=f)
         chol = ref.consts.chol  # weight-only: constant across recenters
         rounds_fn = _refine_rounds_accel_jit if accel_on \
             else _refine_rounds_jit
