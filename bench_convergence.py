@@ -16,7 +16,9 @@ second on sphere2500 with 8 agents, r=5:
    block_until_ready cannot be trusted on the tunneled platform).
 
 Prints one JSON line:
-  {"metric": f"time_to_{REL_GAP:.0e}_subopt_sphere2500_8agents_r5", "value": <s>,
+  {# "1e-06" -> "1e-6": keep the historical metric key for default runs
+        "metric": "time_to_%s_subopt_sphere2500_8agents_r5"
+                  % f"{REL_GAP:.0e}".replace("e-0", "e-"), "value": <s>,
    "unit": "s", "rounds": N, "f_opt": ..., "certified": true}
 """
 
@@ -383,7 +385,9 @@ def main():
             if path is not None and os.path.exists(path):
                 os.unlink(path)
     print(json.dumps({
-        "metric": f"time_to_{REL_GAP:.0e}_subopt_sphere2500_8agents_r5",
+        # "1e-06" -> "1e-6": keep the historical metric key for default runs
+        "metric": "time_to_%s_subopt_sphere2500_8agents_r5"
+                  % f"{REL_GAP:.0e}".replace("e-0", "e-"),
         "value": round(reached, 3) if reached is not None else None,
         "unit": "s",
         "rounds": rounds,

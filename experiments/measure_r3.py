@@ -238,14 +238,17 @@ def ais():
 
     state, graph, meta, params = build(meas, 32, 3, jnp.float32,
                                        schedule="COLORED")
-    part = partition_contiguous(meas, 32)
+    part = partition_contiguous(meas, 32)  # deterministic: same as build()
     edges_g = edge_set_from_measurements(part.meas_global, dtype=jnp.float32)
     costs = []
     for _ in range(50):
         state = rbcd.rbcd_steps(state, graph, meta.num_colors, meta, params)
         costs.append(float(quadratic.cost(
             rbcd.gather_to_global(state.X, graph, meas.num_poses), edges_g)))
-    inc = sum(1 for a, b in zip(costs, costs[1:]) if b > a + 1e-3)
+    # f32-relative tolerance: absolute 1e-3 sits below rounding noise at
+    # cost magnitudes ~1e5
+    inc = sum(1 for a, b in zip(costs, costs[1:])
+              if b > a + 1e-6 * max(abs(a), 1.0))
     log(f"[ais colored] C={meta.num_colors} f0={costs[0]:.0f} "
         f"f_end={costs[-1]:.0f} increases={inc}")
 
@@ -266,7 +269,6 @@ def ais_gnc():
         rel_change_tol=0.0,
         robust=RobustCostParams(cost_type=RobustCostType.GNC_TLS))
     part = partition_contiguous(meas, 32)
-    graph, meta = rbcd.build_graph(part, 3, jnp.float32)
     t0 = _t.perf_counter()
     res = rbcd.solve_rbcd(meas, 32, params=params, max_iters=1500,
                           grad_norm_tol=0.5, eval_every=50,

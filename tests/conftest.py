@@ -37,6 +37,7 @@ DATA_DIR = "/root/reference/data"
 # iteration loop is `pytest -m "not slow"` (< ~2 min); the full suite
 # (~25 min on this 1-core box) remains the pre-commit gate for solver math.
 SLOW_TESTS = {
+    "test_colored_schedule_with_acceleration",
     "test_four_process_robust_tcp_matches_in_process",
     "test_four_process_tcp_solve_matches_two",
     "test_four_process_async_tcp_solve",
