@@ -28,6 +28,7 @@ import json
 import os
 import sys
 import time
+from typing import NamedTuple
 
 import numpy as np
 
@@ -109,10 +110,27 @@ def fopt_main():
                       "certified": bool(cert.certified)}))
 
 
-def _build_problem(dtype, init: str = "chordal"):
+class BenchProblem(NamedTuple):
+    """Everything the descent / polish arms need, by name (the positional
+    tuple outgrew itself once the host-eval path needed ``gather_of`` and
+    ``part``)."""
+
+    rbcd: object      # the models.rbcd module
+    graph: object
+    meta: object
+    params: object
+    state0: object    # None when init != "chordal"
+    cost_of: object   # jitted on-device scalar cost
+    edges_g: object
+    n_total: int
+    gather_of: object  # jitted [A, n_max, ...] -> global [N, ...]
+    part: object
+
+
+def _build_problem(dtype, init: str = "chordal") -> BenchProblem:
     """Shared benchmark-problem builder (main / polish subprocess): one
     definition so the polish measures exactly the problem the accelerator
-    descent ran.  Returns (rbcd, graph, meta, params, state0, cost_of)."""
+    descent ran."""
     import jax
     import jax.numpy as jnp
     from dpgo_tpu.config import AgentParams, SolverParams
@@ -143,7 +161,12 @@ def _build_problem(dtype, init: str = "chordal"):
         return quadratic.cost(rbcd.gather_to_global(s.X, graph, n_total),
                               edges_g)
 
-    return rbcd, graph, meta, params, state0, cost_of, edges_g, n_total
+    @jax.jit
+    def gather_of(s):
+        return rbcd.gather_to_global(s.X, graph, n_total)
+
+    return BenchProblem(rbcd, graph, meta, params, state0, cost_of,
+                        edges_g, n_total, gather_of, part)
 
 
 def advance(rbcd, graph, meta, params, state, it, k):
@@ -184,8 +207,9 @@ def polish_main():
 
     # init="warm": skip _build_problem's chordal initialization — the
     # warm-start state comes from the accelerator's .npz.
-    rbcd, graph, meta, params, _none, cost_of, _eg, _nt = _build_problem(
-        jnp.float64, init="warm")
+    p = _build_problem(jnp.float64, init="warm")
+    rbcd, graph, meta, params, cost_of = \
+        p.rbcd, p.graph, p.meta, p.params, p.cost_of
     X0 = jnp.asarray(data["X"], jnp.float64)
     state = rbcd.init_state(graph, meta, X0, params=params)
 
@@ -230,8 +254,26 @@ def main():
     log(f"benchmark device: {dev.platform} ({dev.device_kind})")
     dtype = jnp.float32 if dev.platform != "cpu" else jnp.float64
 
-    rbcd, graph, meta, params, state0, cost_of, edges_g, n_total = \
-        _build_problem(dtype)
+    p = _build_problem(dtype)
+    (rbcd, graph, meta, params, state0, cost_of, edges_g, n_total,
+     gather_of, part) = p
+
+    # On the tunneled accelerator every device->host sync costs a fixed
+    # ~90 ms round-trip, so the f32 arm evaluates cost on the HOST from a
+    # single jitted full-iterate readback (f64 oracle — also the exact
+    # iterate the refine phase recenters from, so the handoff pays no
+    # second readback).  The CPU arm keeps the on-device scalar eval.
+    host_eval = dtype == jnp.float32
+    if host_eval:
+        from dpgo_tpu.models import refine as refine_mod
+        edges_oracle = refine_mod.host_edges_f64(part.meas_global)
+
+    def eval_state(s):
+        """Returns (f, Xg64-or-None): the benchmark's gap oracle."""
+        if host_eval:
+            Xg64 = np.asarray(gather_of(s), np.float64)
+            return refine_mod.global_cost(Xg64, edges_oracle), Xg64
+        return float(cost_of(s)), None
 
     # Warm-up: compile the fused step, the restart-round variant (hit at
     # every RESTART_INTERVAL boundary — compiling it inside the timed loop
@@ -240,7 +282,7 @@ def main():
     if ACCEL:
         _ = rbcd.rbcd_step(state, graph, meta, params,
                            update_weights=False, restart=True)
-    _ = float(cost_of(state))
+    _ = eval_state(state)
 
     # Ladder of relative gaps: record the first crossing time of each, so
     # TPU (float32: floor measured ~4e-6 on this problem) and CPU (float64)
@@ -257,6 +299,7 @@ def main():
     # rungs below the handoff are credited from the refine history.
     handoff = float(os.environ.get("BENCH_HANDOFF", "1e-4")) \
         if dtype == jnp.float32 else None
+    f, Xg64 = eval_state(state)  # pre-clock: defines f when the loop is empty
     t0 = time.perf_counter()
     rounds = 0
     best = float("inf")
@@ -265,7 +308,7 @@ def main():
         seg = FIRST_SEGMENT if rounds == 0 else EVAL_EVERY
         state, rounds = advance(rbcd, graph, meta, params, state, rounds,
                                 seg)
-        f = float(cost_of(state))  # device->host sync each eval
+        f, Xg64 = eval_state(state)  # device->host sync each eval
         now = time.perf_counter() - t0
         for g in ladder:
             if g not in crossed and f <= f_opt * (1.0 + g):
@@ -287,7 +330,6 @@ def main():
         else:
             stall = 0
         best = min(best, f)
-    f = float(cost_of(state))
     gap = f / f_opt - 1.0
     dt = time.perf_counter() - t0
     log(f"  rounds {rounds}, final cost {f:.9f}, rel gap {gap:.2e}, "
@@ -301,14 +343,15 @@ def main():
     refine_res = None
     if reached is None and jax.devices()[0].platform != "cpu":
         try:
-            from dpgo_tpu.models import refine as refine_mod
             import jax.numpy as jnp2
-            Xg64 = np.asarray(
-                rbcd.gather_to_global(state.X, graph, n_total), np.float64)
+            # The handoff eval already read the full iterate back (the f32
+            # arm's gap oracle IS the host f64 cost of that readback), so
+            # the refine phase starts from Xg64 with no extra sync.
             # Compile the fused refine rounds outside the clock (bench.py
             # convention: steady-state timing, compile cached; num_rounds
             # is traced, so the 2-round warm-up covers REFINE_ROUNDS).
-            ref_w = refine_mod.recenter(Xg64, graph, meta, params, edges_g)
+            ref_w = refine_mod.recenter(Xg64, graph, meta, params,
+                                        edges_oracle)
             _ = np.asarray(refine_mod._refine_rounds_accel_jit(
                 jnp2.zeros(ref_w.consts.R.shape, jnp2.float32),
                 ref_w.consts, graph, meta, params, 2))
@@ -318,7 +361,7 @@ def main():
             rpc = REFINE_ROUNDS or (120 if f <= f_opt * (1 + 2e-5) else 200)
             t_r = time.perf_counter()
             _X64, rgap, cycles, hist = refine_mod.solve_refine(
-                Xg64, graph, meta, params, edges_g, f_opt,
+                Xg64, graph, meta, params, edges_oracle, f_opt,
                 rel_gap=REL_GAP, rounds_per_cycle=rpc,
                 accel=True)
             refine_s = time.perf_counter() - t_r

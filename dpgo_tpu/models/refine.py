@@ -46,8 +46,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from functools import partial
+
 from ..config import AgentParams
 from ..ops import manifold, quadratic, solver
+from ..types import EdgeSet
 from . import rbcd
 
 
@@ -219,8 +222,18 @@ def recenter(Xg64: np.ndarray, graph, meta, params: AgentParams,
         chol = jnp.asarray(
             _np_chol_blocks(edges_np, meta.n_max, d,
                             params.solver.precond_shift), jnp.float32)
+    else:
+        chol = jnp.asarray(chol, jnp.float32)
 
-    pallas_fields = {}
+    # All remaining device constants are built as ONE host f32 buffer and
+    # shipped in ONE transfer, then sliced apart by a single jitted unpack
+    # (``Lc`` is derived from ``chol`` inside it).  On the tunneled TPU a
+    # host->device transfer costs a fixed latency regardless of size, so
+    # the previous one-asarray-per-field recenter paid ~14 latencies per
+    # cycle where this pays one.
+    fields = dict(
+        R=R_loc, Rz=Rz, G_ref=G_ref, g0=g0, S0=S0,
+    )
     if graph.eidx_i is not None:
         # Kernel-layout constants: reference residuals at R over the edge
         # tiles, R component-major, weight tiles (weights are fixed
@@ -242,36 +255,53 @@ def recenter(Xg64: np.ndarray, graph, meta, params: AgentParams,
             return p.reshape(A, nt, 1, T)
 
         def cm(arr):  # [A, n, r, k] -> [A, r*k, n] component-major
-            return jnp.asarray(
-                arr.transpose(0, 2, 3, 1).reshape(A, -1, meta.n_max),
-                jnp.float32)
+            return arr.transpose(0, 2, 3, 1).reshape(A, -1, meta.n_max)
 
-        pallas_fields = dict(
-            rho_rot_t=jnp.asarray(tile_cm(rrR, r * d), jnp.float32),
-            rho_trn_t=jnp.asarray(tile_cm(rrt, r), jnp.float32),
+        fields.update(
+            rho_rot_t=tile_cm(rrR, r * d),
+            rho_trn_t=tile_cm(rrt, r),
             Rc=cm(R_loc),
-            wk_t=jnp.asarray(wtile(w * edges_np["kappa"]), jnp.float32),
-            wt_t=jnp.asarray(wtile(w * edges_np["tau"]), jnp.float32),
+            wk_t=wtile(w * edges_np["kappa"]),
+            wt_t=wtile(w * edges_np["tau"]),
             g0_c=cm(g0),
             Gref_c=cm(G_ref),
-            S0_c=jnp.asarray(
-                S0.transpose(0, 2, 3, 1).reshape(A, d * d, meta.n_max),
-                jnp.float32),
-            Lc=jnp.transpose(jnp.asarray(chol, jnp.float32),
-                             (0, 2, 3, 1)).reshape(
-                A, (d + 1) * (d + 1), meta.n_max),
+            S0_c=S0.transpose(0, 2, 3, 1).reshape(A, d * d, meta.n_max),
         )
 
-    consts = RefineConstants(
-        R=jnp.asarray(R_loc, jnp.float32),
-        Rz=jnp.asarray(Rz, jnp.float32),
-        G_ref=jnp.asarray(G_ref, jnp.float32),
-        g0=jnp.asarray(g0, jnp.float32),
-        S0=jnp.asarray(S0, jnp.float32),
-        chol=jnp.asarray(chol, jnp.float32),
-        **pallas_fields,
-    )
+    layout = tuple((name, arr.shape) for name, arr in fields.items())
+    packed = np.concatenate(
+        [np.ascontiguousarray(arr, np.float32).ravel()
+         for arr in fields.values()])
+    consts = _unpack_consts(jnp.asarray(packed), chol, layout,
+                            graph.eidx_i is not None)
     return RefineRef(Xg=Xg64, f_ref=f_ref, consts=consts)
+
+
+@partial(jax.jit, static_argnames=("layout", "kernel"))
+def _unpack_consts(packed, chol, layout, kernel) -> RefineConstants:
+    """Slice the packed recenter buffer back into named device constants
+    (one dispatch); derives the kernel preconditioner layout from chol."""
+    out = {}
+    off = 0
+    for name, shape in layout:
+        size = int(np.prod(shape))
+        out[name] = jax.lax.dynamic_slice_in_dim(
+            packed, off, size).reshape(shape)
+        off += size
+    if kernel:
+        A, n, k, _ = chol.shape
+        out["Lc"] = jnp.transpose(chol, (0, 2, 3, 1)).reshape(A, k * k, n)
+    return RefineConstants(chol=chol, **out)
+
+
+def host_edges_f64(meas) -> EdgeSet:
+    """A host-side float64 EdgeSet over global pose indices — the gap
+    oracle's edge data.  The tunneled TPU process cannot enable x64, so
+    ``edge_set_from_measurements(dtype=float64)`` silently truncates to
+    f32 there; the numpy-backed build keeps the oracle's edge data
+    (R, t, kappa, tau) at full precision for ``global_cost``."""
+    from ..types import edge_set_from_measurements
+    return edge_set_from_measurements(meas, dtype=np.float64, as_numpy=True)
 
 
 def global_x(ref: RefineRef, D, graph) -> np.ndarray:

@@ -121,6 +121,7 @@ def edge_set_from_measurements(
     is_lc: np.ndarray | None = None,
     pad_to: int | None = None,
     dtype=jnp.float32,
+    as_numpy: bool = False,
 ) -> EdgeSet:
     """Build an on-device EdgeSet from host measurements.
 
@@ -128,6 +129,11 @@ def edge_set_from_measurements(
     (single-buffer, centralized problem).  ``tail_index``/``head_index``
     override the buffer indices (used by the multi-agent builder to point
     shared-edge endpoints into the neighbor section of the buffer).
+
+    ``as_numpy`` keeps the arrays on the host (numpy) instead of shipping
+    them to a device — the float64 gap-oracle path in processes where x64
+    cannot be enabled (the TPU tunnel), where ``jnp.asarray`` would
+    silently truncate ``dtype=float64`` to f32.
     """
     m = len(meas)
     ti = np.asarray(meas.p1 if tail_index is None else tail_index, np.int32)
@@ -148,15 +154,16 @@ def edge_set_from_measurements(
         return np.pad(x, width, constant_values=fill)
 
     d = meas.d
+    conv = np.asarray if as_numpy else jnp.asarray
     return EdgeSet(
-        i=jnp.asarray(pad(ti)),
-        j=jnp.asarray(pad(hi)),
-        R=jnp.asarray(pad(np.broadcast_to(np.eye(d), (m, d, d)) if m == 0 else meas.R), dtype),
-        t=jnp.asarray(pad(meas.t), dtype),
-        kappa=jnp.asarray(pad(meas.kappa), dtype),
-        tau=jnp.asarray(pad(meas.tau), dtype),
-        weight=jnp.asarray(pad(meas.weight), dtype),
-        mask=jnp.asarray(pad(np.ones(m)), dtype),
-        is_lc=jnp.asarray(pad(is_lc.astype(np.float64)), dtype),
-        fixed_weight=jnp.asarray(pad(meas.is_known_inlier.astype(np.float64)), dtype),
+        i=conv(pad(ti)),
+        j=conv(pad(hi)),
+        R=conv(pad(np.broadcast_to(np.eye(d), (m, d, d)) if m == 0 else meas.R), dtype),
+        t=conv(pad(meas.t), dtype),
+        kappa=conv(pad(meas.kappa), dtype),
+        tau=conv(pad(meas.tau), dtype),
+        weight=conv(pad(meas.weight), dtype),
+        mask=conv(pad(np.ones(m)), dtype),
+        is_lc=conv(pad(is_lc.astype(np.float64)), dtype),
+        fixed_weight=conv(pad(meas.is_known_inlier.astype(np.float64)), dtype),
     )

@@ -1,0 +1,111 @@
+"""Instrument the certified-gap refine phase: where does the ~0.3 s go?
+
+Phases per cycle: verify (host f64 project + cost), recenter host build,
+device transfers, fused refine rounds dispatch+readback, final verify.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DATASET = "/root/reference/data/sphere2500.g2o"
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from dpgo_tpu.config import AgentParams, SolverParams
+    from dpgo_tpu.models import rbcd, refine
+    from dpgo_tpu.types import edge_set_from_measurements
+    from dpgo_tpu.utils.g2o import read_g2o
+    from dpgo_tpu.utils.partition import partition_contiguous
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+    dtype = jnp.float32
+
+    meas = read_g2o(DATASET)
+    params = AgentParams(
+        d=3, r=5, num_robots=8, rel_change_tol=0.0,
+        acceleration=True, restart_interval=100,
+        solver=SolverParams(grad_norm_tol=1e-9, max_inner_iters=10))
+    part = partition_contiguous(meas, 8)
+    graph, meta = rbcd.build_graph(part, 5, dtype)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, dtype)
+    state0 = rbcd.init_state(graph, meta, X0, params=params)
+    # Host-f64 oracle edges, same as the tuned pipeline (a device-f32
+    # EdgeSet here would put ~8 per-field tunnel readbacks inside every
+    # "verify" phase and misattribute the time).
+    edges_g = edge_set_from_measurements(part.meas_global, dtype=np.float64,
+                                         as_numpy=True)
+    n_total = part.meas_global.num_poses
+
+    # descend 125 rounds to the handoff (warm compile first)
+    state = rbcd.rbcd_steps(state0, graph, 1, meta, params)
+    state = rbcd.rbcd_steps(state, graph, 124, meta, params)
+    Xg64_w = np.asarray(
+        rbcd.gather_to_global(state.X, graph, n_total), np.float64)
+
+    # warm-up: one full recenter + 2 fused rounds + readback
+    ref_w = refine.recenter(Xg64_w, graph, meta, params, edges_g)
+    _ = np.asarray(refine._refine_rounds_accel_jit(
+        jnp.zeros(ref_w.consts.R.shape, jnp.float32),
+        ref_w.consts, graph, meta, params, 2))
+
+    # Timed, phase by phase (mirror solve_refine's single-cycle path)
+    for trial in range(3):
+        t = {}
+        t0 = time.perf_counter()
+
+        t1 = time.perf_counter()
+        Xg64 = np.asarray(
+            rbcd.gather_to_global(state.X, graph, n_total), np.float64)
+        t["X_readback"] = time.perf_counter() - t1
+
+        t1 = time.perf_counter()
+        Xg64p = refine._np_project_manifold(Xg64, meta.d)
+        t["verify_project"] = time.perf_counter() - t1
+
+        t1 = time.perf_counter()
+        f = refine.global_cost(Xg64p, edges_g)
+        t["verify_cost"] = time.perf_counter() - t1
+
+        t1 = time.perf_counter()
+        ref = refine.recenter(Xg64p, graph, meta, params, edges_g,
+                              pre_projected=True, f_ref=f)
+        jax.block_until_ready(ref.consts.Rc)
+        t["recenter_total"] = time.perf_counter() - t1
+
+        t1 = time.perf_counter()
+        D = refine._refine_rounds_accel_jit(
+            jnp.zeros(ref.consts.R.shape, jnp.float32),
+            ref.consts, graph, meta, params, 120)
+        Dnp = np.asarray(D)
+        t["rounds120_and_readback"] = time.perf_counter() - t1
+
+        t1 = time.perf_counter()
+        X64 = refine.global_x(ref, Dnp, graph)
+        X64p = refine._np_project_manifold(X64, meta.d)
+        f2 = refine.global_cost(X64p, edges_g)
+        t["final_verify"] = time.perf_counter() - t1
+
+        t["TOTAL"] = time.perf_counter() - t0
+        print(json.dumps({k: round(v, 4) for k, v in t.items()}))
+
+    # Sub-breakdown of recenter: host build vs device transfers
+    for trial in range(2):
+        t1 = time.perf_counter()
+        ref = refine.recenter(Xg64_w, graph, meta, params, edges_g)
+        host_done = time.perf_counter() - t1
+        jax.block_until_ready(jax.tree.leaves(ref.consts))
+        print(json.dumps({"recenter_host+enqueue": round(host_done, 4),
+                          "recenter_blocked": round(
+                              time.perf_counter() - t1, 4)}))
+
+
+if __name__ == "__main__":
+    main()
