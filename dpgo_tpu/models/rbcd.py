@@ -429,6 +429,14 @@ def precond_chol(graph_edges: EdgeSet, n_max: int, s_max: int,
     return jax.vmap(one)(graph_edges)
 
 
+#: Jitted ``precond_chol`` for HOST-side callers (init_state /
+#: refresh_problem) — eager, the vmapped block build dispatches hundreds of
+#: individual ops, ~90 ms each on a tunneled TPU.  ``_rbcd_round`` calls the
+#: plain function (it already traces under jit).
+precond_chol_jit = jax.jit(precond_chol,
+                           static_argnames=("n_max", "s_max", "params"))
+
+
 #: Dense-Q memory budget: the [A, K, K] buffer Laplacians (K = (d+1)
 #: (n_max + s_max)) must fit comfortably beside the rest of the problem.
 #: 1 GiB covers sphere2500/8 (51 MB f32) through city10000/8 (~900 MB f32
@@ -742,18 +750,41 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
     qbuf = state.Qbuf
     if update_weights:
         edges_r = graph.edges._replace(weight=weights)
-        weights = _gnc_update_weights(X, Z, edges_r, mu, params)
-        mu = robust.gnc_update_mu(mu, params.robust)
+        w_new = _gnc_update_weights(X, Z, edges_r, mu, params)
+        # Weight freeze, ON DEVICE (beyond-reference, see run_rbcd's note on
+        # the robust_opt_num_weight_updates cap): once the GNC inlier/outlier
+        # decision has converged (fraction of LC weights in {0,1} >= the
+        # reference's min ratio over ALL agents — global min, gathered on
+        # the mesh path), further updates would keep annealing mu and flip
+        # borderline edges, destabilizing the now-fixed-weight descent, and
+        # with warm start disabled would keep resetting the iterate.  The
+        # gate mirrors the former host-side check exactly: the ratio is
+        # evaluated on the PRE-update weights, and only from the third
+        # flagged round on (the first two updates always run; `>= 2 updates
+        # before freezing` — the all-ones initialization is trivially
+        # "converged").  A frozen flagged round computes the same values as
+        # a plain round, so freezing is permanent without any host control
+        # flow or readback.
+        ratio_pre = _converged_weight_ratio(edges_r, params)
+        if ratio_pre is None:
+            frozen = jnp.zeros((), bool)
+        else:
+            ordinal = (state.iteration + 1) // params.robust_opt_inner_iters
+            frozen = (ordinal >= 3) & (
+                jnp.min(gather(ratio_pre))
+                >= params.robust_opt_min_convergence_ratio)
+        weights = jnp.where(frozen, weights, w_new)
+        mu = jnp.where(frozen, mu, robust.gnc_update_mu(mu, params.robust))
         if state.X_init is not None:
             # Warm start disabled: reset the iterate to the initial guess
             # BEFORE this round's optimization (PGOAgent.cpp:657-662); the
             # reset X also refreshes the regular neighbor buffer.
-            X = state.X_init
+            X = jnp.where(frozen, X, state.X_init)
             Z = exchange(X)
         if accel:  # initializeAcceleration (PGOAgent.cpp:1054-1063)
-            V = X
-            gamma = jnp.zeros_like(gamma)
-            alpha = jnp.zeros_like(alpha)
+            V = jnp.where(frozen, V, X)
+            gamma = jnp.where(frozen, gamma, jnp.zeros_like(gamma))
+            alpha = jnp.where(frozen, alpha, jnp.zeros_like(alpha))
     edges = graph.edges._replace(weight=weights)
     form = _formulation(meta, params, graph, itemsize=X.dtype.itemsize)
     if form == "dense" and qbuf is None:
@@ -909,6 +940,38 @@ rbcd_steps = jax.jit(_rbcd_rounds, static_argnames=(
     "meta", "params", "axis_name", "shifts"))
 
 
+def _rbcd_segment(state: RBCDState, graph: MultiAgentGraph, num_rounds,
+                  meta: GraphMeta, params: AgentParams,
+                  axis_name: str | None = None,
+                  plan: PPermutePlan | None = None,
+                  shifts: tuple = (),
+                  first_update_weights: bool = False,
+                  first_restart: bool = False) -> RBCDState:
+    """One schedule segment — a (possibly flagged) first round followed by
+    ``num_rounds - 1`` plain rounds — as ONE device dispatch.
+
+    The driver's schedule puts weight-update / Nesterov-restart flags on
+    modularly-scheduled rounds (``run_rbcd``); with plain-only fusion those
+    flagged rounds each cost a separate dispatch (an RPC round-trip on a
+    tunneled TPU) between fused stretches.  Folding the flagged round into
+    the front of its following stretch keeps every segment at exactly one
+    dispatch.  With both flags False this is exactly ``_rbcd_rounds``.
+    ``num_rounds`` is traced; the flags are static (<= 4 compiled variants).
+    """
+    state = _rbcd_round(state, graph, meta, params, axis_name=axis_name,
+                        update_weights=first_update_weights,
+                        restart=first_restart, plan=plan, shifts=shifts)
+    return _rbcd_rounds(state, graph, num_rounds - 1, meta, params,
+                        axis_name=axis_name, plan=plan, shifts=shifts)
+
+
+#: Jitted fused segment (single-device; ``parallel.make_sharded_segment``
+#: is the mesh equivalent).
+rbcd_segment = jax.jit(_rbcd_segment, static_argnames=(
+    "meta", "params", "axis_name", "shifts", "first_update_weights",
+    "first_restart"))
+
+
 # ---------------------------------------------------------------------------
 # Initialization, rounding, and the high-level driver
 # ---------------------------------------------------------------------------
@@ -922,7 +985,7 @@ def init_state(graph: MultiAgentGraph, meta: GraphMeta, X0: jax.Array,
     # Preconditioner factors are baked only when the solver params are
     # known; otherwise the round factors from its live params (the shift
     # must match what the solver was configured with).
-    chol0 = precond_chol(graph.edges, meta.n_max, meta.s_max, params) \
+    chol0 = precond_chol_jit(graph.edges, meta.n_max, meta.s_max, params) \
         if params is not None else None
     qbuf0 = dense_q_all(graph.edges, meta) \
         if _formulation(meta, params, graph,
@@ -958,7 +1021,7 @@ def refresh_problem(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
     and would optimize against the stale (unweighted) problem until the
     next GNC update fires."""
     edges = graph.edges._replace(weight=state.weights)
-    chol = precond_chol(edges, meta.n_max, meta.s_max, params)
+    chol = precond_chol_jit(edges, meta.n_max, meta.s_max, params)
     # Decide the dense buffer from the given params (like init_state does),
     # not from its previous presence — this also (re)creates a missing Qbuf
     # when the caller switched to a dense_quadratic configuration.
@@ -970,15 +1033,24 @@ def refresh_problem(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
     return state._replace(chol=chol, Qbuf=qbuf)
 
 
+@partial(jax.jit, static_argnames=("meta", "n"))
+def _chordal_init_jit(edges_g: EdgeSet, graph: MultiAgentGraph,
+                      meta: GraphMeta, n: int) -> jax.Array:
+    T0 = chordal.chordal_initialization(edges_g, n)
+    X0g = lift(T0, lifting_matrix(meta, T0.dtype))
+    return scatter_to_agents(X0g, graph)
+
+
 def centralized_chordal_init(part: Partition, meta: GraphMeta, graph: MultiAgentGraph,
                              dtype=jnp.float32) -> jax.Array:
     """Centralized chordal init, lifted and scattered to agents — the demo
-    initialization of ``MultiRobotExample.cpp:158-165``."""
+    initialization of ``MultiRobotExample.cpp:158-165``.
+
+    One jitted program: run eagerly, the chordal CG solves alone dispatch
+    thousands of individual device ops — ~105 s on the tunneled TPU for
+    ais2klinik vs ~12 s compiled (and ~0 steady-state)."""
     edges_g = edge_set_from_measurements(part.meas_global, dtype=dtype)
-    T0 = chordal.chordal_initialization(edges_g, part.meas_global.num_poses)
-    ylift = lifting_matrix(meta, dtype)
-    X0g = lift(T0, ylift)
-    return scatter_to_agents(X0g, graph)
+    return _chordal_init_jit(edges_g, graph, meta, part.meas_global.num_poses)
 
 
 def lifting_matrix(meta: GraphMeta, dtype=jnp.float32) -> jax.Array:
@@ -1037,6 +1109,7 @@ def run_rbcd(
     dtype=jnp.float64,
     params: AgentParams | None = None,
     multi_step=None,
+    segment=None,
 ) -> RBCDResult:
     """The driver loop shared by the single-device and mesh-sharded solvers —
     the analog of the ``multi-robot-example`` loop
@@ -1056,22 +1129,47 @@ def run_rbcd(
     between weight-update/restart/eval rounds — instead of once per round,
     which removes the host round-trip that dominates wall-clock on fast
     devices.  Identical math either way (the fused body is ``_rbcd_round``).
+
+    ``segment(state, k, update_weights, restart)``, when given, supersedes
+    both: each dispatch covers a flagged first round AND the plain stretch
+    to the next flag/eval boundary (``rbcd_segment`` / the shard_map
+    equivalent), so flagged rounds stop costing their own round-trips.
+    The GNC weight freeze runs on-device either way (see ``_rbcd_round``),
+    so no path reads weights back between evals.
     """
     n_total = part.meas_global.num_poses
     num_meas = len(part.meas_global)
     edges_g = edge_set_from_measurements(part.meas_global, dtype=dtype)
 
     @jax.jit
-    def central_metrics(Xa, weights):
+    def central_metrics(Xa, weights, ready):
+        # One stacked output = ONE device->host readback per eval (each
+        # separate scalar fetch costs a full round-trip on a tunneled TPU).
         Xg = gather_to_global(Xa, graph, n_total)
         eg = edges_g._replace(weight=global_weights(weights, graph, num_meas))
         f = quadratic.cost(Xg, eg)
         g = manifold.rgrad(Xg, quadratic.egrad(Xg, eg))
-        return f, manifold.norm(g)
+        return jnp.stack([f, manifold.norm(g),
+                          jnp.all(ready).astype(f.dtype)])
 
     robust_on = params is not None and \
         params.robust.cost_type != RobustCostType.L2
     accel_on = params is not None and params.acceleration
+
+    if segment is None:
+        # Legacy callers (step-only, or step + fused plain loop): synthesize
+        # the segment so ONE copy of the schedule-boundary arithmetic below
+        # serves every path.  Identical math — a segment is a flagged round
+        # plus plain rounds.
+        def segment(s, k, uw, rs):
+            s = step(s, uw, rs)
+            if k > 1:
+                if multi_step is not None:
+                    s = multi_step(s, k - 1)
+                else:
+                    for _ in range(k - 1):
+                        s = step(s, False, False)
+            return s
 
     cost_hist, gn_hist = [], []
     terminated_by = "max_iters"
@@ -1092,58 +1190,54 @@ def run_rbcd(
         update_w = updates_remaining and \
             (it + 1) % params.robust_opt_inner_iters == 0
         restart = accel_on and (it + 1) % params.restart_interval == 0
-        if update_w or restart or multi_step is None:
-            num_weight_updates += int(update_w)
-            state = step(state, update_w, restart)
-            it += 1
-            if update_w and num_weight_updates >= 2:
-                # Freeze the weights once the GNC inlier/outlier decision has
-                # converged (fraction of LC weights in {0,1} >= the
-                # reference's min ratio, ``computeConvergedLoopClosure-
-                # Ratio``, PGOAgent.cpp:1247-1289): further updates would
-                # keep annealing mu and flip borderline edges, destabilizing
-                # the now-fixed-weight descent.  >= 2 updates required — the
-                # all-ones initialization is trivially "converged".
-                ratio = _converged_weight_ratio(
-                    graph.edges._replace(weight=state.weights), params)
-                if ratio is not None and float(jnp.min(ratio)) >= \
-                        params.robust_opt_min_convergence_ratio:
-                    robust_on = False
-        else:
-            # Fuse the plain rounds up to (exclusive) the next flagged round
-            # and (inclusive) the next eval boundary into one device call.
-            end = max_iters
-            if updates_remaining:
-                end = min(end, ((it // params.robust_opt_inner_iters) + 1)
-                          * params.robust_opt_inner_iters - 1)
-            if accel_on:
-                end = min(end, ((it // params.restart_interval) + 1)
-                          * params.restart_interval - 1)
-            end = min(max(end, it + 1),
-                      ((it // eval_every) + 1) * eval_every, max_iters)
-            k = end - it
-            state = multi_step(state, k) if k > 1 else step(state, False, False)
-            it = end
+        # The GNC weight freeze (stop updating once the inlier/outlier
+        # decision has converged — ratio of LC weights in {0,1} >= the
+        # reference's min ratio, ``computeConvergedLoopClosureRatio``,
+        # PGOAgent.cpp:1247-1289) is decided ON DEVICE inside the flagged
+        # round (see ``_rbcd_round``): a frozen flagged round computes
+        # exactly a plain round, so the host keeps flagging on the modular
+        # schedule with no weight readback and identical results.
+        # Segment bounds: the plain tail runs to (exclusive) the next
+        # flagged round, capped (inclusive) at the next eval boundary.
+        n0 = it + 1
+        end = max_iters
+        if updates_remaining:
+            end = min(end, (n0 // params.robust_opt_inner_iters + 1)
+                      * params.robust_opt_inner_iters - 1)
+        if accel_on:
+            end = min(end, (n0 // params.restart_interval + 1)
+                      * params.restart_interval - 1)
+        end = min(max(end, n0),
+                  ((n0 - 1) // eval_every + 1) * eval_every, max_iters)
+        num_weight_updates += int(update_w)
+        state = segment(state, end - it, update_w, restart)
+        it = end
         # Host syncs (metrics readback + consensus flag) only every
         # eval_every rounds so device dispatch stays ahead of the host.
         if it % eval_every == 0 or it >= max_iters:
-            f, gn = central_metrics(state.X, state.weights)
+            f, gn, consensus = np.asarray(
+                central_metrics(state.X, state.weights, state.ready))
             cost_hist.append(float(f))
             gn_hist.append(float(gn))
             if float(gn) < grad_norm_tol:
                 terminated_by = "grad_norm"
                 break
-            if bool(jnp.all(state.ready)):
+            if consensus > 0:
                 terminated_by = "consensus"
                 break
 
-    ylift = lifting_matrix(meta, dtype)
-    Xg = gather_to_global(state.X, graph, n_total)
-    T = round_global(Xg, ylift)
+    # Final assembly as one jitted program (eager, the gather + rounding
+    # chain costs ~15 s in per-op dispatches on a tunneled TPU at 15k poses).
+    @jax.jit
+    def _finalize(Xa, weights):
+        Xg = gather_to_global(Xa, graph, n_total)
+        return (round_global(Xg, lifting_matrix(meta, Xg.dtype)),
+                global_weights(weights, graph, num_meas))
+
+    T, w_glob = _finalize(state.X, state.weights)
     return RBCDResult(T=T, X=state.X, cost_history=cost_hist,
                       grad_norm_history=gn_hist, iterations=it,
-                      terminated_by=terminated_by,
-                      weights=global_weights(state.weights, graph, num_meas))
+                      terminated_by=terminated_by, weights=w_glob)
 
 
 def initial_state_for(init: str, part: Partition, meta: GraphMeta,
@@ -1184,6 +1278,9 @@ def solve_rbcd(
     step = lambda s, uw, rs: rbcd_step(s, graph, meta, params,
                                        update_weights=uw, restart=rs)
     multi = lambda s, k: rbcd_steps(s, graph, k, meta, params)
+    seg = lambda s, k, uw, rs: rbcd_segment(s, graph, k, meta, params,
+                                            first_update_weights=uw,
+                                            first_restart=rs)
     return run_rbcd(state, graph, meta, step, part, max_iters,
                     grad_norm_tol, eval_every, dtype, params=params,
-                    multi_step=multi)
+                    multi_step=multi, segment=seg)

@@ -24,6 +24,8 @@ acceptable (SURVEY.md hard-part #6).
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -42,6 +44,7 @@ def _cg(matvec, b, precond, maxiter: int, tol: float):
     return x
 
 
+@partial(jax.jit, static_argnames=("n", "maxiter", "tol"))
 def chordal_rotations(edges: EdgeSet, n: int, maxiter: int = 2000,
                       tol: float = 1e-10) -> jax.Array:
     """Solve the chordal rotation relaxation; returns [n, d, d] in SO(d).
@@ -87,6 +90,7 @@ def chordal_rotations(edges: EdgeSet, n: int, maxiter: int = 2000,
     return project_to_rotation(Rs)
 
 
+@partial(jax.jit, static_argnames=("n", "maxiter", "tol"))
 def recover_translations(edges: EdgeSet, Rs: jax.Array, n: int,
                          maxiter: int = 2000, tol: float = 1e-10) -> jax.Array:
     """Least-squares translations given rotations; returns [n, d], t_0 = 0.
@@ -122,6 +126,7 @@ def recover_translations(edges: EdgeSet, Rs: jax.Array, n: int,
     return _cg(H, b, precond, maxiter, tol)
 
 
+@partial(jax.jit, static_argnames=("n", "maxiter", "tol"))
 def chordal_initialization(edges: EdgeSet, n: int, maxiter: int = 2000,
                            tol: float = 1e-10) -> jax.Array:
     """Full chordal init; returns T [n, d, d+1] = [R_i | t_i] per pose.
@@ -134,6 +139,7 @@ def chordal_initialization(edges: EdgeSet, n: int, maxiter: int = 2000,
     return jnp.concatenate([Rs, ts[..., None]], axis=-1)
 
 
+@partial(jax.jit, static_argnames=("n",))
 def odometry_from_edges(edges: EdgeSet, n: int) -> jax.Array:
     """Select the odometry chain (k -> k+1) out of an arbitrary edge set and
     chain-propagate it; returns T [n, d, d+1].

@@ -158,6 +158,32 @@ def make_sharded_multi_step(mesh: Mesh, meta: GraphMeta, params: AgentParams,
     return steps
 
 
+def make_sharded_segment(mesh: Mesh, meta: GraphMeta, params: AgentParams,
+                         shifts: tuple = (), plan=None):
+    """Compile the fused schedule segment for the mesh path: a (possibly
+    flagged) first round + the plain stretch as one dispatch
+    (``models.rbcd.rbcd_segment``).  ``k`` is traced; the two first-round
+    flags are static (<= 4 compiled variants)."""
+
+    @partial(jax.jit, static_argnames=("update_weights", "restart"))
+    def seg(state: RBCDState, graph: MultiAgentGraph, num_rounds,
+            update_weights: bool = False, restart: bool = False) -> RBCDState:
+        def body(s, g, n, p):
+            return rbcd._rbcd_segment(s, g, n, meta, params, axis_name=AXIS,
+                                      plan=p, shifts=shifts,
+                                      first_update_weights=update_weights,
+                                      first_restart=restart)
+
+        in_specs = (_specs(mesh, state), _specs(mesh, graph), P(),
+                    _specs(mesh, plan))
+        out_specs = _specs(mesh, state)
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs,
+                             check_vma=False)(state, graph, num_rounds, plan)
+
+    return seg
+
+
 def comm_bytes_per_round(meta: GraphMeta, mesh_size: int,
                          shifts: tuple | None = None,
                          accel: bool = False, itemsize: int = 4,
@@ -221,8 +247,11 @@ def solve_rbcd_sharded(
     shifts, plan = _exchange_plan(mesh, meta, graph, exchange)
     sharded_step = make_sharded_step(mesh, meta, params, shifts, plan)
     sharded_multi = make_sharded_multi_step(mesh, meta, params, shifts, plan)
+    sharded_seg = make_sharded_segment(mesh, meta, params, shifts, plan)
     step = lambda s, uw, rs: sharded_step(s, graph, update_weights=uw, restart=rs)
     multi = lambda s, k: sharded_multi(s, graph, k)
+    seg = lambda s, k, uw, rs: sharded_seg(s, graph, k, update_weights=uw,
+                                           restart=rs)
     return rbcd.run_rbcd(state, graph, meta, step, part, max_iters,
                          grad_norm_tol, eval_every, dtype, params=params,
-                         multi_step=multi)
+                         multi_step=multi, segment=seg)

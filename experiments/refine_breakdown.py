@@ -20,7 +20,6 @@ def main():
     import jax.numpy as jnp
     from dpgo_tpu.config import AgentParams, SolverParams
     from dpgo_tpu.models import rbcd, refine
-    from dpgo_tpu.types import edge_set_from_measurements
     from dpgo_tpu.utils.g2o import read_g2o
     from dpgo_tpu.utils.partition import partition_contiguous
 
@@ -37,11 +36,8 @@ def main():
     graph, meta = rbcd.build_graph(part, 5, dtype)
     X0 = rbcd.centralized_chordal_init(part, meta, graph, dtype)
     state0 = rbcd.init_state(graph, meta, X0, params=params)
-    # Host-f64 oracle edges, same as the tuned pipeline (a device-f32
-    # EdgeSet here would put ~8 per-field tunnel readbacks inside every
-    # "verify" phase and misattribute the time).
-    edges_g = edge_set_from_measurements(part.meas_global, dtype=np.float64,
-                                         as_numpy=True)
+    # Host-f64 oracle edges, same as the tuned pipeline.
+    edges_g = refine.host_edges_f64(part.meas_global)
     n_total = part.meas_global.num_poses
 
     # descend 125 rounds to the handoff (warm compile first)

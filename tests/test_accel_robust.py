@@ -153,6 +153,39 @@ def test_gnc_convergence_ratio_gates_consensus(rng):
     assert np.all((w[lc] < 1e-4) | (w[lc] > 1 - 1e-4))
 
 
+def test_gnc_weight_freeze_on_device(rng):
+    """The ratio-gated weight freeze is decided inside the flagged round:
+    once all LC weights sit in {0, 1} and at least two updates have run,
+    a weight-update round must leave weights, mu, and the iterate exactly
+    as a plain round would — and before that ordinal the same converged
+    weights must NOT freeze (the first two updates always run)."""
+    meas, _ = make_measurements(rng, n=20, d=3, num_lc=8, outlier_lc=4)
+    params = robust_params(4, inner_iters=5)
+    part = partition_contiguous(meas, 4)
+    graph, meta = rbcd.build_graph(part, params.r, jnp.float64)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float64)
+    state = rbcd.init_state(graph, meta, X0, params=params)
+
+    # Converged weights (exactly {0,1}): every LC edge decided.
+    w_conv = jnp.where(graph.edges.is_lc > 0,
+                       jnp.round(graph.edges.weight), graph.edges.weight)
+    state = state._replace(weights=w_conv, mu=jnp.asarray(7.0, jnp.float64))
+
+    # Ordinal >= 3 (iteration + 1 = 3 * inner_iters): frozen — the flagged
+    # round equals a plain round on every carried quantity.
+    st3 = state._replace(iteration=jnp.asarray(3 * 5 - 1, jnp.int32))
+    upd = rbcd.rbcd_step(st3, graph, meta, params, update_weights=True)
+    plain = rbcd.rbcd_step(st3, graph, meta, params, update_weights=False)
+    assert np.array_equal(np.asarray(upd.weights), np.asarray(w_conv))
+    assert float(upd.mu) == 7.0
+    assert np.allclose(np.asarray(upd.X), np.asarray(plain.X), atol=1e-12)
+
+    # Ordinal 2: NOT frozen even with converged weights — mu must anneal.
+    st2 = state._replace(iteration=jnp.asarray(2 * 5 - 1, jnp.int32))
+    upd2 = rbcd.rbcd_step(st2, graph, meta, params, update_weights=True)
+    assert float(upd2.mu) > 7.0
+
+
 def test_gnc_warm_start_disabled_resets(rng):
     # Warm start off: X resets to the initial guess after every weight
     # update (reference PGOAgent.cpp:657-662), so each GNC cycle re-solves

@@ -229,23 +229,29 @@ def test_fused_rounds_match_sequential(rng):
 
 
 def test_solver_uses_fused_segments(rng, monkeypatch):
-    """``solve_rbcd`` with ``eval_every > 1`` must route plain stretches
-    through the fused path (dispatch count shrinks) and still converge to the
-    same answer as per-round stepping."""
+    """``solve_rbcd`` with ``eval_every > 1`` must route every stretch
+    through the fused segment path (one dispatch per eval stretch) and
+    still converge to the same answer as per-round stepping."""
     meas, (Rs, ts) = make_measurements(rng, n=20, d=3, num_lc=10)
     params = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.JACOBI)
 
-    calls = {"fused": 0}
-    orig = rbcd.rbcd_steps
+    calls = {"fused": 0, "per_round": 0}
+    orig = rbcd.rbcd_segment
 
     def counting(state, graph, k, *a, **kw):
         calls["fused"] += 1
         return orig(state, graph, k, *a, **kw)
 
-    monkeypatch.setattr(rbcd, "rbcd_steps", counting)
+    def no_step(*a, **kw):
+        calls["per_round"] += 1
+        raise AssertionError("segment-driven solve must not single-step")
+
+    monkeypatch.setattr(rbcd, "rbcd_segment", counting)
+    monkeypatch.setattr(rbcd, "rbcd_step", no_step)
     res = rbcd.solve_rbcd(meas, 4, params, max_iters=60, grad_norm_tol=1e-6,
                           eval_every=10)
     assert calls["fused"] >= 1
+    assert calls["per_round"] == 0
     assert res.grad_norm_history[-1] < 1e-6
     assert trajectory_error(res.T, Rs, ts) < 1e-4
 
