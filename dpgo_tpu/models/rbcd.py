@@ -1176,30 +1176,31 @@ def run_rbcd(
     it = 0
     num_weight_updates = 0
     cap = params.robust_opt_num_weight_updates if params is not None else 0
-    while it < max_iters:
-        # The modular counters of the reference (shouldUpdateLoopClosure-
-        # Weights / shouldRestart, PGOAgent.cpp:1174-1179, 1033-1038) live on
-        # the host: round variants compile branch-free.  Beyond-reference:
-        # weight updates stop after robust_opt_num_weight_updates (<=0 means
-        # unlimited, the reference behavior) — once GNC weights have
-        # converged to {0,1} further updates are no-ops on the weights but,
-        # with warm start disabled, would keep resetting the iterate and
-        # prevent the solve from ever settling; the cap also bounds the
-        # mu <- 1.4 mu growth.
-        updates_remaining = robust_on and (cap <= 0 or num_weight_updates < cap)
-        update_w = updates_remaining and \
-            (it + 1) % params.robust_opt_inner_iters == 0
-        restart = accel_on and (it + 1) % params.restart_interval == 0
-        # The GNC weight freeze (stop updating once the inlier/outlier
-        # decision has converged — ratio of LC weights in {0,1} >= the
-        # reference's min ratio, ``computeConvergedLoopClosureRatio``,
-        # PGOAgent.cpp:1247-1289) is decided ON DEVICE inside the flagged
-        # round (see ``_rbcd_round``): a frozen flagged round computes
-        # exactly a plain round, so the host keeps flagging on the modular
-        # schedule with no weight readback and identical results.
-        # Segment bounds: the plain tail runs to (exclusive) the next
-        # flagged round, capped (inclusive) at the next eval boundary.
-        n0 = it + 1
+
+    def _bounds(n_done, nwu):
+        """Flags for round ``n_done + 1`` and the segment end — the plain
+        tail runs to (exclusive) the next flagged round, capped (inclusive)
+        at the next eval boundary.
+
+        The modular counters of the reference (shouldUpdateLoopClosure-
+        Weights / shouldRestart, PGOAgent.cpp:1174-1179, 1033-1038) live on
+        the host: round variants compile branch-free.  Beyond-reference:
+        weight updates stop after robust_opt_num_weight_updates (<=0 means
+        unlimited, the reference behavior) — without the cap, post-
+        convergence weight updates keep annealing mu (<- 1.4 mu) and, with
+        warm start disabled, keep resetting the iterate to the initial
+        guess, so the solve would never settle.  The GNC
+        ratio freeze itself (computeConvergedLoopClosureRatio semantics,
+        PGOAgent.cpp:1247-1289) is decided ON DEVICE inside the flagged
+        round (see ``_rbcd_round``): a frozen flagged round computes exactly
+        a plain round, so the host keeps flagging on the modular schedule
+        with no weight readback and identical results.
+        """
+        updates_remaining = robust_on and (cap <= 0 or nwu < cap)
+        uw = updates_remaining and \
+            (n_done + 1) % params.robust_opt_inner_iters == 0
+        rs = accel_on and (n_done + 1) % params.restart_interval == 0
+        n0 = n_done + 1
         end = max_iters
         if updates_remaining:
             end = min(end, (n0 // params.robust_opt_inner_iters + 1)
@@ -1209,22 +1210,42 @@ def run_rbcd(
                       * params.restart_interval - 1)
         end = min(max(end, n0),
                   ((n0 - 1) // eval_every + 1) * eval_every, max_iters)
-        num_weight_updates += int(update_w)
-        state = segment(state, end - it, update_w, restart)
-        it = end
-        # Host syncs (metrics readback + consensus flag) only every
-        # eval_every rounds so device dispatch stays ahead of the host.
-        if it % eval_every == 0 or it >= max_iters:
-            f, gn, consensus = np.asarray(
-                central_metrics(state.X, state.weights, state.ready))
-            cost_hist.append(float(f))
-            gn_hist.append(float(gn))
-            if float(gn) < grad_norm_tol:
-                terminated_by = "grad_norm"
-                break
-            if consensus > 0:
-                terminated_by = "consensus"
-                break
+        return uw, rs, end
+
+    # Pipelined driver: advance to each eval boundary, ENQUEUE the metrics
+    # program, dispatch one speculative segment past the boundary, and only
+    # then fetch the metrics — the device works through the speculation
+    # while the readback round-trip (the dominant host cost on a tunneled
+    # TPU) is in flight.  Flags are host-deterministic functions of the
+    # round index, so speculation never changes which rounds are flagged;
+    # a termination at the boundary simply discards the speculative state.
+    spec = None  # (state, it, uw) one segment past the last eval boundary
+    while it < max_iters:
+        target = min(((it // eval_every) + 1) * eval_every, max_iters)
+        if spec is not None:
+            # A spec can only be pending at the top of an outer iteration
+            # (set at the previous eval boundary, exactly one segment ahead).
+            state, it, uw = spec
+            num_weight_updates += int(uw)
+            spec = None
+        while it < target:
+            uw, rs, end = _bounds(it, num_weight_updates)
+            num_weight_updates += int(uw)
+            state = segment(state, end - it, uw, rs)
+            it = end
+        fut = central_metrics(state.X, state.weights, state.ready)
+        if it < max_iters:
+            uw, rs, end = _bounds(it, num_weight_updates)
+            spec = (segment(state, end - it, uw, rs), end, uw)
+        f, gn, consensus = np.asarray(fut)
+        cost_hist.append(float(f))
+        gn_hist.append(float(gn))
+        if float(gn) < grad_norm_tol:
+            terminated_by = "grad_norm"
+            break
+        if consensus > 0:
+            terminated_by = "consensus"
+            break
 
     # Final assembly as one jitted program (eager, the gather + rounding
     # chain costs ~15 s in per-op dispatches on a tunneled TPU at 15k poses).
