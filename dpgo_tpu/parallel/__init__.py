@@ -1,10 +1,12 @@
 """Distributed (device-mesh) execution layer — see ``sharded.py``."""
 
 from .sharded import (AXIS, comm_bytes_per_round, make_mesh,
+                      make_multislice_mesh,
                       make_sharded_multi_step, make_sharded_segment,
                       make_sharded_step, shard_problem, solve_rbcd_sharded)
 
 __all__ = ["AXIS", "comm_bytes_per_round", "make_mesh",
+           "make_multislice_mesh",
            "make_sharded_multi_step", "make_sharded_segment",
            "make_sharded_step", "shard_problem",
            "solve_rbcd_sharded"]

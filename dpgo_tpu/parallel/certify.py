@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from ..ops import manifold, quadratic
 from ..models import rbcd
 from ..models.rbcd import MultiAgentGraph
-from .sharded import AXIS, _specs, make_mesh  # noqa: F401  (re-export mesh)
+from .sharded import AXIS, _axes, _specs, make_mesh  # noqa: F401  (re-export mesh)
 
 
 def _egrad_local(V, Vz, graph: MultiAgentGraph):
@@ -214,13 +214,13 @@ def make_sharded_certificate(mesh, num_probe: int = 4,
 
     @partial(jax.jit, static_argnames=())
     def cert(X, graph: MultiAgentGraph, key):
-        body = partial(_certificate_shard, axis_name=AXIS,
+        body = partial(_certificate_shard, axis_name=_axes(mesh),
                        num_probe=num_probe, power_iters=power_iters,
                        sub_iters=sub_iters)
         in_specs = (_specs(mesh, X), _specs(mesh, graph),
                     jax.sharding.PartitionSpec())
         from jax.sharding import PartitionSpec as P
-        out_specs = (P(), P(), P(), P(AXIS))
+        out_specs = (P(), P(), P(), P(_axes(mesh)))
         return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs,
                              check_vma=False)(X, graph, key)

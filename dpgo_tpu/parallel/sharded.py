@@ -6,7 +6,9 @@ is supplied externally (in-process calls in ``examples/MultiRobotExample.cpp``,
 ROS pub/sub in ``dpgo_ros``).  Here the transport is the device mesh itself:
 
 * agents = shards of a 1-D mesh axis ``"agent"`` (several agents per device
-  when ``num_robots > mesh size``);
+  when ``num_robots > mesh size``), or of the flattened ``("dcn", "ici")``
+  product axis of a multi-slice mesh (``make_multislice_mesh`` — BASELINE
+  config #5's 64-agents-across-slices deployment);
 * public-pose exchange (``getSharedPoseDict`` -> ``updateNeighborPoses``,
   reference ``PGOAgent.cpp:95-105``, ``434-458``) = one ``all_gather`` of the
   padded public-pose table over ICI (DCN across slices — same code);
@@ -16,9 +18,11 @@ ROS pub/sub in ``dpgo_ros``).  Here the transport is the device mesh itself:
 * the lifting matrix / global anchor broadcast
   (``MultiRobotExample.cpp:139-146``, ``258-263``) = replicated arrays.
 
-The per-shard round body is ``models.rbcd._rbcd_round`` with
-``axis_name="agent"`` — identical math to the single-device path, so the
-sharded and unsharded solvers agree bitwise up to XLA reduction order.
+The per-shard round body is ``models.rbcd._rbcd_round`` with ``axis_name``
+set to the mesh's full axis-name tuple (``("agent",)``, or
+``("dcn", "ici")`` on a multi-slice mesh) — identical math to the
+single-device path, so the sharded and unsharded solvers agree bitwise up
+to XLA reduction order.
 """
 
 from __future__ import annotations
@@ -58,11 +62,34 @@ def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(devices, (AXIS,))
 
 
+def make_multislice_mesh(num_slices: int, devices=None) -> Mesh:
+    """A 2-D ``("dcn", "ici")`` mesh: ``num_slices`` TPU slices (DCN edges
+    between them) x devices-per-slice (ICI within).  Agents shard over the
+    flattened product axis; XLA routes each hop of the pose-exchange
+    collective over the interconnect that actually links the devices — the
+    multi-slice deployment of SURVEY.md section 2.4 / BASELINE config #5
+    (64 agents across slices).  On real multi-slice hardware pass the
+    devices in slice-major order (``jax.devices()`` already is); under the
+    virtual CPU mesh the axis split exercises the identical program.
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if devices.size % num_slices != 0:
+        raise ValueError(
+            f"{devices.size} devices do not split into {num_slices} slices")
+    return Mesh(devices.reshape(num_slices, -1), ("dcn", "ici"))
+
+
+def _axes(mesh: Mesh) -> tuple:
+    """All mesh axis names — the agent axis is their flattened product."""
+    return tuple(mesh.axis_names)
+
+
 def _specs(mesh: Mesh, tree):
     """PartitionSpec pytree: leading axis over agents for [A, ...] arrays,
     replicated for scalars."""
+    ax = _axes(mesh)
     def spec(x):
-        return P(AXIS) if jnp.ndim(x) >= 1 else P()
+        return P(ax) if jnp.ndim(x) >= 1 else P()
     return jax.tree.map(spec, tree)
 
 
@@ -98,10 +125,15 @@ def _exchange_plan(mesh: Mesh, meta: GraphMeta, graph: MultiAgentGraph,
         return (), None
     if exchange != "ppermute":
         raise ValueError(f"unknown exchange backend {exchange!r}")
+    if len(_axes(mesh)) > 1:
+        raise ValueError(
+            "ppermute exchange plans device-ring shifts over a 1-D mesh; "
+            "use exchange='all_gather' on a multi-slice mesh (XLA routes "
+            "each gather hop over the linking interconnect)")
     shifts, plan = rbcd.plan_ppermute(graph, meta.num_robots,
                                       mesh.devices.size)
     plan = jax.tree.map(
-        lambda x: jax.device_put(x, NamedSharding(mesh, P(AXIS))), plan)
+        lambda x: jax.device_put(x, NamedSharding(mesh, P(_axes(mesh)))), plan)
     return shifts, plan
 
 
@@ -120,7 +152,7 @@ def make_sharded_step(mesh: Mesh, meta: GraphMeta, params: AgentParams,
              update_weights: bool = False, restart: bool = False) -> RBCDState:
         def body(s, g, p):
             return rbcd._rbcd_round(s, g, meta=meta, params=params,
-                                    axis_name=AXIS,
+                                    axis_name=_axes(mesh),
                                     update_weights=update_weights,
                                     restart=restart, plan=p, shifts=shifts)
 
@@ -145,7 +177,7 @@ def make_sharded_multi_step(mesh: Mesh, meta: GraphMeta, params: AgentParams,
     @jax.jit
     def steps(state: RBCDState, graph: MultiAgentGraph, num_rounds) -> RBCDState:
         def body(s, g, n, p):
-            return rbcd._rbcd_rounds(s, g, n, meta, params, axis_name=AXIS,
+            return rbcd._rbcd_rounds(s, g, n, meta, params, axis_name=_axes(mesh),
                                      plan=p, shifts=shifts)
 
         in_specs = (_specs(mesh, state), _specs(mesh, graph), P(),
@@ -169,7 +201,7 @@ def make_sharded_segment(mesh: Mesh, meta: GraphMeta, params: AgentParams,
     def seg(state: RBCDState, graph: MultiAgentGraph, num_rounds,
             update_weights: bool = False, restart: bool = False) -> RBCDState:
         def body(s, g, n, p):
-            return rbcd._rbcd_segment(s, g, n, meta, params, axis_name=AXIS,
+            return rbcd._rbcd_segment(s, g, n, meta, params, axis_name=_axes(mesh),
                                       plan=p, shifts=shifts,
                                       first_update_weights=update_weights,
                                       first_restart=restart)

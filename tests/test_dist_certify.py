@@ -49,6 +49,20 @@ def test_sharded_certificate_matches_centralized(rng):
     assert cd.certified == c.certified
 
 
+def test_sharded_certificate_multislice_mesh(rng):
+    """The distributed certificate runs unchanged over a 2-D ("dcn","ici")
+    multi-slice mesh — the collectives span the flattened product axis."""
+    from dpgo_tpu.parallel.sharded import make_multislice_mesh
+
+    meas, _ = make_measurements(rng, n=48, d=3, num_lc=24,
+                                rot_noise=0.01, trans_noise=0.01)
+    state, graph, meta, part, Xg, edges_g = _setup(meas, 8, 5, rounds=150)
+    c = certify.certify_solution(Xg, edges_g)
+    cd = dcert.certify_sharded(state.X, graph, mesh=make_multislice_mesh(2))
+    assert abs(cd.lambda_min - c.lambda_min) < 1e-3 * max(1.0, c.sigma)
+    assert cd.certified == c.certified
+
+
 def test_sharded_certificate_detects_suboptimality():
     """Uncertified case: the classic winding-cycle local minimum (rank-2
     critical point of an identity cycle, test_certify.py) partitioned over
