@@ -827,34 +827,72 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
     # tCG formulation resolution (``form`` resolved above, before the
     # factor refresh): forced Pallas > explicit dense-Q > Pallas auto (TPU)
     # > ELL edge path.
-    if form == "pallas":
-        interp = jax.default_backend() != "tpu"
-        # inc rides along for the start-point gradient (gather-only ELL);
-        # the full RTR step runs in the VMEM kernel.
-        X_upd, gn0 = jax.vmap(
-            lambda x, z, e, c, s, m, ii, ij, rc, tc: _agent_update(
-                x, z, e, params, c, inc=(s, m),
-                pallas=(ii, ij, rc, tc, interp)))(
-            start, Zuse, edges, chol, graph.inc_slot, graph.inc_mask,
-            graph.eidx_i, graph.eidx_j, graph.rot_t, graph.trn_t)
-    elif form == "dense":  # qbuf presence enforced above
-        X_upd, gn0 = jax.vmap(
-            lambda x, z, e, c, q: _agent_update(x, z, e, params, c, qbuf=q))(
-            start, Zuse, edges, chol, qbuf)
-    else:
-        X_upd, gn0 = jax.vmap(
-            lambda x, z, e, c, s, m: _agent_update(x, z, e, params, c,
-                                                   inc=(s, m)))(
-            start, Zuse, edges, chol, graph.inc_slot, graph.inc_mask)
+    interp = jax.default_backend() != "tpu"
+
+    def _update_one(x, z, e, c, s, m, ii=None, ij=None, rc=None, tc=None,
+                    q=None):
+        """Formulation-dispatched single-agent solve (vmapped below, or
+        called once on dynamically-sliced inputs by the greedy path)."""
+        if form == "pallas":
+            # inc rides along for the start-point gradient (gather-only
+            # ELL); the full RTR step runs in the VMEM kernel.
+            return _agent_update(x, z, e, params, c, inc=(s, m),
+                                 pallas=(ii, ij, rc, tc, interp))
+        if form == "dense":  # qbuf presence enforced above
+            return _agent_update(x, z, e, params, c, qbuf=q)
+        return _agent_update(x, z, e, params, c, inc=(s, m))
+
+    def _solve_all(take=lambda t: t):
+        """Per-agent solves over (a selection of) the batch axis."""
+        args = [take(t) for t in (start, Zuse, edges, chol, graph.inc_slot,
+                                  graph.inc_mask)]
+        kw = {}
+        if form == "pallas":
+            kw = dict(zip("ii ij rc tc".split(),
+                          (take(t) for t in (graph.eidx_i, graph.eidx_j,
+                                             graph.rot_t, graph.trn_t))))
+        elif form == "dense":
+            kw = dict(q=take(qbuf))
+        return args, kw
 
     schedule = params.schedule
     split = jax.vmap(lambda k: jax.random.split(k, 2))(state.key)  # [A, 2, 2]
     key, sub = split[:, 0], split[:, 1]
+    if schedule == Schedule.GREEDY:
+        # One agent fires per round (the reference driver's argmax-gradnorm
+        # selection, ``MultiRobotExample.cpp:242-256``).  Solving every
+        # block and masking all but one would burn A x the needed work
+        # (the round-1/2 behavior); instead a cheap selection pass (ONE
+        # edge sweep per agent: Riemannian gradient norm at the start
+        # point — the same quantity the solver reports as gn0) picks the
+        # agent, and each device solves only its local slot of the argmax
+        # (the non-owners' solves are masked out by ``fired``; n_dev
+        # solves total instead of A).
+        def gn_of(x, z, e, s, m):
+            buf = jnp.concatenate([x, z], axis=0)
+            g = manifold.rgrad(x, quadratic.egrad_ell(buf, e, s, m))
+            return manifold.norm(g)
+
+        gn0 = jax.vmap(gn_of)(start, Zuse, edges, graph.inc_slot,
+                              graph.inc_mask)
+        sel = jnp.argmax(gather(gn0))
+        li = (sel % A_loc).astype(jnp.int32)  # local slot on every shard
+        take1 = lambda t: jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, li, 0, keepdims=False),
+            t)
+        args1, kw1 = _solve_all(take1)
+        upd1, _ = _update_one(*args1, **kw1)
+        X_upd = jax.lax.dynamic_update_index_in_dim(start, upd1, li, 0)
+    else:
+        args, kw = _solve_all()
+        X_upd, gn0 = jax.vmap(
+            lambda *a: _update_one(*a[:6], **dict(zip(kw.keys(), a[6:]))))(
+            *args, *kw.values())
+
     if schedule == Schedule.JACOBI:
         fired = jnp.ones((A_loc,), bool)
     elif schedule == Schedule.GREEDY:
-        gn_all = gather(gn0)
-        fired = agent_ids == jnp.argmax(gn_all)
+        fired = agent_ids == sel
     elif schedule == Schedule.ASYNC:
         fired = jax.vmap(
             lambda k: jax.random.bernoulli(k, params.async_update_prob))(sub)
