@@ -1,5 +1,6 @@
 """Multi-agent sync RBCD tests (reference multi-robot-example semantics)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -92,6 +93,40 @@ def test_rbcd_converges_noiseless(rng, schedule):
     res = rbcd.solve_rbcd(meas, 4, params, max_iters=200, grad_norm_tol=1e-6)
     assert res.grad_norm_history[-1] < 1e-6
     assert trajectory_error(res.T, Rs, ts) < 1e-4
+
+
+def test_greedy_updates_exactly_one_agent_per_round(rng):
+    """The gated greedy path (single dynamic-sliced solve instead of A
+    masked solves) must still change exactly one agent's block per round,
+    and that agent must be the argmax-gradnorm one the reference driver
+    selects (MultiRobotExample.cpp:242-256)."""
+    meas, _ = make_measurements(rng, n=20, d=3, num_lc=10,
+                                rot_noise=0.02, trans_noise=0.02)
+    params = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.GREEDY)
+    part = partition_contiguous(meas, 4)
+    graph, meta = rbcd.build_graph(part, params.r, jnp.float64)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float64)
+    state = rbcd.init_state(graph, meta, X0, params=params)
+
+    for _ in range(3):
+        # Expected selection: per-agent Riemannian gradnorm at X.
+        Z = rbcd.neighbor_buffer(rbcd.public_table(state.X, graph), graph)
+
+        def gn_of(x, z, e, s, m):
+            buf = jnp.concatenate([x, z], axis=0)
+            return manifold.norm(
+                manifold.rgrad(x, quadratic.egrad_ell(buf, e, s, m)))
+
+        gn = jax.vmap(gn_of)(state.X, Z, graph.edges, graph.inc_slot,
+                             graph.inc_mask)
+        expect = int(jnp.argmax(gn))
+
+        new = rbcd.rbcd_step(state, graph, meta, params)
+        changed = [a for a in range(4)
+                   if not np.allclose(np.asarray(new.X[a]),
+                                      np.asarray(state.X[a]), atol=0)]
+        assert changed == [expect]
+        state = new
 
 
 def test_rbcd_matches_centralized_on_noisy_graph(rng):
