@@ -3,7 +3,11 @@
 The north-star target (BASELINE.md) is stated two ways: RBCD rounds/sec
 (``bench.py``, the driver metric) and **time-to-1e-6 relative
 suboptimality at matching certified gap** — this script measures the
-second on sphere2500 with 8 agents, r=5:
+second.  Default configuration is the north-star config #2 (sphere2500,
+8 agents, r=5); env-overridable: ``BENCH_DATASET`` (any .g2o path),
+``BENCH_ROBOTS``, ``BENCH_RANK``, ``BENCH_SCHEDULE`` (jacobi | colored |
+greedy | async), ``BENCH_CPU=1`` runs the f64 CPU comparison arm of the
+SAME pipeline.  Protocol:
 
 1. Establish the certified optimum f* once: a centralized float64 CPU
    solve driven to gradnorm <= 1e-9, certified by the dual-certificate
@@ -16,10 +20,9 @@ second on sphere2500 with 8 agents, r=5:
    block_until_ready cannot be trusted on the tunneled platform).
 
 Prints one JSON line:
-  {# "1e-06" -> "1e-6": keep the historical metric key for default runs
-        "metric": "time_to_%s_subopt_sphere2500_8agents_r5"
-                  % f"{REL_GAP:.0e}".replace("e-0", "e-"), "value": <s>,
-   "unit": "s", "rounds": N, "f_opt": ..., "certified": true}
+  {"metric": "time_to_<gap>_subopt_<dataset>_<A>agents_r<r>"
+        (gap spelled "1e-6"-style — the historical key for default runs),
+   "value": <s>, "unit": "s", "rounds": N, "f_opt": ..., "certified": true}
 """
 
 from __future__ import annotations
@@ -32,9 +35,18 @@ from typing import NamedTuple
 
 import numpy as np
 
-DATASET = "/root/reference/data/sphere2500.g2o"
-NUM_ROBOTS = 8
-RANK = 5
+# Dataset / partition are env-overridable so the same certified-gap
+# protocol runs on any benchmark graph (default: the north-star config #2).
+DATASET = os.environ.get("BENCH_DATASET",
+                         "/root/reference/data/sphere2500.g2o")
+NUM_ROBOTS = int(os.environ.get("BENCH_ROBOTS", "8"))
+RANK = int(os.environ.get("BENCH_RANK", "5"))
+# Schedule: any Schedule enum value; "jacobi" is the north-star config's
+# default, "colored" the stable choice for graphs where simultaneous
+# adjacent-block updates oscillate (the ais2klinik/parking-garage failure
+# mode, BASELINE.md).
+SCHEDULE = os.environ.get("BENCH_SCHEDULE", "jacobi")
+_DSET = os.path.splitext(os.path.basename(DATASET))[0]
 REL_GAP = float(os.environ.get("BENCH_REL_GAP", "1e-6"))
 # Each eval is a device->host readback (~50-90 ms on the tunnel), so the
 # cadence is a real cost: 50 keeps 2-3 evals on the path to the handoff.
@@ -47,9 +59,8 @@ MAX_ROUNDS = int(os.environ.get("BENCH_MAX_ROUNDS", "4000"))
 ACCEL = os.environ.get("BENCH_ACCEL", "1") == "1"
 RESTART_INTERVAL = int(os.environ.get("BENCH_RESTART", "100"))
 # Refine: accelerated cycles (adaptive restart) — one long cycle replaces
-# several recenter round-trips (measured: 200 rounds take 5.9e-5 -> 4e-7).
-# 0 = adaptive: 120 rounds when the handoff gap needs ~1 decade, 200 when
-# it needs two.
+# several recenter round-trips.  0 = adaptive: cycle length proportional
+# to the decades of gap to cover (~73 rounds/decade measured), see main().
 REFINE_ROUNDS = int(os.environ.get("BENCH_REFINE_ROUNDS", "0"))
 # First descent segment before the first (expensive: ~90 ms tunnel
 # readback) cost eval.  The accelerated descent crosses 1e-4 at ~105-125
@@ -66,7 +77,7 @@ def log(*a):
 def certified_optimum():
     """f* from a float64 centralized solve + dual certificate (cached)."""
     cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         ".bench_fopt_sphere2500.json")
+                         f".bench_fopt_{_DSET}_r{RANK}.json")
     if os.path.exists(cache):
         with open(cache) as f:
             d = json.load(f)
@@ -133,7 +144,7 @@ def _build_problem(dtype, init: str = "chordal") -> BenchProblem:
     descent ran."""
     import jax
     import jax.numpy as jnp
-    from dpgo_tpu.config import AgentParams, SolverParams
+    from dpgo_tpu.config import AgentParams, Schedule, SolverParams
     from dpgo_tpu.models import rbcd
     from dpgo_tpu.ops import quadratic
     from dpgo_tpu.types import edge_set_from_measurements
@@ -142,8 +153,9 @@ def _build_problem(dtype, init: str = "chordal") -> BenchProblem:
 
     meas = read_g2o(DATASET)
     params = AgentParams(
-        d=3, r=RANK, num_robots=NUM_ROBOTS, rel_change_tol=0.0,
+        d=meas.d, r=RANK, num_robots=NUM_ROBOTS, rel_change_tol=0.0,
         acceleration=ACCEL, restart_interval=RESTART_INTERVAL,
+        schedule=Schedule(SCHEDULE),
         # Drive the local solves tight: the reference's per-step budget
         # (tol 1e-2) caps achievable global suboptimality far above 1e-6.
         solver=SolverParams(grad_norm_tol=1e-9, max_inner_iters=10))
@@ -245,6 +257,12 @@ def main():
         return
 
     import jax
+    if os.environ.get("BENCH_CPU") == "1":
+        # The f64 CPU comparison arm.  The env JAX_PLATFORMS=cpu alone is
+        # not enough on this image (sitecustomize force-registers the
+        # tunnel platform); pin in code like bench.py's BENCH_MODE=cpu.
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_x64", True)
     import jax.numpy as jnp
 
     f_opt, certified = certified_optimum()
@@ -355,10 +373,17 @@ def main():
             _ = np.asarray(refine_mod._refine_rounds_accel_jit(
                 jnp2.zeros(ref_w.consts.R.shape, jnp2.float32),
                 ref_w.consts, graph, meta, params, 2))
-            # Adaptive cycle length: ~1 decade of gap to cover -> 120
-            # accelerated rounds suffice (measured 59x per 100 rounds);
-            # two decades -> the full 200.
-            rpc = REFINE_ROUNDS or (120 if f <= f_opt * (1 + 2e-5) else 200)
+            # Adaptive cycle length, proportional to the decades of gap to
+            # cover: the accelerated refine contracts ~1 decade per ~73
+            # rounds (measured on sphere2500: 120 rounds took 1.38e-5 ->
+            # 2.97e-7, 1.66 decades); target 0.3x the requested gap so a
+            # single cycle lands with margin, and the per-cycle f64 verify
+            # + extra-cycle fallback catches problems that contract slower.
+            import math
+            decades = math.log10(max(f / f_opt - 1.0, REL_GAP)
+                                 / (REL_GAP * 0.3))
+            rpc = REFINE_ROUNDS or int(min(max(round(73 * decades), 40),
+                                           220))
             t_r = time.perf_counter()
             _X64, rgap, cycles, hist = refine_mod.solve_refine(
                 Xg64, graph, meta, params, edges_oracle, f_opt,
@@ -429,8 +454,9 @@ def main():
                 os.unlink(path)
     print(json.dumps({
         # "1e-06" -> "1e-6": keep the historical metric key for default runs
-        "metric": "time_to_%s_subopt_sphere2500_8agents_r5"
-                  % f"{REL_GAP:.0e}".replace("e-0", "e-"),
+        "metric": "time_to_%s_subopt_%s_%dagents_r%d"
+                  % (f"{REL_GAP:.0e}".replace("e-0", "e-"),
+                     _DSET, NUM_ROBOTS, RANK),
         "value": round(reached, 3) if reached is not None else None,
         "unit": "s",
         "rounds": rounds,
