@@ -183,23 +183,20 @@ def _build_problem(dtype, init: str = "chordal") -> BenchProblem:
 
 def advance(rbcd, graph, meta, params, state, it, k):
     """Run ``k`` rounds from round-count ``it``, honoring the Nesterov
-    restart cadence (restart rounds are single dispatches, the stretches
-    between are fused — the run_rbcd segmentation, inlined so the bench
-    keeps its ladder-timing loop)."""
+    restart cadence — one ``rbcd_segment`` dispatch per stretch, with a
+    restart round fused into the front of its following stretch (the
+    run_rbcd segmentation, inlined so the bench keeps its ladder-timing
+    loop; on a tunneled device each extra dispatch costs real latency)."""
     end = it + k
     while it < end:
-        if ACCEL and (it + 1) % RESTART_INTERVAL == 0:
-            state = rbcd.rbcd_step(state, graph, meta, params,
-                                   update_weights=False, restart=True)
-            it += 1
-            continue
+        restart = ACCEL and (it + 1) % RESTART_INTERVAL == 0
         nxt = end
         if ACCEL:
-            nxt = min(nxt, ((it // RESTART_INTERVAL) + 1)
+            nxt = min(nxt, ((it + 1) // RESTART_INTERVAL + 1)
                       * RESTART_INTERVAL - 1)
-        kk = max(1, nxt - it)
-        state = rbcd.rbcd_steps(state, graph, kk, meta, params)
-        it += kk
+        state = rbcd.rbcd_segment(state, graph, max(1, nxt - it), meta,
+                                  params, first_restart=restart)
+        it = nxt
     return state, it
 
 
@@ -225,10 +222,11 @@ def polish_main():
     X0 = jnp.asarray(data["X"], jnp.float64)
     state = rbcd.init_state(graph, meta, X0, params=params)
 
-    _ = float(cost_of(rbcd.rbcd_steps(state, graph, 1, meta, params)))  # compile
-    if ACCEL:  # the restart-round variant compiles separately (see main)
-        _ = rbcd.rbcd_step(state, graph, meta, params,
-                           update_weights=False, restart=True)
+    _ = float(cost_of(rbcd.rbcd_segment(
+        state, graph, 1, meta, params, first_restart=False)))  # compile
+    if ACCEL:  # the restart-first variant compiles separately (see main)
+        _ = rbcd.rbcd_segment(state, graph, 1, meta, params,
+                              first_restart=True)
     state = rbcd.init_state(graph, meta, X0, params=params)
 
     f = float(cost_of(state))  # also covers MAX_ROUNDS < 5 (loop never runs)
@@ -293,13 +291,17 @@ def main():
             return refine_mod.global_cost(Xg64, edges_oracle), Xg64
         return float(cost_of(s)), None
 
-    # Warm-up: compile the fused step, the restart-round variant (hit at
-    # every RESTART_INTERVAL boundary — compiling it inside the timed loop
-    # once cost ~2.9 s), and the cost eval, all outside the clock.
-    state = rbcd.rbcd_steps(state0, graph, 1, meta, params)
+    # Warm-up: compile both segment variants (plain and restart-first —
+    # compiling the restart variant inside the timed loop once cost
+    # ~2.9 s) and the cost eval, all outside the clock.  The calls MUST
+    # match advance()'s exact call pattern (explicit first_restart kwarg):
+    # jit re-traces for a different bound-argument structure even when the
+    # value equals the default, which once cost ~1.5 s inside the clock.
+    state = rbcd.rbcd_segment(state0, graph, 1, meta, params,
+                              first_restart=False)
     if ACCEL:
-        _ = rbcd.rbcd_step(state, graph, meta, params,
-                           update_weights=False, restart=True)
+        _ = rbcd.rbcd_segment(state, graph, 1, meta, params,
+                              first_restart=True)
     _ = eval_state(state)
 
     # Ladder of relative gaps: record the first crossing time of each, so
