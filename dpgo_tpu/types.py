@@ -114,6 +114,20 @@ class EdgeSet(NamedTuple):
         return self.R.shape[-1]
 
 
+def loop_closure_mask(meas: Measurements) -> np.ndarray:
+    """Bool mask of loop closures: an edge is odometry iff same robot and
+    consecutive indices (the partitioning convention of
+    ``MultiRobotExample.cpp:104-113``); everything else is a loop closure.
+
+    Note this is the GLOBAL-indexing convention.  After partitioning,
+    globally-consecutive edges that span a robot boundary become *shared*
+    edges (``Partition.classify``) and are GNC-reweightable like any loop
+    closure — so rejection metrics must not assume weights outside this
+    mask are untouched (see ``utils.synthetic.rejection_scores``).
+    """
+    return ~((meas.r1 == meas.r2) & (meas.p1 + 1 == meas.p2))
+
+
 def edge_set_from_measurements(
     meas: Measurements,
     tail_index: np.ndarray | None = None,
@@ -139,9 +153,7 @@ def edge_set_from_measurements(
     ti = np.asarray(meas.p1 if tail_index is None else tail_index, np.int32)
     hi = np.asarray(meas.p2 if head_index is None else head_index, np.int32)
     if is_lc is None:
-        # Default: an edge is odometry iff same robot and consecutive indices
-        # (partitioning convention of MultiRobotExample.cpp:104-113).
-        is_lc = ~((meas.r1 == meas.r2) & (meas.p1 + 1 == meas.p2))
+        is_lc = loop_closure_mask(meas)
     is_lc = np.asarray(is_lc, bool)
 
     n_pad = (pad_to or m) - m

@@ -98,6 +98,72 @@ def make_measurements(rng, n, d=3, num_lc=5, rot_noise=0.0, trans_noise=0.0,
     return meas, (Rs, ts)
 
 
+def corrupt_loop_closures(meas: Measurements, fraction: float, rng=None,
+                          seed: int = 0):
+    """Replace a random ``fraction`` of the loop closures with gross
+    outliers (the GNC-paper corruption protocol).
+
+    The reference's GNC machinery (``src/DPGO_robust.cpp:23-103``,
+    ``src/PGOAgent.cpp:1181-1245``) exists to survive corrupted loop
+    closures, but its repo ships no corrupted datasets or injection
+    protocol — this is the standard one used by the robust-PGO
+    literature: keep odometry trusted, pick round(fraction * num_lc)
+    loop closures uniformly at random, and overwrite each with a
+    uniformly random rotation and a random translation at the scale of
+    the trajectory's own extent (so the outliers are gross but not
+    astronomically out of distribution; precisions are kept, as the
+    corrupted edge still CLAIMS the dataset noise model).
+
+    ``meas`` must be globally indexed (as from ``read_g2o``).  Returns
+    ``(corrupted, outlier_idx)`` where ``outlier_idx`` are the global
+    measurement indices that were overwritten — the ground truth for
+    precision/recall scoring of GNC edge rejection.
+    """
+    from dpgo_tpu.types import loop_closure_mask
+
+    rng = rng or np.random.default_rng(seed)
+    d = meas.d
+    lc_idx = np.flatnonzero(loop_closure_mask(meas))
+    k = int(round(fraction * lc_idx.size))
+    outlier_idx = np.sort(rng.choice(lc_idx, size=k, replace=False))
+
+    out = meas.select(np.arange(len(meas)))  # fancy indexing copies every field
+    out.weight = np.ones(len(meas))
+    if k:
+        out.R[outlier_idx] = _project_rotations_np(
+            rng.standard_normal((k, d, d)))
+        # Translation scale from the data itself: outlier norms uniform in
+        # [0, 2 * the 95th-percentile measured translation norm].
+        scale = 2.0 * float(np.percentile(np.linalg.norm(meas.t, axis=1), 95))
+        dirs = rng.standard_normal((k, d))
+        dirs /= np.maximum(np.linalg.norm(dirs, axis=1, keepdims=True), 1e-12)
+        out.t[outlier_idx] = dirs * rng.uniform(0.0, scale, (k, 1))
+    return out, outlier_idx
+
+
+def rejection_scores(weights: np.ndarray, meas: Measurements,
+                     outlier_idx: np.ndarray, thresh: float = 0.5):
+    """Precision/recall of GNC edge rejection against injected ground truth.
+
+    ``weights`` are final per-measurement GNC weights ([M], as in
+    ``RBCDResult.weights``); an edge is *rejected* when its weight falls
+    below ``thresh``.  ALL edges count, not just the global loop-closure
+    mask: interior odometry keeps weight 1 by construction, but
+    globally-consecutive edges that span a robot boundary are shared
+    edges the solver CAN reweight (``types.loop_closure_mask`` note) —
+    a false rejection there must count against precision.
+    Returns ``(precision, recall, n_rejected)``.
+    """
+    rejected = np.asarray(weights) < thresh
+    truth = np.zeros(len(meas), bool)
+    truth[outlier_idx] = True
+    tp = int(np.sum(rejected & truth))
+    n_rej = int(np.sum(rejected))
+    precision = tp / n_rej if n_rej else 1.0
+    recall = tp / truth.sum() if truth.any() else 1.0
+    return precision, recall, n_rej
+
+
 def trajectory_error(T, Rs, ts):
     """Max pose error of T [n, d, d+1] vs ground truth, after aligning
     pose 0 (gauge)."""

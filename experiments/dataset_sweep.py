@@ -86,6 +86,7 @@ def one(fname, A, r, rounds):
     # warm-up compile, then timed fused segments with a mid eval
     state = rbcd.rbcd_steps(state, graph, 1, meta, params)
     costs = [f0]
+    f, gn = f0, gn0  # in case rounds <= 1 skips the eval loop entirely
     t0 = time.perf_counter()
     done = 1
     while done < rounds:

@@ -1,0 +1,191 @@
+"""Corrupted-data GNC robustness benchmark (VERDICT r3 item 3).
+
+Protocol (the GNC-paper one, via ``utils.synthetic.corrupt_loop_closures``):
+inject 10/20/40% random gross-outlier loop closures into sphere2500 and
+city10000, run the robust GNC_TLS pipeline on the default backend (TPU),
+and report
+
+* edge-rejection precision / recall against the injected ground truth,
+* the final iterate's cost on the CLEAN (pre-corruption) edge set,
+  relative to the outlier-free optimum f* (centralized f64 solve, cached),
+* wall clock and rounds.
+
+This is the first at-scale demonstration that the GNC machinery
+(reference ``src/DPGO_robust.cpp:23-103``, ``src/PGOAgent.cpp:1181-1245``)
+does its actual job — the reference repo ships no corrupted datasets and
+its shipped benchmarks are outlier-free (city10000's weights all converge
+to 1; BASELINE.md round-2 table).
+
+Usage: python experiments/gnc_corruption.py [--quick]
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+DATA = "/root/reference/data"
+CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".fopt_cache.json")
+
+# (file, agents, rank, rounds) — 3000 rounds = 100 GNC weight updates at
+# the default inner_iters=30, the reference's full annealing budget
+# (gnc_max_iters, DPGO_robust.h:48-55), plus post-freeze descent.
+CONFIGS = [
+    ("sphere2500.g2o", 8, 5, 3000),
+    ("city10000.g2o", 32, 3, 3000),
+]
+FRACTIONS = [0.1, 0.2, 0.4]
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def fopt_inliers(fname: str, rank: int, fraction: float, seed: int = 0) -> float:
+    """Optimum f* of the INLIER-ONLY subproblem (odometry + uncorrupted
+    loop closures) via a centralized f64 CPU solve, cached per
+    (dataset, rank, fraction, seed).
+
+    This is the honest comparator for a robust run: the corrupted problem
+    never contains the true versions of the corrupted edges, so the final
+    iterate can only be judged on the edges GNC was supposed to keep.
+    Runs in a subprocess because the TPU-tunnel process cannot enable x64
+    (see bench.py).
+    """
+    cache = {}
+    if os.path.exists(CACHE):
+        with open(CACHE) as f:
+            cache = json.load(f)
+    key = f"{fname}_r{rank}_p{fraction}_s{seed}"
+    if key in cache:
+        return cache[key]
+    code = f"""
+import jax, json, numpy as np
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+from dpgo_tpu.models.local_pgo import solve_local
+from dpgo_tpu.utils.g2o import read_g2o
+from dpgo_tpu.utils.synthetic import corrupt_loop_closures
+meas = read_g2o({f"{DATA}/{fname}"!r})
+_, idx = corrupt_loop_closures(meas, {fraction}, seed={seed})
+keep = np.ones(len(meas), bool); keep[idx] = False
+res = solve_local(meas.select(keep), rank={rank}, grad_norm_tol=1e-7,
+                  max_iters=3000, dtype=jnp.float64)
+print(json.dumps({{"f": float(res.cost), "gn": float(res.grad_norm)}}))
+"""
+    out = subprocess.run([sys.executable, "-c", code],
+                         env=dict(os.environ, PYTHONPATH="/root/repo"),
+                         capture_output=True, text=True, timeout=7200)
+    if out.returncode != 0:
+        raise RuntimeError(f"f* solve failed:\n{out.stderr[-2000:]}")
+    d = json.loads(out.stdout.strip().splitlines()[-1])
+    log(f"  [{fname} p={fraction}] inlier f* = {d['f']:.7f} "
+        f"(gradnorm {d['gn']:.1e})")
+    cache[key] = d["f"]
+    with open(CACHE, "w") as f:
+        json.dump(cache, f)
+    return d["f"]
+
+
+def run_one(fname: str, A: int, r: int, rounds: int, fraction: float,
+            seed: int = 0):
+    import jax
+    import jax.numpy as jnp
+    from dpgo_tpu.config import (AgentParams, RobustCostParams,
+                                 RobustCostType, Schedule)
+    from dpgo_tpu.models import rbcd
+    from dpgo_tpu.ops import quadratic
+    from dpgo_tpu.types import edge_set_from_measurements
+    from dpgo_tpu.utils.g2o import read_g2o
+    from dpgo_tpu.utils.partition import partition_contiguous
+    from dpgo_tpu.utils.synthetic import (corrupt_loop_closures,
+                                          rejection_scores)
+
+    dtype = jnp.float32 if jax.devices()[0].platform != "cpu" else jnp.float64
+    clean = read_g2o(f"{DATA}/{fname}")
+    meas, outlier_idx = corrupt_loop_closures(clean, fraction, seed=seed)
+
+    params = AgentParams(
+        d=clean.d, r=r, num_robots=A, schedule=Schedule.COLORED,
+        robust=RobustCostParams(cost_type=RobustCostType.GNC_TLS),
+        rel_change_tol=0.0, acceleration=True, restart_interval=100,
+    )
+    t0 = time.perf_counter()
+    # Iterated (2-pass) GNC: anneal, hard-drop rejected LCs, re-anneal —
+    # a single pass at BCD inner-convergence leaves a few gross outliers
+    # above the rejection threshold, and they bend the whole solution
+    # (see solve_rbcd_robust_iterated's docstring for the measurement).
+    # Init is chordal, not odometry: the iterated anneal recovers from a
+    # corruption-poisoned chordal basin, while city10000's odometry
+    # drift is unrecoverable (A/B in centralized_odometry_init's
+    # docstring).
+    res, w, kept = rbcd.solve_rbcd_robust_iterated(
+        meas, A, params, passes=2, max_iters=rounds, grad_norm_tol=0.0,
+        eval_every=rounds // 4, dtype=dtype)
+    wall = time.perf_counter() - t0
+
+    from dpgo_tpu.types import loop_closure_mask
+    prec, rec, n_rej = rejection_scores(w, meas, outlier_idx)
+    lc = loop_closure_mask(meas)
+    conv = float(np.mean((w[lc] < 1e-3) | (w[lc] > 1 - 1e-3)))
+    # Final cost on the INLIER-ONLY edge set (odometry + uncorrupted LCs) —
+    # the edges GNC was supposed to keep; compared against that
+    # subproblem's own f64 optimum by the caller.
+    keep = np.ones(len(meas), bool)
+    keep[outlier_idx] = False
+    edges_in = edge_set_from_measurements(clean.select(keep), dtype=dtype)
+    # res.X lives on the LAST pass's (filtered) graph; poses are unchanged
+    # by filtering, but rebuild that graph for the gather.
+    part = partition_contiguous(meas.select(kept), A)
+    graph, meta = rbcd.build_graph(part, r, dtype)
+    Xg = rbcd.gather_to_global(res.X, graph, clean.num_poses)
+    f_in = float(quadratic.cost(jnp.asarray(Xg), edges_in))
+    return dict(dataset=fname, fraction=fraction, n_lc_out=len(outlier_idx),
+                precision=prec, recall=rec, n_rejected=n_rej,
+                weight_converged_ratio=conv, f_inlier=f_in,
+                rounds=res.iterations, wall=wall,
+                cost_final=float(res.cost_history[-1]))
+
+
+def main():
+    quick = "--quick" in sys.argv
+    rows = []
+    for fname, A, r, rounds in CONFIGS:
+        if quick and fname != "sphere2500.g2o":
+            continue
+        for frac in ([0.2] if quick else FRACTIONS):
+            row = run_one(fname, A, r, rounds if not quick else 300, frac)
+            fstar = fopt_inliers(fname, r, frac)
+            row["f_star_inlier"] = fstar
+            row["rel_excess"] = row["f_inlier"] / fstar - 1.0
+            rows.append(row)
+            log(f"[{fname} {int(frac*100)}%] rejected {row['n_rejected']} "
+                f"(injected {row['n_lc_out']}): precision {row['precision']:.3f} "
+                f"recall {row['recall']:.3f} conv {row['weight_converged_ratio']:.2f}; "
+                f"inlier-edge cost {row['f_inlier']:.2f} "
+                f"vs f*_in {fstar:.2f} (+{row['rel_excess']*100:.2f}%), "
+                f"{row['rounds']} rounds in {row['wall']:.1f}s")
+
+    print("\n| dataset | outliers | rejected | precision | recall | "
+          "inlier cost vs f*_in | rounds | wall |")
+    print("|---|---|---|---|---|---|---|---|")
+    for w in rows:
+        print(f"| {w['dataset'].replace('.g2o','')} | {int(w['fraction']*100)}% "
+              f"({w['n_lc_out']}) | {w['n_rejected']} | {w['precision']:.3f} | "
+              f"{w['recall']:.3f} | +{w['rel_excess']*100:.2f}% | "
+              f"{w['rounds']} | {w['wall']:.1f}s |")
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "gnc_corruption_results.json"), "w") as f:
+        json.dump(rows, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

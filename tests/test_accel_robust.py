@@ -243,3 +243,50 @@ def test_non_gnc_robust_costs_downweight_outliers(rng, cost_type, kw):
     # The 3 outlier loop closures are the last measurements.
     assert w[-3:].max() < w[:-3].min(), (cost_type, w[-6:])
     assert res.cost_history[-1] <= res.cost_history[0]
+
+
+def test_gnc_corruption_protocol_precision_recall(rng):
+    """The corrupted-data benchmark protocol at test scale (VERDICT r3
+    item 3): corrupt 20% of the loop closures of a noisy graph with
+    gross random poses (``corrupt_loop_closures``), run the full GNC
+    annealing from the trusted-odometry init, and pin exact-set
+    edge-rejection precision/recall plus trajectory recovery.
+
+    The at-scale version of this (sphere2500/city10000 at 10/20/40%)
+    lives in ``experiments/gnc_corruption.py`` with its results table in
+    BASELINE.md; this test keeps the protocol itself honest on every
+    commit.  Reference anchor: the machinery under test is
+    ``updateLoopClosuresWeights`` (``PGOAgent.cpp:1181-1245``) /
+    ``RobustCost`` (``DPGO_robust.cpp:23-103``), which the reference only
+    ever exercises on hand-made micro graphs (``testUtils.cpp:72-180``).
+    """
+    from dpgo_tpu.utils.synthetic import (corrupt_loop_closures,
+                                          rejection_scores)
+
+    clean, (Rs, ts) = make_measurements(rng, n=120, d=3, num_lc=60,
+                                        rot_noise=0.02, trans_noise=0.02)
+    meas, outlier_idx = corrupt_loop_closures(clean, 0.2, seed=7)
+    assert len(outlier_idx) == 12
+    # barc=2: the clean residuals at this noise level reach ~0.3-0.8
+    # (sqrt(kappa)-scaled), gross outliers ~20+; the threshold sits
+    # between, as the benchmark uses the reference default barc=10 on
+    # the real datasets whose inlier residuals are larger.
+    params = AgentParams(
+        d=3, r=5, num_robots=4, schedule=Schedule.COLORED,
+        robust=RobustCostParams(cost_type=RobustCostType.GNC_TLS,
+                                gnc_barc=2.0),
+        robust_opt_inner_iters=10, rel_change_tol=0.0,
+        solver=SolverParams(grad_norm_tol=1e-6))
+    res = rbcd.solve_rbcd(meas, 4, params, max_iters=500, grad_norm_tol=0.0,
+                          eval_every=100, init="odometry")
+    prec, recall, n_rej = rejection_scores(np.asarray(res.weights), meas,
+                                           outlier_idx)
+    assert prec >= 0.95, (prec, n_rej)
+    assert recall >= 0.95, (recall, n_rej)
+    # With the outliers rejected, the iterate must recover the ground
+    # truth to noise level despite 20% corruption: the max-abs pose error
+    # of a CLEAN (uncorrupted) solve of this graph is ~0.21 (accumulated
+    # drift at noise 0.02 under this metric), so 0.45 pins "no worse than
+    # ~2x the clean noise floor" while a corruption-driven failure would
+    # sit far above 1.
+    assert trajectory_error(res.T, Rs, ts) < 0.45
