@@ -268,13 +268,66 @@ class PGOAgent:
 
     def _build_step(self):
         params = self.params
+        pallas = self._pallas_tiles()
 
         @jax.jit
         def step(X_local, z, weights):
             edges = self._edges._replace(weight=weights)
-            return _agent_update(X_local, z, edges, params)
+            return _agent_update(X_local, z, edges, params, pallas=pallas)
 
         self._step_fn = step
+
+    def _pallas_tiles(self):
+        """Tile-major edge arrays when this robot's iterate should run the
+        VMEM Pallas kernel — the same engine/gates as the batched core
+        (``rbcd._formulation``): RTR, TPU backend (or pallas_tcg=True for
+        interpreter-mode testing), within the kernel's VMEM budget.  The
+        deployment surface previously always took the ELL path, so a
+        per-robot ``iterate()`` ran a different engine than ``solve_rbcd``
+        on the identical problem."""
+        from .config import ROptAlg
+        from .models.rbcd import (_edge_tile_shape, agent_edge_tiles,
+                                  pallas_vmem_ok)
+
+        sp = self.params.solver
+        forced = sp.pallas_tcg is True
+        if sp.algorithm != ROptAlg.RTR:
+            if forced:
+                raise ValueError(
+                    "pallas_tcg=True cannot run on this agent: "
+                    "algorithm is not RTR")
+            return None
+        if sp.pallas_tcg is False or \
+                not (forced or jax.default_backend() == "tpu"):
+            return None
+        if jax.config.read("jax_enable_x64") and not forced:
+            # The kernel is float32-only; with x64 live this agent's f64
+            # arrays would be silently clamped every iterate (the batched
+            # core's _formulation routes f64 problems to the f64 ELL path
+            # for the same reason).  An explicit pallas_tcg=True still
+            # honors the force — the deployment surface documents that the
+            # kernel computes in f32 (interpreter-mode testing).
+            return None
+        from .models.rbcd import resolved_sel_mode
+
+        n, s = self.n, len(self._slot_pose)
+        e = int(self._edges.i.shape[0])
+        T, nt = _edge_tile_shape(n, s, e)
+        bf16 = resolved_sel_mode(self.params) != "f32"
+        if not pallas_vmem_ok(n, s, self.params.r, self.d, T, nt, bf16):
+            if forced:
+                # Same no-silent-downgrade contract as the batched core
+                # (rbcd._formulation): an explicit force that cannot be
+                # honored must raise, not quietly run another engine.
+                raise ValueError(
+                    "pallas_tcg=True cannot run on this agent: the "
+                    "per-robot problem exceeds the kernel's VMEM budget")
+            return None
+        eidx_i, eidx_j, rot_t, trn_t = agent_edge_tiles(
+            self._edges.i, self._edges.j, self._edges.R, self._edges.t,
+            n, s)
+        interpret = jax.default_backend() != "tpu"
+        return (eidx_i, eidx_j, rot_t, trn_t, interpret)
 
     # -- pose sharing (the message vocabulary, SURVEY.md section 2.4) -------
 

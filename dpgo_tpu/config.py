@@ -114,9 +114,18 @@ class SolverParams:
     # (hi/lo split of the gathered vectors; the 0/1 selection matrices are
     # bf16-exact) instead of f32 — ~2x on the MXU-bound large-problem
     # shapes, at ~2^-16 relative hessvec/cost error.  Opt-in: appropriate
-    # when running the reference's loose per-step budget (tol 1e-2); keep
-    # off for certified-gap pipelines (the refine kernel never uses it).
+    # when running the reference's loose per-step budget (tol 1e-2); the
+    # refine kernel ignores this flag (it runs f32 — or bf16x3 when that
+    # f32-grade mode is selected via pallas_sel_mode).
     pallas_bf16_select: bool = False
+    # Selection-matmul mode, superseding ``pallas_bf16_select`` when set:
+    # "f32" (Precision.HIGHEST — ~6 emulated bf16 MXU passes), "bf16"
+    # (2-pass hi/lo split, ~2^-16 error — what pallas_bf16_select turns
+    # on), or "bf16x3" (3-pass hi/mid/lo split covering the full 24-bit
+    # f32 mantissa: f32-grade accuracy at half the HIGHEST pass count,
+    # since the bf16-exact one-hots need no split of their own).
+    # "" = derive from pallas_bf16_select.
+    pallas_sel_mode: str = ""
     # Materialize each agent's buffer connection Laplacian and run
     # cost/gradient/Hessian as dense matmuls (``quadratic.dense_q``).
     # Opt-in: the dense products are HBM-bandwidth-bound reading the

@@ -473,6 +473,57 @@ def _edge_tile_shape(n_max: int, s_max: int, e_max: int) -> tuple[int, int]:
     return T, max(1, -(-e_max // T))
 
 
+def pallas_vmem_ok(n_max: int, s_max: int, rank: int, d: int, T: int,
+                   nt: int, bf16: bool = False) -> bool:
+    """Scalar-shape form of ``_pallas_vmem_ok`` — also the gate for the
+    per-robot deployment surface (``agent.PGOAgent``), which has no
+    GraphMeta/MultiAgentGraph."""
+    from ..ops.pallas_tcg import hoist_scratch_bytes, should_hoist
+
+    rk = rank * (d + 1)
+    sel_item = 2 if bf16 else 4  # bf16 one-hot tiles are half-size
+    edge_tiles_b = nt * T * (d * d + d + 4) * 4
+    onehots = 4 * T * (n_max + s_max) * sel_item
+    vecs = 12 * rk * n_max * 4
+    hoist = hoist_scratch_bytes(nt, T, n_max, sel_item) \
+        if should_hoist(nt, T, n_max, sel_item) else 0
+    return edge_tiles_b + onehots + vecs + hoist \
+        <= PALLAS_TCG_VMEM_BUDGET_BYTES
+
+
+def agent_edge_tiles(i, j, R, t, n: int, s: int):
+    """Tile-major edge arrays for ONE agent's buffer-indexed edge list —
+    the single-agent equivalent of ``build_graph``'s batched Pallas layout
+    (``eidx_i/eidx_j [nt, 1, T]``, ``rot_t [nt, d*d, T]``,
+    ``trn_t [nt, d, T]``; padding gets index ``n + s``, which one-hots to
+    all-zero in both the local and neighbor ranges).  Used by the
+    deployment surface (``agent.PGOAgent``) so per-robot iterates run the
+    same VMEM kernel as the batched core."""
+    i = np.asarray(i, np.int32)
+    j = np.asarray(j, np.int32)
+    R = np.asarray(R, np.float32)
+    t = np.asarray(t, np.float32)
+    e = i.shape[0]
+    d = R.shape[-1]
+    T, nt = _edge_tile_shape(n, s, e)
+    Ep = nt * T
+    pad = n + s
+    ii = np.full((Ep,), pad, np.int32)
+    jj = np.full((Ep,), pad, np.int32)
+    ii[:e] = i
+    jj[:e] = j
+    rot = np.zeros((d * d, Ep), np.float32)
+    trn = np.zeros((d, Ep), np.float32)
+    rot[:, :e] = R.transpose(1, 2, 0).reshape(d * d, e)
+    trn[:, :e] = t.T
+    return (jnp.asarray(ii.reshape(nt, 1, T)),
+            jnp.asarray(jj.reshape(nt, 1, T)),
+            jnp.asarray(np.ascontiguousarray(
+                rot.reshape(d * d, nt, T).transpose(1, 0, 2))),
+            jnp.asarray(np.ascontiguousarray(
+                trn.reshape(d, nt, T).transpose(1, 0, 2))))
+
+
 def _pallas_vmem_ok(meta: GraphMeta, graph, bf16: bool = False) -> bool:
     """Whether the kernel's per-agent working set fits in VMEM.
 
@@ -488,19 +539,20 @@ def _pallas_vmem_ok(meta: GraphMeta, graph, bf16: bool = False) -> bool:
     same budget when the kernel will allocate it — both gates derive from
     one estimate, so a shape cannot pass here and then overflow VMEM by
     adding the hoist scratch."""
-    from ..ops.pallas_tcg import hoist_scratch_bytes, should_hoist
+    return pallas_vmem_ok(meta.n_max, meta.s_max, meta.rank, meta.d,
+                          graph.eidx_i.shape[-1], graph.eidx_i.shape[1],
+                          bf16)
 
-    T = graph.eidx_i.shape[-1]
-    nt = graph.eidx_i.shape[1]
-    rk = meta.rank * (meta.d + 1)
-    sel_item = 2 if bf16 else 4  # bf16_select halves the one-hot tiles
-    edge_tiles = nt * T * (meta.d * meta.d + meta.d + 4) * 4
-    onehots = 4 * T * (meta.n_max + meta.s_max) * sel_item
-    vecs = 12 * rk * meta.n_max * 4
-    hoist = hoist_scratch_bytes(nt, T, meta.n_max, sel_item) \
-        if should_hoist(nt, T, meta.n_max, sel_item) else 0
-    return edge_tiles + onehots + vecs + hoist \
-        <= PALLAS_TCG_VMEM_BUDGET_BYTES
+
+def resolved_sel_mode(params: AgentParams) -> str:
+    """The kernel selection-matmul mode: ``pallas_sel_mode`` when set,
+    else derived from the older ``pallas_bf16_select`` flag."""
+    m = params.solver.pallas_sel_mode
+    if m:
+        if m not in ("f32", "bf16", "bf16x3"):
+            raise ValueError(f"unknown pallas_sel_mode {m!r}")
+        return m
+    return "bf16" if params.solver.pallas_bf16_select else "f32"
 
 
 def _formulation(meta: GraphMeta, params: AgentParams | None, graph,
@@ -519,7 +571,7 @@ def _formulation(meta: GraphMeta, params: AgentParams | None, graph,
     # and tight grad_norm_tols become unreachable.
     pallas_ok = rtr and itemsize == 4 and graph.eidx_i is not None \
         and _pallas_vmem_ok(meta, graph,
-                            bf16=params.solver.pallas_bf16_select)
+                            bf16=resolved_sel_mode(params) != "f32")
     if params.solver.pallas_tcg is True:
         if not pallas_ok:
             # An explicit force that cannot be honored must not silently
@@ -607,7 +659,7 @@ def _agent_update(X_local, z, edges, params: AgentParams, chol=None, inc=None,
             max_rejections=params.solver.max_rejections,
             grad_tol=params.solver.grad_norm_tol,
             interpret=interpret,
-            bf16_select=params.solver.pallas_bf16_select)
+            sel_mode=resolved_sel_mode(params))
         X_new = ptcg.comp_minor(X_out_c, r, k).astype(X_local.dtype)
         gn0 = stats[0, 4].astype(X_local.dtype)
         return X_new, gn0

@@ -152,10 +152,29 @@ def _np_chol_blocks(edges_np, n_max, d, shift):
 
 
 def _np_project_manifold(Xg64: np.ndarray, d: int) -> np.ndarray:
-    """f64 manifold projection (per-pose Stiefel polar via SVD, numpy)."""
+    """f64 manifold projection (per-pose Stiefel polar via SVD, numpy).
+
+    LAPACK's divide-and-conquer gesdd can fail to converge on rare
+    near-degenerate blocks (observed on parking-garage iterates); the
+    polar factor is also U(V^T) of the symmetric eigendecomposition of
+    Y^T Y, which is the per-block fallback."""
     Y = Xg64[..., :d]
-    U, _, Vh = np.linalg.svd(Y, full_matrices=False)
-    return np.concatenate([U @ Vh, Xg64[..., d:]], axis=-1)
+    try:
+        U, _, Vh = np.linalg.svd(Y, full_matrices=False)
+        return np.concatenate([U @ Vh, Xg64[..., d:]], axis=-1)
+    except np.linalg.LinAlgError:
+        pass
+    out = Xg64.copy()
+    for i in range(Y.shape[0]):
+        try:
+            U, _, Vh = np.linalg.svd(Y[i], full_matrices=False)
+            out[i, :, :d] = U @ Vh
+        except np.linalg.LinAlgError:
+            # Polar via eigh of the (symmetric PSD) Gram — always converges.
+            w, V = np.linalg.eigh(Y[i].T @ Y[i])
+            inv_sqrt = V @ np.diag(1.0 / np.sqrt(np.maximum(w, 1e-300))) @ V.T
+            out[i, :, :d] = Y[i] @ inv_sqrt
+    return out
 
 
 def recenter(Xg64: np.ndarray, graph, meta, params: AgentParams,
@@ -401,6 +420,18 @@ def _agent_refine(D, Dz, consts_a, edges, inc, params: AgentParams,
         # VMEM (``pallas_tcg.rtr_refine_full_call``) — no XLA pre-pass.
         from ..ops import pallas_tcg as ptcg
 
+        from .rbcd import resolved_sel_mode
+
+        # The 2-pass "bf16" mode (~2^-16 selection error) never applies
+        # here — this kernel exists to dissolve the f32 floor, and the
+        # legacy pallas_bf16_select flag is documented as ignored by
+        # refinement.  The 3-pass "bf16x3" mode IS allowed: it covers the
+        # full f32 mantissa (f32-grade; measured identical refine result
+        # on sphere2500), at half the HIGHEST-emulation pass count.
+        sel_mode = resolved_sel_mode(params)
+        if sel_mode == "bf16":
+            sel_mode = "f32"
+
         D_out_c, stats = ptcg.rtr_refine_full_call(
             eidx[0], eidx[1], eidx[2], eidx[3],
             consts_a.wk_t, consts_a.wt_t,
@@ -411,7 +442,8 @@ def _agent_refine(D, Dz, consts_a, edges, inc, params: AgentParams,
             r=r, d=d, max_iters=sp.max_inner_iters, kappa=sp.tcg_kappa,
             theta=sp.tcg_theta, initial_radius=sp.initial_radius,
             max_rejections=sp.max_rejections,
-            grad_tol=sp.grad_norm_tol, interpret=interpret)
+            grad_tol=sp.grad_norm_tol, interpret=interpret,
+            sel_mode=sel_mode)
         return ptcg.comp_minor(D_out_c, r, k), stats[0, 4]
 
     Dbuf = jnp.concatenate([D, Dz], axis=0)
@@ -604,17 +636,30 @@ def solve_refine(Xg64: np.ndarray, graph, meta, params: AgentParams,
     target = f_opt * (1.0 + rel_gap)
     chol = None
     best = None  # (gap, X64) — accelerated tails can overshoot slightly
+    last_revert = -10  # cycle index of the most recent safeguard revert
     for cyc in range(max_cycles + 1):
         # Cheap verify pass: f64 projection + global cost only.  The full
         # recenter (reference gradients, residual tiles, device transfers)
         # is built ONLY when another cycle actually runs — on the success
         # and exhaustion paths this saves most of a recenter's host work.
+        if not np.all(np.isfinite(Xg64)):
+            # A diverged accelerated cycle can go non-finite outright;
+            # NaN compares False against every threshold, so it would
+            # slip the worsened-gap safeguard below (and the manifold
+            # projection would raise) — treat it as a worsened cycle.
+            assert best is not None, "initial iterate is non-finite"
+            accel_on = False
+            Xg64 = best[1]
+            last_revert = cyc
+            history.append((float("inf"), time.perf_counter() - t0))
+            continue
         Xg64 = _np_project_manifold(Xg64, meta.d)
         f = global_cost(Xg64, edges_global)
         gap_now = f / f_opt - 1.0
         history.append((gap_now, time.perf_counter() - t0))
         if best is not None and accel_on and \
-                gap_now > best[0] + 1e-12 * max(1.0, abs(best[0])):
+                (not np.isfinite(gap_now)
+                 or gap_now > best[0] + 1e-12 * max(1.0, abs(best[0]))):
             # Cycle-level safeguard: every cycle boundary VERIFIES the gap
             # in f64, so a worsened accelerated cycle is caught here —
             # revert to the best point and continue un-accelerated.
@@ -626,6 +671,7 @@ def solve_refine(Xg64: np.ndarray, graph, meta, params: AgentParams,
             # fallback.
             accel_on = False
             Xg64 = best[1]
+            last_revert = cyc
             continue
         if best is None or gap_now < best[0]:
             best = (gap_now, Xg64)
@@ -634,6 +680,25 @@ def solve_refine(Xg64: np.ndarray, graph, meta, params: AgentParams,
             # band) — honor the "returns the best verified point" contract
             # on both exits.
             return best[1], best[0], cyc, history
+        # Condition-limited early exit: when the last two cycles together
+        # contracted less than ~0.1 decades and several decades remain,
+        # exhausting max_cycles cannot reach the target — return now so a
+        # caller's fallback (e.g. the centralized A=1 continuation,
+        # bench_convergence.py) gets the time instead.
+        # Skipped for 3 cycles after a safeguard revert: the revert paths
+        # leave a flat/worsened entry in the window (g_init, g_bad,
+        # g_init), which would read as "no contraction" before a single
+        # plain cycle has actually run.
+        if cyc >= 2 and len(history) >= 3 and rel_gap > 0 \
+                and cyc >= last_revert + 3:
+            g2, g1, g0 = (history[-3][0], history[-2][0], history[-1][0])
+            if np.isfinite(g2) and np.isfinite(g1) and np.isfinite(g0) \
+                    and g0 > 30 * rel_gap:
+                import math
+                gained = math.log10(max(g2, 1e-300) / g0)
+                need = math.log10(g0 / (rel_gap * 0.3))
+                if gained < 0.1 and need > gained * (max_cycles - cyc):
+                    return best[1], best[0], cyc, history
         ref = recenter(Xg64, graph, meta, params, edges_global, chol=chol,
                        pre_projected=True, f_ref=f)
         chol = ref.consts.chol  # weight-only: constant across recenters

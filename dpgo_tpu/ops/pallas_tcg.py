@@ -72,7 +72,7 @@ TILE = 256
 
 def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                 X, S, L, *, r, d, max_iters, kappa, theta, refine=None,
-                hoist_scratch=None, Z=None, bf16_select=False):
+                hoist_scratch=None, Z=None, sel_mode="f32"):
     """Closures over the per-agent VMEM refs (component-major layout).
 
     Edge data arrives as tile-major refs (see module docstring) read
@@ -109,47 +109,50 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         return a * k + c
 
     bf16 = jnp.bfloat16
-    sel_t = bf16 if bf16_select else f32
+    sel_t = f32 if sel_mode == "f32" else bf16
+    sel_passes = {"f32": 0, "bf16": 2, "bf16x3": 3}[sel_mode]
 
-    def _split(V):  # f32 -> (hi, lo) bf16 pair with hi + lo ~ V (2^-16 rel)
-        hi = V.astype(bf16)
-        return hi, (V - hi.astype(f32)).astype(bf16)
+    def _split(V, parts):
+        """f32 -> ``parts`` bf16 terms summing back to V.
+
+        Each term peels the next ~8 mantissa bits: 2 parts cover 16 bits
+        (~2^-16 relative error), 3 parts cover the full 24-bit f32
+        mantissa — the reconstruction is f32-exact up to the residual
+        term's own rounding (<= f32 eps), so 3-pass selection is an
+        f32-equivalent gather/scatter at bf16 MXU rates."""
+        outs = []
+        rem = V
+        for _ in range(parts - 1):
+            hi = rem.astype(bf16)
+            outs.append(hi)
+            rem = rem - hi.astype(f32)
+        outs.append(rem.astype(bf16))
+        return outs
+
+    def _sel_dot(V, Sel, dims):
+        if sel_passes == 0:
+            return jax.lax.dot_general(V, Sel, dims, precision=HI,
+                                       preferred_element_type=f32)
+        # One-hots are EXACT in bf16 (entries 0/1); V splits into bf16
+        # passes at the MXU's native bf16 rate — 2 or 3 passes instead of
+        # the f32 HIGHEST emulation's 6.  No cross terms arise because Sel
+        # needs no split, which is why 3 passes already reach f32-grade
+        # accuracy.  precision must be DEFAULT explicitly: with bf16
+        # operands and no precision, Mosaic resolves contract precision to
+        # fp32 and rejects the matmul ("Bad lhs type").
+        acc = None
+        for part in _split(V, sel_passes):
+            t = jax.lax.dot_general(part, Sel, dims,
+                                    precision=jax.lax.Precision.DEFAULT,
+                                    preferred_element_type=f32)
+            acc = t if acc is None else acc + t
+        return acc
 
     def gather(V, Sel):  # [rk, m] x [m, T] -> [rk, T]
-        if bf16_select:
-            # One-hots are EXACT in bf16 (entries 0/1); V splits into two
-            # bf16 passes at the MXU's native bf16 rate — 2 passes instead
-            # of the f32 emulation's 3+, with ~2^-16 relative error from
-            # the hi/lo split.  Only enabled via the static flag (large-
-            # scale configs running the reference's loose per-step budget).
-            hi, lo = _split(V)
-            # precision must be DEFAULT explicitly: with bf16 operands and
-            # no precision, Mosaic resolves contract precision to fp32 and
-            # rejects the matmul ("Bad lhs type").
-            return (jax.lax.dot_general(
-                        hi, Sel, (((1,), (0,)), ((), ())),
-                        precision=jax.lax.Precision.DEFAULT,
-                        preferred_element_type=f32)
-                    + jax.lax.dot_general(
-                        lo, Sel, (((1,), (0,)), ((), ())),
-                        precision=jax.lax.Precision.DEFAULT,
-                        preferred_element_type=f32))
-        return jax.lax.dot_general(V, Sel, (((1,), (0,)), ((), ())),
-                                   precision=HI, preferred_element_type=f32)
+        return _sel_dot(V, Sel, (((1,), (0,)), ((), ())))
 
     def scatter(G, Sel):  # [rk, T] x [m, T] -> [rk, m]  (scatter-add)
-        if bf16_select:
-            hi, lo = _split(G)
-            return (jax.lax.dot_general(
-                        hi, Sel, (((1,), (1,)), ((), ())),
-                        precision=jax.lax.Precision.DEFAULT,
-                        preferred_element_type=f32)
-                    + jax.lax.dot_general(
-                        lo, Sel, (((1,), (1,)), ((), ())),
-                        precision=jax.lax.Precision.DEFAULT,
-                        preferred_element_type=f32))
-        return jax.lax.dot_general(G, Sel, (((1,), (1,)), ((), ())),
-                                   precision=HI, preferred_element_type=f32)
+        return _sel_dot(G, Sel, (((1,), (1,)), ((), ())))
 
     def onehot(idx_row, m, base):
         """[m, T] one-hot of (idx - base): column e selects row idx[e]-base,
@@ -606,7 +609,7 @@ def _rtr_full_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                      r: int, d: int, max_iters: int, kappa: float,
                      theta: float, initial_radius: float,
                      max_rejections: int, grad_tol: float,
-                     bf16_select: bool):
+                     sel_mode: str):
     """Fully-fused single-step RTR: the start-point gradient, curvature
     term, gradient norm, AND the attempt loop of ``_rtr_kernel`` in one
     kernel — one invocation is the complete local solve of
@@ -619,7 +622,7 @@ def _rtr_full_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
                     X, None, chol_ref[...],
                     r=r, d=d, max_iters=max_iters, kappa=kappa, theta=theta,
                     hoist_scratch=scratch or None, Z=Z,
-                    bf16_select=bf16_select)
+                    sel_mode=sel_mode)
     g = m.g
     gn0 = m.gn0
 
@@ -660,7 +663,8 @@ def _rtr_refine_full_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref,
                             chol_ref, d_out_ref, stats_ref, *scratch,
                             r: int, d: int, max_iters: int, kappa: float,
                             theta: float, initial_radius: float,
-                            max_rejections: int, grad_tol: float):
+                            max_rejections: int, grad_tol: float,
+                            sel_mode: str = "f32"):
     """Fully-fused re-centered single-step RTR: the recentered gradient
     (g0 + dG with the S0/S1 curvature corrections), the adaptive initial
     radius, and the shrink-radius attempt loop in one kernel —
@@ -676,7 +680,7 @@ def _rtr_refine_full_kernel(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref,
                     r=r, d=d, max_iters=max_iters, kappa=kappa, theta=theta,
                     refine=(rho_rot_ref, rho_trn_ref, Rc, D, Dz,
                             g0_ref[...], gref_ref[...], s0_ref[...]),
-                    hoist_scratch=scratch or None)
+                    hoist_scratch=scratch or None, sel_mode=sel_mode)
     g = m.g
     gn0 = m.gn0
 
@@ -805,12 +809,12 @@ def rtr_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Sc, Lc,
 
 @functools.partial(jax.jit, static_argnames=(
     "r", "d", "max_iters", "kappa", "theta", "initial_radius",
-    "max_rejections", "grad_tol", "interpret", "hoist", "bf16_select"))
+    "max_rejections", "grad_tol", "interpret", "hoist", "sel_mode"))
 def rtr_full_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Lc,
                   *, r: int, d: int, max_iters: int, kappa: float,
                   theta: float, initial_radius: float, max_rejections: int,
                   grad_tol: float = 0.0, interpret: bool = False,
-                  hoist: bool | None = None, bf16_select: bool = False):
+                  hoist: bool | None = None, sel_mode: str = "f32"):
     """Invoke the fully-fused single-step RTR kernel for one agent: only
     the pose buffer halves [Xc | Zc], the preconditioner factors and the
     edge tiles go in — gradient, curvature and norm are computed in-kernel.
@@ -823,12 +827,12 @@ def rtr_full_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Lc,
                              max_iters=max_iters, kappa=kappa, theta=theta,
                              initial_radius=initial_radius,
                              max_rejections=max_rejections,
-                             grad_tol=grad_tol, bf16_select=bf16_select)
+                             grad_tol=grad_tol, sel_mode=sel_mode)
     vspec = pl.BlockSpec(memory_space=pltpu.VMEM)
     nt, T = idx_i.shape[0], idx_i.shape[-1]
     if hoist is None:
-        hoist = should_hoist(nt, T, n, itemsize=2 if bf16_select else 4)
-    sel_t = jnp.bfloat16 if bf16_select else jnp.float32
+        hoist = should_hoist(nt, T, n, itemsize=4 if sel_mode == "f32" else 2)
+    sel_t = jnp.float32 if sel_mode == "f32" else jnp.bfloat16
     scratch = [pltpu.VMEM((nt, n, T), sel_t)] * 2 if hoist else []
     return pl.pallas_call(
         kern,
@@ -845,13 +849,14 @@ def rtr_full_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Lc,
 
 @functools.partial(jax.jit, static_argnames=(
     "r", "d", "max_iters", "kappa", "theta", "initial_radius",
-    "max_rejections", "grad_tol", "interpret", "hoist"))
+    "max_rejections", "grad_tol", "interpret", "hoist", "sel_mode"))
 def rtr_refine_full_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, rho_rot,
                          rho_trn, Rc, Dc, Dzc, g0c, Grefc, S0c, Lc, *,
                          r: int, d: int, max_iters: int, kappa: float,
                          theta: float, initial_radius: float,
                          max_rejections: int, grad_tol: float = 0.0,
-                         interpret: bool = False, hoist: bool | None = None):
+                         interpret: bool = False, hoist: bool | None = None,
+                         sel_mode: str = "f32"):
     """Invoke the fully-fused re-centered RTR kernel for one agent: the
     recenter constants go in (reference point, residuals, g0, G_ref, S0 in
     component-major/tile layouts), the updated correction comes out.
@@ -864,12 +869,13 @@ def rtr_refine_full_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, rho_rot,
                              max_iters=max_iters, kappa=kappa, theta=theta,
                              initial_radius=initial_radius,
                              max_rejections=max_rejections,
-                             grad_tol=grad_tol)
+                             grad_tol=grad_tol, sel_mode=sel_mode)
     vspec = pl.BlockSpec(memory_space=pltpu.VMEM)
     nt, T = idx_i.shape[0], idx_i.shape[-1]
     if hoist is None:
-        hoist = should_hoist(nt, T, n)
-    scratch = [pltpu.VMEM((nt, n, T), jnp.float32)] * 2 if hoist else []
+        hoist = should_hoist(nt, T, n, itemsize=4 if sel_mode == "f32" else 2)
+    sel_t = jnp.float32 if sel_mode == "f32" else jnp.bfloat16
+    scratch = [pltpu.VMEM((nt, n, T), sel_t)] * 2 if hoist else []
     return pl.pallas_call(
         kern,
         out_shape=(
@@ -895,7 +901,7 @@ def hoist_scratch_bytes(nt: int, tile: int, n: int,
     """Bytes of the two [nt, n, T] one-hot scratch stacks — the single
     source for ``should_hoist``, the kernels' ``scratch_shapes``, and the
     dispatch gate's VMEM estimate (``rbcd._pallas_vmem_ok``).  ``itemsize``
-    is 2 under ``bf16_select`` (bf16 one-hots), else 4."""
+    is 2 under the bf16 selection modes (bf16 one-hots), else 4."""
     return 2 * nt * tile * n * itemsize
 
 

@@ -1,0 +1,110 @@
+"""A/B the kernel selection-matmul modes at the 100k/64 shape
+(VERDICT r3 item 5: attack the selection-matmul ceiling).
+
+Modes (``config.SolverParams.pallas_sel_mode``):
+* f32    — Precision.HIGHEST one-hot matmuls (~6 emulated bf16 passes)
+* bf16x3 — 3-pass hi/mid/lo split, covers the full 24-bit f32 mantissa:
+           f32-grade numerics at half the pass count
+* bf16   — 2-pass hi/lo split (~2^-16 error), the round-3 opt-in mode
+
+Also numerics: 100-round cost trajectories per mode vs the f32 arm.
+
+Usage: python experiments/selmode_100k.py [rounds] [--sphere]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build(meas, A, r, mode):
+    import jax.numpy as jnp
+    from dpgo_tpu.config import AgentParams, SolverParams
+    from dpgo_tpu.models import rbcd
+    from dpgo_tpu.utils.partition import partition_contiguous
+
+    params = AgentParams(d=meas.d, r=r, num_robots=A,
+                         solver=SolverParams(pallas_sel_mode=mode))
+    part = partition_contiguous(meas, A)
+    graph, meta = rbcd.build_graph(part, r, jnp.float32)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float32)
+    state = rbcd.init_state(graph, meta, X0, params=params)
+    return state, graph, meta, params
+
+
+def measure(meas, A, r, mode, rounds, trials=3):
+    from dpgo_tpu.models import rbcd
+
+    state, graph, meta, params = build(meas, A, r, mode)
+    form = rbcd._formulation(meta, params, graph)
+    assert form == "pallas", f"{mode}: formulation resolved to {form}"
+    steps = lambda s, k: rbcd.rbcd_steps(s, graph, k, meta, params)
+    t0 = time.perf_counter()
+    st = steps(state, 1)
+    _ = np.asarray(st.X)
+    log(f"[{mode}] compile {time.perf_counter()-t0:.1f}s "
+        f"(n_max={meta.n_max} e_max={meta.e_max})")
+    _ = np.asarray(steps(st, min(20, rounds)).X)
+    rates = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        out = steps(state, rounds)
+        _ = np.asarray(out.X)
+        rates.append(rounds / (time.perf_counter() - t0))
+        log(f"[{mode}] {rates[-1]:.1f} rounds/s")
+    # Numerics: 100-round final cost vs mode-f32 computed by caller.
+    st100 = steps(state, 100)
+    Xh = np.asarray(st100.X)
+    return float(np.median(rates)), Xh
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("-")]
+    rounds = int(args[0]) if args else 60
+    from dpgo_tpu.utils.g2o import read_g2o
+    from dpgo_tpu.utils.synthetic import make_measurements
+
+    if "--sphere" in sys.argv:
+        meas = read_g2o("/root/reference/data/sphere2500.g2o")
+        A, r, name = 8, 5, "sphere2500/8"
+    else:
+        t0 = time.perf_counter()
+        rng = np.random.default_rng(0)
+        meas, _ = make_measurements(rng, n=100000, d=3, num_lc=20000,
+                                    rot_noise=0.01, trans_noise=0.01)
+        A, r, name = 64, 5, "100k/64"
+        log(f"synthesized 100k in {time.perf_counter()-t0:.1f}s")
+
+    out = {"config": name, "rounds": rounds}
+    X_ref = None
+    for mode in ("f32", "bf16x3", "bf16"):
+        rate, Xh = measure(meas, A, r, mode, rounds)
+        if X_ref is None:
+            X_ref = Xh
+            drift = 0.0
+        else:
+            drift = float(np.abs(Xh - X_ref).max())
+        out[mode] = {"rounds_per_s": round(rate, 2),
+                     "x_drift_vs_f32_at_100r": drift}
+        log(f"[{mode}] median {rate:.1f} rounds/s, "
+            f"100-round iterate drift vs f32: {drift:.2e}")
+    out["speedup_bf16x3_vs_f32"] = round(
+        out["bf16x3"]["rounds_per_s"] / out["f32"]["rounds_per_s"], 3)
+    print(json.dumps(out))
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "selmode_results.json"), "a") as f:
+        f.write(json.dumps(out) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Config #5 end-to-end: rank staircase r=3->7 + certification at 100k/64
+on TPU (VERDICT r3 item 4 / BASELINE.json config #5).
+
+The staircase is beyond-reference (certification is not implemented in the
+reference code; BASELINE.md) — scoped from the T-RO paper: at each rank,
+solve sharded RBCD over the agent mesh, run the distributed dual
+certificate (block LOBPCG), and on failure lift along the negative
+curvature direction (``parallel.certify.solve_staircase_sharded``).
+
+The 100k synthetic stands in for the stripped g2o100k dataset
+(``/root/reference/.MISSING_LARGE_BLOBS``) — same generator/seed as the
+round-3 certification benchmark (``experiments/cert_scale.py``).
+
+Usage: python experiments/staircase_100k.py [rounds_per_rank]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    from dpgo_tpu.parallel import certify as dcert
+    from dpgo_tpu.utils.synthetic import make_measurements
+
+    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    log("generating 100k-pose synthetic (seed 0, as cert_scale.py) ...")
+    rng = np.random.default_rng(0)
+    meas, _ = make_measurements(rng, n=100000, d=3, num_lc=20000,
+                                rot_noise=0.01, trans_noise=0.01)
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind}); staircase r=3->7, "
+        f"{rounds} rounds/rank, 64 agents")
+
+    t0 = time.perf_counter()
+    T, Xa, rank, cert, hist = dcert.solve_staircase_sharded(
+        meas, 64, r_min=3, r_max=7, rounds_per_rank=rounds, verbose=True)
+    total = time.perf_counter() - t0
+
+    rows = [dict(rank=r, cost=f, lambda_min=lam, wall_s=w)
+            for r, f, lam, w in hist]
+    out = dict(metric="staircase_100k_64agents_r3to7",
+               certified=bool(cert.certified), final_rank=rank,
+               total_s=round(total, 1), per_rank=rows)
+    log(f"final rank {rank}, certified={cert.certified}, "
+        f"total {total:.1f}s")
+    print(json.dumps(out))
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "staircase_100k_results.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

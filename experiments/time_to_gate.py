@@ -1,0 +1,148 @@
+"""Time-to-gate for BASELINE.json configs #1-#4 (VERDICT r3 item 2).
+
+Runs each config to the reference driver's termination criterion —
+centralized Riemannian gradient norm < 0.1
+(``/root/reference/examples/MultiRobotExample.cpp:238``) — and records the
+wall-clock to the gate on the TPU f32 arm and on this framework's own f64
+CPU build (the reference's SuiteSparse/ROPTLIB dep is unavailable offline;
+BASELINE.md).  Configs whose gradnorm plateaus above the gate (kitti_00's
+near-chain graph) are run to a round cap on BOTH arms to show the plateau
+is a property of block-coordinate descent on that graph, not of the arm.
+
+Protocol: solve_rbcd with eval cadence 25-100 rounds (the eval readbacks
+are inside the clock — they are how the driver decides to stop, exactly
+as the reference's centralized monitor is), compile warmed by a short
+throwaway solve.  CPU arm runs in a subprocess (x64 cannot be enabled in
+the tunnel process; see bench.py).
+
+Usage: python experiments/time_to_gate.py [config_name ...]
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+DATA = "/root/reference/data"
+GATE = 0.1
+
+# name -> (file, agents, rank, schedule, robust, accel, eval_every,
+#          tpu_cap, cpu_cap).  Caps are asymmetric where the CPU arm's
+# wall-clock at the same round count would run to hours: the CPU arm then
+# records a BOUND (gradnorm still above gate after cpu_cap rounds / its
+# wall) rather than a crossing.
+CONFIGS = {
+    # smallGrid: JACOBI + momentum diverges on this densely-coupled little
+    # grid (gn 237 -> 2000 over 2000 rounds, both arms) — the classic
+    # simultaneous-update instability; COLORED Gauss-Seidel + momentum is
+    # stable, matching the reference's sequential greedy driver.
+    "smallGrid": ("smallGrid3D.g2o", 5, 5, "colored", False, True, 25,
+                  2000, 2000),
+    "sphere2500": ("sphere2500.g2o", 8, 5, "jacobi", False, True, 25,
+                   2000, 2000),
+    # kitti_00: near-chain graph, BCD plateaus at gn ~27 from 648 on BOTH
+    # arms (6000 rounds) — the gate is unreachable for block-coordinate
+    # descent here regardless of arm; both rows document the bound.
+    "kitti_00": ("kitti_00.g2o", 16, 3, "async", False, False, 100,
+                 6000, 6000),
+    "city10000_gnc": ("city10000.g2o", 32, 3, "jacobi", True, False, 100,
+                      15000, 12000),
+    "ais2klinik_gnc": ("ais2klinik.g2o", 32, 3, "colored", True, False, 100,
+                       60000, 6000),
+}
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def run_config(name: str):
+    import jax
+    import jax.numpy as jnp
+    from dpgo_tpu.config import (AgentParams, RobustCostParams,
+                                 RobustCostType, Schedule)
+    from dpgo_tpu.models import rbcd
+    from dpgo_tpu.utils.g2o import read_g2o
+
+    fname, A, r, sched, robust, accel, ev, tpu_cap, cpu_cap = CONFIGS[name]
+    cpu = jax.devices()[0].platform == "cpu"
+    dtype = jnp.float64 if cpu else jnp.float32
+    cap = cpu_cap if cpu else tpu_cap
+    meas = read_g2o(f"{DATA}/{fname}")
+    params = AgentParams(
+        d=meas.d, r=r, num_robots=A, schedule=Schedule(sched),
+        robust=RobustCostParams(cost_type=RobustCostType.GNC_TLS)
+        if robust else RobustCostParams(),
+        rel_change_tol=0.0, acceleration=accel, restart_interval=100,
+    )
+
+    # Warm-up: compile every program variant (init, segment flavors,
+    # metrics) outside the clock — steady-state timing, bench.py
+    # convention.  Must cross one eval boundary AND (accelerated) one
+    # restart boundary: the restart-first segment variant compiles
+    # separately, and a cold compile inside the clock once cost ~5 s of a
+    # 7 s run.
+    warm = 2 * ev if not accel else max(2 * ev, 100 + ev)
+    _ = rbcd.solve_rbcd(meas, A, params, max_iters=warm, grad_norm_tol=0.0,
+                        eval_every=ev, dtype=dtype)
+
+    t0 = time.perf_counter()
+    res = rbcd.solve_rbcd(meas, A, params, max_iters=cap, grad_norm_tol=GATE,
+                          eval_every=ev, dtype=dtype)
+    wall = time.perf_counter() - t0
+    gn = float(res.grad_norm_history[-1])
+    return dict(config=name, arm="cpu_f64" if cpu else "tpu_f32",
+                reached=bool(gn < GATE), gate=GATE, rounds=res.iterations,
+                wall=round(wall, 2), final_gradnorm=gn,
+                final_cost=float(res.cost_history[-1]),
+                terminated_by=res.terminated_by)
+
+
+def main():
+    names = [a for a in sys.argv[1:] if not a.startswith("-")] \
+        or list(CONFIGS)
+    if os.environ.get("GATE_MODE") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_x64", True)
+        print(json.dumps(run_config(names[0])))
+        return
+
+    rows = []
+    for name in names:
+        row = run_config(name)
+        log(f"[{name}] tpu: reached={row['reached']} rounds={row['rounds']} "
+            f"wall={row['wall']}s gn={row['final_gradnorm']:.3f}")
+        rows.append(row)
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), name],
+            env=dict(os.environ, GATE_MODE="cpu", PYTHONPATH="/root/repo"),
+            capture_output=True, text=True, timeout=7200)
+        if out.returncode != 0:
+            log(f"[{name}] cpu arm FAILED:\n{out.stderr[-1500:]}")
+            continue
+        crow = json.loads(out.stdout.strip().splitlines()[-1])
+        log(f"[{name}] cpu: reached={crow['reached']} rounds={crow['rounds']} "
+            f"wall={crow['wall']}s gn={crow['final_gradnorm']:.3f}")
+        rows.append(crow)
+
+    print("\n| config | arm | reached gate (gn<0.1) | rounds | wall | "
+          "final gradnorm |")
+    print("|---|---|---|---|---|---|")
+    for w in rows:
+        print(f"| {w['config']} | {w['arm']} | {w['reached']} | {w['rounds']} "
+              f"| {w['wall']}s | {w['final_gradnorm']:.3f} |")
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "time_to_gate_results.json")
+    with open(path, "w") as f:
+        json.dump(rows, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -39,9 +39,11 @@ DATA_DIR = "/root/reference/data"
 SLOW_TESTS = {
     "test_colored_schedule_with_acceleration",
     "test_four_process_robust_tcp_matches_in_process",
+    "test_agent_iterate_pallas_kernel_matches_ell",
     "test_four_process_tcp_solve_matches_two",
     "test_four_process_async_tcp_solve",
     "test_rounds_bf16_select_tracks_ell_path",
+    "test_rounds_bf16x3_select_matches_f32_kernel",
     "test_colored_fixes_jacobi_oscillation_ais2klinik",
     "test_colored_schedule_converges_and_matches_structure",
     "test_accelerated_solve",

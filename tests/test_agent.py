@@ -413,3 +413,34 @@ def test_aux_shared_pose_getter():
     blk = a0.get_aux_shared_pose(0)
     assert blk is not None and blk.shape == (a0.r, a0.d + 1)
     assert a0.get_aux_shared_pose(a0.n) is None
+
+
+def test_agent_iterate_pallas_kernel_matches_ell():
+    """The deployment surface must run the SAME engine as the batched
+    core: with pallas_tcg forced (interpreter mode off-TPU), each robot's
+    ``iterate()`` routes through the fused VMEM kernel
+    (``agent._pallas_tiles`` -> ``rtr_full_call``) and the trajectory must
+    match the ELL-path agents to kernel-parity tolerance (the f32 kernel
+    vs the f64 ELL path; VERDICT r3 weak item 8)."""
+    from dpgo_tpu.config import SolverParams
+
+    kw = dict(rel_change_tol=0.0)
+    ag_k, part, _ = make_agents(
+        2, n=10, num_lc=4,
+        solver=SolverParams(pallas_tcg=True, grad_norm_tol=1e-9), **kw)
+    ag_e, _, _ = make_agents(
+        2, n=10, num_lc=4,
+        solver=SolverParams(pallas_tcg=False, grad_norm_tol=1e-9), **kw)
+    # The kernel path must actually be engaged, not silently skipped.
+    assert ag_k[0]._pallas_tiles() is not None
+    assert ag_e[0]._pallas_tiles() is None
+    for it in range(4):
+        exchange(ag_k)
+        exchange(ag_e)
+        for ag in ag_k:
+            ag.iterate(True)
+        for ag in ag_e:
+            ag.iterate(True)
+    for k, e in zip(ag_k, ag_e):
+        assert np.allclose(k.X, e.X, atol=5e-5), \
+            np.abs(k.X - e.X).max()

@@ -100,7 +100,7 @@ def test_sharded_staircase_escapes_winding_minimum():
         dtype=jnp.float64, X0=np.asarray(Xa0))
     assert cert.certified
     assert rank >= 3  # the winding configuration is rank-2 critical
-    costs = [f for _, f, _ in hist]
+    costs = [f for _, f, *_ in hist]
     assert all(b < a for a, b in zip(costs, costs[1:]))  # strict descent
     assert costs[0] > 1.0      # started at the suboptimal critical point
     assert costs[-1] < 1e-2    # certified solution is the near-zero optimum

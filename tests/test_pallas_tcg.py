@@ -153,3 +153,30 @@ def test_rounds_bf16_select_tracks_ell_path(rng):
         sp = rbcd.rbcd_step(sp, graph, meta, pp)
         se = rbcd.rbcd_step(se, graph, meta, pe)
     assert np.allclose(sp.X, se.X, atol=3e-4)
+
+
+def test_rounds_bf16x3_select_matches_f32_kernel(rng):
+    """bf16x3 selection (hi/mid/lo split covers the full 24-bit f32
+    mantissa; the 0/1 one-hots are bf16-exact, so no cross terms): rounds
+    must match BOTH the f32-precision kernel and the ELL path to f32
+    round-off scale — an order tighter than the 2-pass mode's 3e-4
+    budget — making it an f32-equivalent mode at half the MXU passes."""
+    graph, meta, X0 = _setup(rng)
+    px = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.JACOBI,
+                     solver=SolverParams(pallas_tcg=True,
+                                         pallas_sel_mode="bf16x3"))
+    pf = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.JACOBI,
+                     solver=SolverParams(pallas_tcg=True))
+    pe = AgentParams(d=3, r=5, num_robots=4, schedule=Schedule.JACOBI,
+                     solver=SolverParams(pallas_tcg=False))
+    sx = rbcd.init_state(graph, meta, X0, params=px)
+    sf = rbcd.init_state(graph, meta, X0, params=pf)
+    se = rbcd.init_state(graph, meta, X0, params=pe)
+    for _ in range(3):
+        sx = rbcd.rbcd_step(sx, graph, meta, px)
+        sf = rbcd.rbcd_step(sf, graph, meta, pf)
+        se = rbcd.rbcd_step(se, graph, meta, pe)
+    assert np.allclose(sx.X, sf.X, atol=2e-5), \
+        np.abs(np.asarray(sx.X) - np.asarray(sf.X)).max()
+    assert np.allclose(sx.X, se.X, atol=2e-5), \
+        np.abs(np.asarray(sx.X) - np.asarray(se.X)).max()
