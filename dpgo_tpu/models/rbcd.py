@@ -1458,6 +1458,14 @@ def solve_rbcd_robust_iterated(
     The reference's GNC is single-pass (``updateLoopClosuresWeights``,
     ``PGOAgent.cpp:1181-1245``); the iteration is beyond-reference.
 
+    Between passes, previously-dropped edges whose residual at the new
+    solution falls back inside the TLS inlier boundary (``gnc_barc``) are
+    REINSTATED: at heavy corruption the re-anneal over-rejects borderline
+    clean edges (measured at 40%: precision 0.87-0.97), and once the
+    iterate no longer carries the outliers' distortion, a wrongly-dropped
+    edge is cheap to recognize — its residual is small again.  (The
+    consensus re-test of RANSAC-style pipelines; beyond-reference.)
+
     Returns ``(result_of_last_pass, weights_full, kept_mask)`` where
     ``weights_full [M]`` maps the last pass's weights back to the
     ORIGINAL measurement indices (dropped edges report weight 0) and
@@ -1473,6 +1481,7 @@ def solve_rbcd_robust_iterated(
         # which would silently undo the per-pass edge filtering.
         raise ValueError("solve_rbcd_robust_iterated re-partitions each "
                          "pass; 'part' cannot be supplied")
+    lc = loop_closure_mask(meas)
     kept = np.ones(len(meas), bool)
     res = None
     total_rounds = 0
@@ -1486,9 +1495,40 @@ def solve_rbcd_robust_iterated(
         w_full[kept] = w_sub
         if p == passes - 1:
             break
-        drop = (w_full < reject_thresh) & kept & loop_closure_mask(meas)
-        if not drop.any():
+        drop = (w_full < reject_thresh) & kept & lc
+        # Re-test every previously-dropped edge against the new iterate.
+        reinstate = np.zeros(len(meas), bool)
+        dropped = ~kept
+        if dropped.any():
+            rn = _global_residual_norms(res, meas, num_robots,
+                                        params.r if params else 5)
+            barc = params.robust.gnc_barc if params else 10.0
+            reinstate = dropped & (rn < barc)
+            w_full[reinstate] = 1.0
+        new_kept = (kept & ~drop) | reinstate
+        if (new_kept == kept).all():
             break
-        kept = kept & ~drop
+        kept = new_kept
     res = dataclasses.replace(res, iterations=total_rounds)
     return res, w_full, kept
+
+
+def _global_residual_norms(res: RBCDResult, meas: Measurements,
+                           num_robots: int, rank: int) -> np.ndarray:
+    """Per-measurement residual norms sqrt(kappa ||rR||^2 + tau ||rt||^2)
+    of the FULL original measurement set at a result's iterate (the
+    iterate lives on the filtered problem; poses are unchanged by edge
+    filtering, so the pose layout is partition-independent).  The gather
+    uses the Partition's index table directly — no need to rebuild the
+    whole multi-agent graph for its ``global_index`` alone."""
+    edges_g = edge_set_from_measurements(meas, dtype=jnp.float32)
+    part = partition_contiguous(meas, num_robots)
+    X = np.asarray(res.X, np.float32)
+    Xg = np.zeros((meas.num_poses,) + X.shape[2:], np.float32)
+    idx = part.global_index  # [A, n_max], -1 on padding
+    valid = idx >= 0
+    Xg[idx[valid]] = X[valid]
+    rR, rt = quadratic._edge_terms(jnp.asarray(Xg), edges_g)
+    sq = edges_g.kappa * jnp.sum(rR * rR, axis=(-2, -1)) \
+        + edges_g.tau * jnp.sum(rt * rt, axis=-1)
+    return np.sqrt(np.maximum(np.asarray(sq), 0.0))

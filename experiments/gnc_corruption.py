@@ -119,16 +119,18 @@ def run_one(fname: str, A: int, r: int, rounds: int, fraction: float,
         rel_change_tol=0.0, acceleration=True, restart_interval=100,
     )
     t0 = time.perf_counter()
-    # Iterated (2-pass) GNC: anneal, hard-drop rejected LCs, re-anneal —
+    # Iterated (3-pass) GNC: anneal, hard-drop rejected LCs, re-anneal —
     # a single pass at BCD inner-convergence leaves a few gross outliers
     # above the rejection threshold, and they bend the whole solution
-    # (see solve_rbcd_robust_iterated's docstring for the measurement).
-    # Init is chordal, not odometry: the iterated anneal recovers from a
-    # corruption-poisoned chordal basin, while city10000's odometry
-    # drift is unrecoverable (A/B in centralized_odometry_init's
-    # docstring).
+    # (see solve_rbcd_robust_iterated's docstring for the measurement);
+    # pass boundaries also REINSTATE wrongly-dropped edges whose residual
+    # at the cleaner iterate re-enters the TLS inlier band (the 40%
+    # over-rejection fix).  Init is chordal, not odometry: the iterated
+    # anneal recovers from a corruption-poisoned chordal basin, while
+    # city10000's odometry drift is unrecoverable (A/B in
+    # centralized_odometry_init's docstring).
     res, w, kept = rbcd.solve_rbcd_robust_iterated(
-        meas, A, params, passes=2, max_iters=rounds, grad_norm_tol=0.0,
+        meas, A, params, passes=3, max_iters=rounds, grad_norm_tol=0.0,
         eval_every=rounds // 4, dtype=dtype)
     wall = time.perf_counter() - t0
 
