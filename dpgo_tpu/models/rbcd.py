@@ -1500,8 +1500,7 @@ def solve_rbcd_robust_iterated(
         reinstate = np.zeros(len(meas), bool)
         dropped = ~kept
         if dropped.any():
-            rn = _global_residual_norms(res, meas, num_robots,
-                                        params.r if params else 5)
+            rn = _global_residual_norms(res, meas, num_robots)
             barc = params.robust.gnc_barc if params else 10.0
             reinstate = dropped & (rn < barc)
             w_full[reinstate] = 1.0
@@ -1514,7 +1513,7 @@ def solve_rbcd_robust_iterated(
 
 
 def _global_residual_norms(res: RBCDResult, meas: Measurements,
-                           num_robots: int, rank: int) -> np.ndarray:
+                           num_robots: int) -> np.ndarray:
     """Per-measurement residual norms sqrt(kappa ||rR||^2 + tau ||rt||^2)
     of the FULL original measurement set at a result's iterate (the
     iterate lives on the filtered problem; poses are unchanged by edge

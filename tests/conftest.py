@@ -66,6 +66,7 @@ SLOW_TESTS = {
     "test_distributed_initialization_and_consensus_solve",
     "test_gnc_rejects_outliers_and_recovers",
     "test_gnc_corruption_protocol_precision_recall",
+    "test_gnc_reinstatement_recovers_over_rejected_edges",
     "test_sharded_matches_single_device",
     "test_checkpoint_resume_matches_uninterrupted",
     "test_rbcd_matches_centralized_on_noisy_graph",
