@@ -1517,16 +1517,12 @@ def _global_residual_norms(res: RBCDResult, meas: Measurements,
     """Per-measurement residual norms sqrt(kappa ||rR||^2 + tau ||rt||^2)
     of the FULL original measurement set at a result's iterate (the
     iterate lives on the filtered problem; poses are unchanged by edge
-    filtering, so the pose layout is partition-independent).  The gather
-    uses the Partition's index table directly — no need to rebuild the
-    whole multi-agent graph for its ``global_index`` alone."""
+    filtering, so the pose layout is partition-independent)."""
+    from ..utils.partition import gather_poses_to_global
+
     edges_g = edge_set_from_measurements(meas, dtype=jnp.float32)
     part = partition_contiguous(meas, num_robots)
-    X = np.asarray(res.X, np.float32)
-    Xg = np.zeros((meas.num_poses,) + X.shape[2:], np.float32)
-    idx = part.global_index  # [A, n_max], -1 on padding
-    valid = idx >= 0
-    Xg[idx[valid]] = X[valid]
+    Xg = gather_poses_to_global(np.asarray(res.X, np.float32), part)
     rR, rt = quadratic._edge_terms(jnp.asarray(Xg), edges_g)
     sq = edges_g.kappa * jnp.sum(rR * rR, axis=(-2, -1)) \
         + edges_g.tau * jnp.sum(rt * rt, axis=-1)

@@ -148,17 +148,23 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
             acc = t if acc is None else acc + t
         return acc
 
-    def gather(V, Sel):  # [rk, m] x [m, T] -> [rk, T]
-        return _sel_dot(V, Sel, (((1,), (0,)), ((), ())))
+    def onehot2(ii, jj, m, base):
+        """[m, 2T] PAIRED one-hot: columns [:T] select the i endpoints,
+        [T:] the j endpoints — one iota compare builds both, one matmul
+        gathers both, one matmul scatters both (half the dot count of
+        separate i/j selection; the MXU work is identical but the
+        fori_loop interleaves fewer, wider dots with the VPU edge math)."""
+        idx2 = jnp.concatenate([ii, jj], axis=-1)
+        io = jax.lax.broadcasted_iota(jnp.int32, (m, 2 * T), 0)
+        return ((idx2 - base) == io).astype(sel_t)
 
-    def scatter(G, Sel):  # [rk, T] x [m, T] -> [rk, m]  (scatter-add)
-        return _sel_dot(G, Sel, (((1,), (1,)), ((), ())))
+    def gather_pair(V, Sel2):  # [rk, m] x [m, 2T] -> ([rk, T], [rk, T])
+        g = _sel_dot(V, Sel2, (((1,), (0,)), ((), ())))
+        return g[:, :T], g[:, T:]
 
-    def onehot(idx_row, m, base):
-        """[m, T] one-hot of (idx - base): column e selects row idx[e]-base,
-        all-zero when the shifted index falls outside [0, m)."""
-        io = jax.lax.broadcasted_iota(jnp.int32, (m, T), 0)
-        return ((idx_row - base) == io).astype(sel_t)
+    def scatter_pair(Gi, Gj, Sel2):  # scatter-add both endpoint stacks
+        return _sel_dot(jnp.concatenate([Gi, Gj], axis=-1), Sel2,
+                        (((1,), (1,)), ((), ())))
 
     def rows(mat):
         return [mat[i] for i in range(mat.shape[0])]
@@ -168,18 +174,16 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
 
     if hoist_scratch is not None:
         # Small-shape fast path: materialize the local one-hot tiles once
-        # per kernel invocation into VMEM scratch ([nt, n, T] refs, which
-        # support the tile loop's dynamic index) instead of rebuilding them
-        # in every tCG iteration — the compare/convert VPU work is ~10% of
-        # a small-problem round.
-        si_scr, sj_scr = hoist_scratch
+        # per kernel invocation into VMEM scratch (an [nt, n, 2T] ref,
+        # which supports the tile loop's dynamic index) instead of
+        # rebuilding them in every tCG iteration — the compare/convert
+        # VPU work is ~10% of a small-problem round.
+        s2_scr, = hoist_scratch
         for t in range(nt):  # static-index stores, once per invocation
-            si_scr[t] = onehot(idx_i_ref[t], n, 0)
-            sj_scr[t] = onehot(idx_j_ref[t], n, 0)
-        local_sel = lambda ti: (si_scr[ti], sj_scr[ti])
+            s2_scr[t] = onehot2(idx_i_ref[t], idx_j_ref[t], n, 0)
+        local_sel2 = lambda ti: s2_scr[ti]
     else:
-        local_sel = lambda ti: (onehot(idx_i_ref[ti], n, 0),
-                                onehot(idx_j_ref[ti], n, 0))
+        local_sel2 = lambda ti: onehot2(idx_i_ref[ti], idx_j_ref[ti], n, 0)
 
     def tile_loop(tile_fn, init):
         return jax.lax.fori_loop(0, nt, tile_fn, init)
@@ -220,16 +224,17 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         (``quadratic.hessvec``)."""
 
         def tile(ti, acc):
-            sel_i, sel_j = local_sel(ti)
+            sel2 = local_sel2(ti)
             R = rows(rot_ref[ti])
             t = rows(trn_ref[ti])
             wk = wk_ref[ti][0]
             wt = wt_ref[ti][0]
-            Vi = rows(gather(V, sel_i))
-            Vj = rows(gather(V, sel_j))
+            Vi2, Vj2 = gather_pair(V, sel2)
+            Vi = rows(Vi2)
+            Vj = rows(Vj2)
             rR, rt = edge_residuals(Vi, Vj, R, t)
             gi, gj = edge_grad_rows(rR, rt, R, t, wk, wt)
-            return acc + scatter(stack(gi), sel_i) + scatter(stack(gj), sel_j)
+            return acc + scatter_pair(stack(gi), stack(gj), sel2)
 
         return tile_loop(tile, jnp.zeros((rk, n), f32))
 
@@ -247,18 +252,19 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         def tile(ti, acc):
             ii = idx_i_ref[ti]
             jj = idx_j_ref[ti]
-            sel_i, sel_j = local_sel(ti)
-            seln_i = onehot(ii, s, n)
-            seln_j = onehot(jj, s, n)
+            sel2 = local_sel2(ti)
+            seln2 = onehot2(ii, jj, s, n)
             R = rows(rot_ref[ti])
             t = rows(trn_ref[ti])
             wk = wk_ref[ti][0]
             wt = wt_ref[ti][0]
-            Vi = rows(gather(Xv, sel_i) + gather(Zv, seln_i))
-            Vj = rows(gather(Xv, sel_j) + gather(Zv, seln_j))
+            Xi2, Xj2 = gather_pair(Xv, sel2)
+            Zi2, Zj2 = gather_pair(Zv, seln2)
+            Vi = rows(Xi2 + Zi2)
+            Vj = rows(Xj2 + Zj2)
             rR, rt = edge_residuals(Vi, Vj, R, t)
             gi, gj = edge_grad_rows(rR, rt, R, t, wk, wt)
-            return acc + scatter(stack(gi), sel_i) + scatter(stack(gj), sel_j)
+            return acc + scatter_pair(stack(gi), stack(gj), sel2)
 
         return tile_loop(tile, jnp.zeros((rk, n), f32))
 
@@ -274,15 +280,16 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         def tile(ti, acc):
             ii = idx_i_ref[ti]
             jj = idx_j_ref[ti]
-            sel_i, sel_j = local_sel(ti)
-            seln_i = onehot(ii, s, n)
-            seln_j = onehot(jj, s, n)
+            sel2 = local_sel2(ti)
+            seln2 = onehot2(ii, jj, s, n)
             R = rows(rot_ref[ti])
             t = rows(trn_ref[ti])
             wk = wk_ref[ti][0]
             wt = wt_ref[ti][0]
-            Vi = rows(gather(V, sel_i) + gather(Z, seln_i))
-            Vj = rows(gather(V, sel_j) + gather(Z, seln_j))
+            Vi2, Vj2 = gather_pair(V, sel2)
+            Zi2, Zj2 = gather_pair(Z, seln2)
+            Vi = rows(Vi2 + Zi2)
+            Vj = rows(Vj2 + Zj2)
             rR, rt = edge_residuals(Vi, Vj, R, t)
             quad = wk * sum(rR[a][c] * rR[a][c]
                             for a in range(r) for c in range(d)) \
@@ -758,7 +765,7 @@ def tcg_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Sc, Lc, gc, radius,
     nt, T = idx_i.shape[0], idx_i.shape[-1]
     if hoist is None:
         hoist = should_hoist(nt, T, n)
-    scratch = [pltpu.VMEM((nt, n, T), jnp.float32)] * 2 if hoist else []
+    scratch = [pltpu.VMEM((nt, n, 2 * T), jnp.float32)] if hoist else []
     return pl.pallas_call(
         kern,
         out_shape=(
@@ -793,7 +800,7 @@ def rtr_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Sc, Lc,
     nt, T = idx_i.shape[0], idx_i.shape[-1]
     if hoist is None:
         hoist = should_hoist(nt, T, n)
-    scratch = [pltpu.VMEM((nt, n, T), jnp.float32)] * 2 if hoist else []
+    scratch = [pltpu.VMEM((nt, n, 2 * T), jnp.float32)] if hoist else []
     return pl.pallas_call(
         kern,
         out_shape=(
@@ -833,7 +840,7 @@ def rtr_full_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, Xc, Zc, Lc,
     if hoist is None:
         hoist = should_hoist(nt, T, n, itemsize=4 if sel_mode == "f32" else 2)
     sel_t = jnp.float32 if sel_mode == "f32" else jnp.bfloat16
-    scratch = [pltpu.VMEM((nt, n, T), sel_t)] * 2 if hoist else []
+    scratch = [pltpu.VMEM((nt, n, 2 * T), sel_t)] if hoist else []
     return pl.pallas_call(
         kern,
         out_shape=(
@@ -875,7 +882,7 @@ def rtr_refine_full_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, rho_rot,
     if hoist is None:
         hoist = should_hoist(nt, T, n, itemsize=4 if sel_mode == "f32" else 2)
     sel_t = jnp.float32 if sel_mode == "f32" else jnp.bfloat16
-    scratch = [pltpu.VMEM((nt, n, T), sel_t)] * 2 if hoist else []
+    scratch = [pltpu.VMEM((nt, n, 2 * T), sel_t)] if hoist else []
     return pl.pallas_call(
         kern,
         out_shape=(
@@ -890,19 +897,20 @@ def rtr_refine_full_call(idx_i, idx_j, rot_t, trn_t, wk_t, wt_t, rho_rot,
       Rc, Dc, Dzc, g0c, Grefc, S0c, Lc)
 
 
-#: Hoisted one-hot budget: materialize the [nt, n, T] local selection
-#: stacks once per kernel invocation when they fit alongside the rest of
-#: the working set.
+#: Hoisted one-hot budget: materialize the [nt, n, 2T] paired local
+#: selection stack once per kernel invocation when it fits alongside the
+#: rest of the working set.
 HOIST_BUDGET_BYTES = 4 << 20
 
 
 def hoist_scratch_bytes(nt: int, tile: int, n: int,
                         itemsize: int = 4) -> int:
-    """Bytes of the two [nt, n, T] one-hot scratch stacks — the single
-    source for ``should_hoist``, the kernels' ``scratch_shapes``, and the
-    dispatch gate's VMEM estimate (``rbcd._pallas_vmem_ok``).  ``itemsize``
-    is 2 under the bf16 selection modes (bf16 one-hots), else 4."""
-    return 2 * nt * tile * n * itemsize
+    """Bytes of the single [nt, n, 2T] PAIRED one-hot scratch stack
+    (i-columns then j-columns per tile) — the single source for
+    ``should_hoist``, the kernels' ``scratch_shapes``, and the dispatch
+    gate's VMEM estimate (``rbcd._pallas_vmem_ok``).  ``itemsize`` is 2
+    under the bf16 selection modes (bf16 one-hots), else 4."""
+    return nt * (2 * tile) * n * itemsize
 
 
 def should_hoist(nt: int, tile: int, n: int, itemsize: int = 4) -> bool:

@@ -141,3 +141,18 @@ def partition_by_keys(meas: Measurements) -> Partition:
     )
     return Partition(num_robots=A, meas=local, n=n,
                      global_index=global_index, meas_global=meas_global)
+
+
+def gather_poses_to_global(X, part: Partition):
+    """Per-agent pose array ``[A, n_max, ...]`` -> global ``[N, ...]``
+    using only the Partition's index table (numpy; no multi-agent graph
+    needed).  The pose layout depends only on ``num_poses``, so a
+    filtered problem's iterate gathers with the full measurement set's
+    partition."""
+    import numpy as np
+
+    X = np.asarray(X)
+    out = np.zeros((int(part.meas_global.num_poses),) + X.shape[2:], X.dtype)
+    valid = part.global_index >= 0
+    out[part.global_index[valid]] = X[valid]
+    return out

@@ -142,15 +142,10 @@ def centralized_continuation(meas, res, A, r, dtype, ev):
     if res.weights is not None:
         meas_w = dataclasses.replace(
             meas, weight=np.asarray(res.weights, np.float64))
-    # Gather res.X to global via the Partition's index table alone — no
-    # need to rebuild the multi-agent graph for its global_index
-    # (the _global_residual_norms trick, models/rbcd.py).
-    part0 = partition_contiguous(meas, A)
-    X0np = np.asarray(res.X)
-    Xg_np = np.zeros((meas.num_poses,) + X0np.shape[2:], X0np.dtype)
-    valid0 = part0.global_index >= 0
-    Xg_np[part0.global_index[valid0]] = X0np[valid0]
-    Xg = jnp.asarray(Xg_np)
+    from dpgo_tpu.utils.partition import gather_poses_to_global
+
+    Xg = jnp.asarray(gather_poses_to_global(res.X,
+                                            partition_contiguous(meas, A)))
 
     part1 = partition_contiguous(meas_w, 1)
     graph1, meta1 = rbcd.build_graph(part1, r, dtype)
