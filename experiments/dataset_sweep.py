@@ -64,8 +64,10 @@ def one(fname, A, r, rounds):
     dtype = jnp.float32 if jax.devices()[0].platform != "cpu" \
         else jnp.float64
     meas = read_g2o(f"{DATA}/{fname}")
+    from dpgo_tpu.config import SolverParams
     params = AgentParams(d=meas.d, r=r, num_robots=A,
-                         schedule=Schedule.COLORED, rel_change_tol=0.0)
+                         schedule=Schedule.COLORED, rel_change_tol=0.0,
+                         solver=SolverParams(pallas_sel_mode="bf16x3"))
     part = partition_contiguous(meas, A)
     graph, meta = rbcd.build_graph(part, r, dtype)
     X0 = rbcd.centralized_chordal_init(part, meta, graph, dtype)
