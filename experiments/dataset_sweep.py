@@ -54,7 +54,7 @@ def log(*a):
 def one(fname, A, r, rounds):
     import jax
     import jax.numpy as jnp
-    from dpgo_tpu.config import AgentParams, Schedule
+    from dpgo_tpu.config import AgentParams, Schedule, SolverParams
     from dpgo_tpu.models import rbcd
     from dpgo_tpu.ops import manifold, quadratic
     from dpgo_tpu.types import edge_set_from_measurements
@@ -64,7 +64,6 @@ def one(fname, A, r, rounds):
     dtype = jnp.float32 if jax.devices()[0].platform != "cpu" \
         else jnp.float64
     meas = read_g2o(f"{DATA}/{fname}")
-    from dpgo_tpu.config import SolverParams
     params = AgentParams(d=meas.d, r=r, num_robots=A,
                          schedule=Schedule.COLORED, rel_change_tol=0.0,
                          solver=SolverParams(pallas_sel_mode="bf16x3"))

@@ -50,14 +50,18 @@ CONFIGS = {
     # kitti_00: near-chain graph, BCD plateaus at gn ~27 from 648 on BOTH
     # arms (6000 rounds) — the gate is unreachable for block-coordinate
     # descent here regardless of arm; both rows document the bound.
-    "kitti_00": ("kitti_00.g2o", 16, 3, "async", False, False, 100,
+    # Eval cadences on the long GNC runs are sized to the tunnel's 90 ms
+    # readback: at cadence 100 the ais run paid ~600 evals = ~54 s of
+    # pure round-trips out of 150 s; 300-500 trades <= one cadence of
+    # overshoot (~1 s of rounds) for most of that.
+    "kitti_00": ("kitti_00.g2o", 16, 3, "async", False, False, 300,
                  6000, 6000, True),
-    "city10000_gnc": ("city10000.g2o", 32, 3, "jacobi", True, False, 100,
+    "city10000_gnc": ("city10000.g2o", 32, 3, "jacobi", True, False, 300,
                       15000, 12000, True),
     # ais2klinik: hybrid excluded by measurement — A=1 rounds run at
     # ~2.8/s (15k poses, deep tCG) and 3000 of them moved gn only
     # 2.016 -> 2.004 for 1084 s; the gate row stands as a bound.
-    "ais2klinik_gnc": ("ais2klinik.g2o", 32, 3, "colored", True, False, 100,
+    "ais2klinik_gnc": ("ais2klinik.g2o", 32, 3, "colored", True, False, 500,
                        60000, 6000, False),
 }
 
@@ -163,6 +167,11 @@ def centralized_continuation(meas, res, A, r, dtype, ev):
 
     Xa = rbcd.scatter_to_agents(Xg, graph1)
     state = rbcd.init_state(graph1, meta1, Xa, params=params1)
+    # A=1 deep-tCG rounds are expensive (a few per second on large
+    # graphs), so the distributed run's eval cadence would overshoot the
+    # gate by tens of seconds here — check at most every 100 rounds,
+    # where <= 10 readbacks total are negligible.
+    ev1 = min(ev, 100)
     # Warm-up compile outside the clock (steady-state convention).
     _ = float(central_gn(rbcd.rbcd_steps(state, graph1, 1, meta1,
                                          params1).X))
@@ -170,8 +179,8 @@ def centralized_continuation(meas, res, A, r, dtype, ev):
     rounds = 0
     gn = float("inf")
     while rounds < 3000:
-        state = rbcd.rbcd_steps(state, graph1, ev, meta1, params1)
-        rounds += ev
+        state = rbcd.rbcd_steps(state, graph1, ev1, meta1, params1)
+        rounds += ev1
         gn = float(central_gn(state.X))
         if gn < GATE:
             break
