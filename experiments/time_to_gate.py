@@ -9,11 +9,14 @@ BASELINE.md).  Configs whose gradnorm plateaus above the gate (kitti_00's
 near-chain graph) are run to a round cap on BOTH arms to show the plateau
 is a property of block-coordinate descent on that graph, not of the arm.
 
-Protocol: solve_rbcd with eval cadence 25-100 rounds (the eval readbacks
-are inside the clock — they are how the driver decides to stop, exactly
-as the reference's centralized monitor is), compile warmed by a short
-throwaway solve.  CPU arm runs in a subprocess (x64 cannot be enabled in
-the tunnel process; see bench.py).
+Protocol: solve_rbcd with a per-config eval cadence (25 rounds on the
+short configs; 300-500 on the long GNC runs, sized to the tunnel's
+90 ms/readback — the evals are inside the clock: they are how the
+driver decides to stop, exactly as the reference's centralized monitor
+is), compile warmed by a short throwaway solve.  The CPU arm (a
+subprocess — x64 cannot be enabled in the tunnel process; see bench.py)
+keeps cadence <= 100: it pays no readback latency, and a coarse cadence
+would only overshoot its gate crossings.
 
 Usage: python experiments/time_to_gate.py [config_name ...]
 """
@@ -83,6 +86,11 @@ def run_config(name: str):
     cpu = jax.devices()[0].platform == "cpu"
     dtype = jnp.float64 if cpu else jnp.float32
     cap = cpu_cap if cpu else tpu_cap
+    if cpu:
+        # The coarse cadences are sized to the tunnel's 90 ms readback,
+        # which the CPU arm does not pay — and a 300-round cadence would
+        # overshoot its gate crossing by up to ~26 s of f64 rounds.
+        ev = min(ev, 100)
     meas = read_g2o(f"{DATA}/{fname}")
     params = AgentParams(
         d=meas.d, r=r, num_robots=A, schedule=Schedule(sched),
