@@ -35,6 +35,13 @@ CPU_ROUNDS = int(os.environ.get("BENCH_CPU_ROUNDS", "25"))
 # inside the 5e-4 parity bound asserted below) at ~1.2x the HIGHEST-
 # emulation round rate on this shape.  Recorded in the output JSON.
 SEL_MODE = os.environ.get("BENCH_SEL_MODE", "bf16x3")
+# CPU f64 arm: number of time-spaced measurement windows and their spacing.
+# The 1-core host's effective f64 throughput swings up to 2x across thermal
+# / scheduling windows (BASELINE.md round-4 caveat), so a single window can
+# silently cherry-pick the headline; >=3 spaced windows give a min/median/
+# max band and vs_baseline is computed from the MEDIAN (VERDICT r4 item 7).
+CPU_WINDOWS = int(os.environ.get("BENCH_CPU_WINDOWS", "3"))
+CPU_WINDOW_SPACING_S = float(os.environ.get("BENCH_CPU_SPACING_S", "45"))
 
 
 def log(*a):
@@ -263,25 +270,53 @@ def main():
     log(f"  {ips:.2f} RBCD rounds/s ({bench_dtype})")
 
     if dev.platform == "cpu":
-        cpu_info = {"ips": ips, "contended": False}
+        windows = [{"ips": ips, "contended": False}]
     else:
-        cpu_info = cpu_baseline_subprocess()
+        # >=3 time-spaced windows of the f64 arm (VERDICT r4 item 7): the
+        # band makes the 2x thermal swing visible instead of letting one
+        # lucky window set the headline.
+        windows = []
+        for wi in range(max(CPU_WINDOWS, 1)):
+            if wi:
+                log(f"  [cpu] window spacing: sleeping "
+                    f"{CPU_WINDOW_SPACING_S:.0f}s")
+                time.sleep(CPU_WINDOW_SPACING_S)
+            windows.append(cpu_baseline_subprocess())
+            log(f"  [cpu] window {wi + 1}/{CPU_WINDOWS}: "
+                f"{windows[-1]['ips']:.2f} rounds/s"
+                + (" (CONTENDED)" if windows[-1].get("contended") else ""))
+    rates_all = [w["ips"] for w in windows]
+    # Contended windows under-measure the arm (inflating vs_baseline), so
+    # the band prefers clean windows and falls back to all only when no
+    # clean window exists — in which case the output is flagged.
+    clean = [w["ips"] for w in windows if not w.get("contended")] or rates_all
+    cpu_med = float(np.median(clean))
 
     out = {
         "metric": "rbcd_rounds_per_sec_sphere2500_8agents_r5",
         "value": round(ips, 3),
         "unit": "rounds/s",
-        "vs_baseline": round(ips / cpu_info["ips"], 3),
+        "vs_baseline": round(ips / cpu_med, 3),
         "sel_mode": SEL_MODE,
+        "cpu_arm_band": {"min": round(min(rates_all), 2),
+                         "median": round(cpu_med, 2),
+                         "max": round(max(rates_all), 2),
+                         "windows": [round(r, 2) for r in rates_all],
+                         "spacing_s": CPU_WINDOW_SPACING_S},
+        "vs_baseline_band": {"min": round(ips / max(rates_all), 2),
+                             "max": round(ips / min(rates_all), 2)},
     }
     if parity is not None:
         out["kernel_parity_max_abs_diff"] = parity
-    if cpu_info.get("contended"):
-        # The f64 arm ran on a loaded host, which inflates vs_baseline —
-        # the guard could not find a clean window, so flag the figure.
-        out["cpu_arm_contended"] = True
-        out["cpu_arm_other_busy_cores"] = cpu_info.get("other_busy_cores")
-        out["cpu_arm_load1"] = cpu_info.get("load1")
+    if any(w.get("contended") for w in windows):
+        # At least one f64 window ran on a loaded host; if ALL were
+        # contended the median itself is inflated — flag loudest then.
+        out["cpu_arm_contended_windows"] = sum(
+            1 for w in windows if w.get("contended"))
+        out["cpu_arm_all_contended"] = all(
+            w.get("contended") for w in windows)
+        out["cpu_arm_other_busy_cores"] = max(
+            w.get("other_busy_cores") or 0.0 for w in windows)
     print(json.dumps(out))
 
 

@@ -43,7 +43,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import AgentParams, ROptAlg, RobustCostType, Schedule
+from ..config import (AgentParams, ROptAlg, RobustCostParams,
+                      RobustCostType, Schedule)
 from .. import robust
 from ..types import EdgeSet, Measurements, edge_set_from_measurements
 from ..utils.graph_plan import plan_topology
@@ -1489,6 +1490,14 @@ def solve_rbcd_robust_iterated(
         sub = meas.select(kept) if not kept.all() else meas
         res = solve_rbcd(sub, num_robots, params, **solve_kw)
         total_rounds += res.iterations
+        if res.weights is None and passes > 1:
+            # A non-robust cost (the default AgentParams) yields no GNC
+            # weights, so the drop/reinstate loop below would silently
+            # degenerate to a single plain solve — surface the misuse.
+            raise ValueError(
+                "solve_rbcd_robust_iterated needs a GNC-weighted cost "
+                "(params.robust.cost_type GNC_TLS); the solve returned no "
+                "weights")
         w_sub = np.asarray(res.weights) if res.weights is not None \
             else np.ones(int(kept.sum()))
         w_full = np.zeros(len(meas))
@@ -1501,7 +1510,8 @@ def solve_rbcd_robust_iterated(
         dropped = ~kept
         if dropped.any():
             rn = _global_residual_norms(res, meas, num_robots)
-            barc = params.robust.gnc_barc if params else 10.0
+            barc = (params.robust if params is not None
+                    else RobustCostParams()).gnc_barc
             reinstate = dropped & (rn < barc)
             w_full[reinstate] = 1.0
         new_kept = (kept & ~drop) | reinstate

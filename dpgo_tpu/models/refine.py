@@ -323,14 +323,21 @@ def host_edges_f64(meas) -> EdgeSet:
     return edge_set_from_measurements(meas, dtype=np.float64, as_numpy=True)
 
 
-def global_x(ref: RefineRef, D, graph) -> np.ndarray:
-    """Assemble the current global f64 iterate R + D (owners' D)."""
-    Dg = np.zeros_like(ref.Xg)
+def scatter_owned(Xg64: np.ndarray, D, graph) -> np.ndarray:
+    """HOST: add each owner's correction rows into a global f64 iterate
+    (the owner-scatter both the host-recenter and fused readback paths
+    assemble with)."""
+    Dg = np.zeros_like(Xg64)
     gi_np = np.asarray(graph.global_index)
     mask = np.asarray(graph.pose_mask) > 0
     Dnp = np.asarray(D, np.float64)
     Dg[gi_np[mask]] = Dnp[mask]
-    return ref.Xg + Dg
+    return Xg64 + Dg
+
+
+def global_x(ref: RefineRef, D, graph) -> np.ndarray:
+    """Assemble the current global f64 iterate R + D (owners' D)."""
+    return scatter_owned(ref.Xg, D, graph)
 
 
 def global_cost(X64: np.ndarray, edges_global) -> float:
@@ -570,33 +577,40 @@ def refine_rounds_accel(D, consts: RefineConstants, graph, meta,
       momentum oscillates once the gap is below ~1e-3 while the adaptive
       scheme keeps the re-centered descent monotone per cycle.
     """
-    A = meta.num_robots
-
     def body(_, carry):
-        D, V, gamma, restart = carry
-        # Collapse the aux sequence when last round's test fired
-        # (initializeAcceleration semantics: V = X, gamma = alpha = 0).
-        V = jnp.where(restart, D, V)
-        gamma = jnp.where(restart, jnp.zeros_like(gamma), gamma)
-
-        gamma = (1.0 + jnp.sqrt(1.0 + 4.0 * (A * gamma) ** 2)) / (2.0 * A)
-        alpha = 1.0 / (gamma * A)
-        Ynes = jax.vmap(_retract_d0)((1.0 - alpha) * D + alpha * V,
-                                     consts.R)
-        D_new, _gn = refine_round(Ynes, consts, graph, meta, params)
-        V = jax.vmap(_retract_d0)(V + gamma * (D_new - Ynes), consts.R)
-        # Adaptive restart test on the actual step vs the momentum lead.
-        # >= 0, not > 0: a zero step (solver rejected every attempt or
-        # early-exited at the gradient floor) gives exactly 0 and MUST
-        # restart — otherwise Ynes keeps extrapolating toward a stale V
-        # with no descent correction and the iterate runs away (measured
-        # at the f32 floor).
-        restart = jnp.sum((Ynes - D_new) * (D_new - D)) >= 0.0
-        return D_new, V, gamma, restart
+        return accel_round_carry(carry, consts, graph, meta, params)
 
     init = (D, D, jnp.zeros((), D.dtype), jnp.asarray(False))
     D_out, *_ = jax.lax.fori_loop(0, num_rounds, body, init)
     return D_out
+
+
+def accel_round_carry(carry, consts: RefineConstants, graph, meta,
+                      params: AgentParams):
+    """One accelerated re-centered round on the momentum carry
+    ``(D, V, gamma, restart)`` — the shared body of
+    ``refine_rounds_accel`` and the fused on-device loop
+    (``refine_fused.refine_until``), so the two pipelines cannot drift."""
+    A = meta.num_robots
+    D, V, gamma, restart = carry
+    # Collapse the aux sequence when last round's test fired
+    # (initializeAcceleration semantics: V = X, gamma = alpha = 0).
+    V = jnp.where(restart, D, V)
+    gamma = jnp.where(restart, jnp.zeros_like(gamma), gamma)
+
+    gamma = (1.0 + jnp.sqrt(1.0 + 4.0 * (A * gamma) ** 2)) / (2.0 * A)
+    alpha = 1.0 / (gamma * A)
+    Ynes = jax.vmap(_retract_d0)((1.0 - alpha) * D + alpha * V, consts.R)
+    D_new, _gn = refine_round(Ynes, consts, graph, meta, params)
+    V = jax.vmap(_retract_d0)(V + gamma * (D_new - Ynes), consts.R)
+    # Adaptive restart test on the actual step vs the momentum lead.
+    # >= 0, not > 0: a zero step (solver rejected every attempt or
+    # early-exited at the gradient floor) gives exactly 0 and MUST
+    # restart — otherwise Ynes keeps extrapolating toward a stale V
+    # with no descent correction and the iterate runs away (measured
+    # at the f32 floor).
+    restart = jnp.sum((Ynes - D_new) * (D_new - D)) >= 0.0
+    return D_new, V, gamma, restart
 
 
 _refine_rounds_jit = jax.jit(refine_rounds,
@@ -647,7 +661,8 @@ def solve_refine(Xg64: np.ndarray, graph, meta, params: AgentParams,
             # NaN compares False against every threshold, so it would
             # slip the worsened-gap safeguard below (and the manifold
             # projection would raise) — treat it as a worsened cycle.
-            assert best is not None, "initial iterate is non-finite"
+            if best is None:
+                raise ValueError("initial iterate is non-finite")
             accel_on = False
             Xg64 = best[1]
             last_revert = cyc

@@ -1,0 +1,255 @@
+"""Double-float32 (two-float) arithmetic for on-device f64-grade scalars.
+
+TPU v5e has no hardware f64, and the tunneled-TPU process cannot enable
+x64 even for host math (bench.py).  The round-4 certified-gap pipeline
+therefore kept its f64 work — manifold projection, recentered-gradient
+constants, the gap oracle — on the HOST, paying a fixed ~90 ms tunnel
+round-trip per device<->host handoff (two per certified run, ~47% of the
+wall clock, BASELINE.md).  This module provides the arithmetic that moves
+that work ON TO the device: every value is an unevaluated sum ``hi + lo``
+of two f32s (a "double-f32"), giving ~49 mantissa bits — measured
+1e-13-relative add/mul/dot accuracy on the actual TPU backend
+(``experiments/df32_spike.py``), far beyond the ~1e-9 the recentered
+refinement needs.
+
+The primitives are the classical error-free transforms:
+
+* ``two_sum`` (Knuth 1969): a + b = s + e exactly, 6 flops, no branches;
+* ``two_prod`` via Dekker's split (2^12 + 1 for the 24-bit f32 mantissa):
+  a * b = p + e exactly provided the compiler neither reassociates nor
+  contracts ``a * b - p`` into an fma with different rounding.  XLA's
+  default semantics preserve both (verified empirically by the spike and
+  pinned by ``tests/test_df32.py`` on every backend the suite runs on).
+
+Values travel as ``DF(hi, lo)`` pairs of same-shape arrays (a pytree), so
+whole tensors run in df32 with vectorized elementwise ops.  Reductions
+use pairwise folding (``fold_sum``) — O(log n) sequential df-adds of
+vectorized halves, cheap on the VPU.
+
+The reference framework never needed any of this: it runs f64 end-to-end
+on CPU (Eigen/ROPTLIB, e.g. ``CartanSyncVariable.cpp``); this module is
+what makes the equivalent precision reachable on f32 accelerator
+hardware without leaving the device.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DF(NamedTuple):
+    """A double-f32 value: the unevaluated exact sum ``hi + lo`` with
+    ``|lo| <= ulp(hi)/2`` (after renormalization)."""
+
+    hi: jax.Array
+    lo: jax.Array
+
+
+_SPLIT = np.float32(4097.0)  # 2^12 + 1: Dekker split constant for f32
+
+
+def _opaque(x):
+    """Hide a value's defining expression from XLA's algebraic simplifier.
+
+    Error-free transforms compute expressions like ``(a + b) - a`` whose
+    VALUE is the rounding error — exactly the quantity an algebraic
+    simplifier is licensed to cancel to ``b`` under real-number axioms.
+    XLA leaves the straight-line f32 versions alone, but pattern-matched
+    rewrites (observed: the broadcast-slice mul-add chain of a small
+    matmul on XLA:CPU gets turned into a ``dot``) re-associate through
+    them and collapse the error terms to zero, silently degrading df32
+    to f32 (caught by ``tests/test_df32.py``).  An optimization_barrier
+    on the primary result before the error-term computation makes the
+    cancellation invisible to the simplifier at the cost of one no-op
+    in the schedule."""
+    return jax.lax.optimization_barrier(x)
+
+
+def two_sum(a, b):
+    """Error-free sum: returns (s, e) with a + b == s + e exactly."""
+    s = _opaque(a + b)
+    bb = _opaque(s - a)
+    e = (a - _opaque(s - bb)) + (b - bb)
+    return s, e
+
+
+def quick_two_sum(a, b):
+    """Error-free sum assuming |a| >= |b| (3 flops)."""
+    s = _opaque(a + b)
+    return s, b - _opaque(s - a)
+
+
+def _split(a):
+    c = _SPLIT * a
+    hi = _opaque(c - _opaque(c - a))
+    return hi, a - hi
+
+
+def two_prod(a, b):
+    """Error-free product: returns (p, e) with a * b == p + e exactly."""
+    p = _opaque(a * b)
+    ah, al = _split(a)
+    bh, bl = _split(b)
+    e = (_opaque(ah * bh - p) + ah * bl + al * bh) + al * bl
+    return p, e
+
+
+# ---------------------------------------------------------------------------
+# Construction / destruction
+# ---------------------------------------------------------------------------
+
+def from_f32(x) -> DF:
+    x = jnp.asarray(x, jnp.float32)
+    return DF(x, jnp.zeros_like(x))
+
+
+def from_f64(x64) -> DF:
+    """HOST-side split of a numpy f64 array into an exact df32 pair
+    (|x| < ~1e31 so the lo part cannot underflow to zero significance)."""
+    x64 = np.asarray(x64, np.float64)
+    hi = x64.astype(np.float32)
+    lo = (x64 - hi.astype(np.float64)).astype(np.float32)
+    return DF(jnp.asarray(hi), jnp.asarray(lo))
+
+
+def to_f64(x: DF) -> np.ndarray:
+    """HOST-side exact reconstruction (for verification paths)."""
+    return (np.asarray(x.hi, np.float64) + np.asarray(x.lo, np.float64))
+
+
+# ---------------------------------------------------------------------------
+# Arithmetic (all elementwise, broadcasting like jnp)
+# ---------------------------------------------------------------------------
+
+def add(x: DF, y: DF) -> DF:
+    s, e = two_sum(x.hi, y.hi)
+    e = e + (x.lo + y.lo)
+    return DF(*quick_two_sum(s, e))
+
+
+def add_f(x: DF, y) -> DF:
+    s, e = two_sum(x.hi, y)
+    e = e + x.lo
+    return DF(*quick_two_sum(s, e))
+
+
+def neg(x: DF) -> DF:
+    return DF(-x.hi, -x.lo)
+
+
+def sub(x: DF, y: DF) -> DF:
+    return add(x, neg(y))
+
+
+def mul(x: DF, y: DF) -> DF:
+    p, e = two_prod(x.hi, y.hi)
+    e = e + (x.hi * y.lo + x.lo * y.hi)
+    return DF(*quick_two_sum(p, e))
+
+
+def mul_f(x: DF, y) -> DF:
+    p, e = two_prod(x.hi, y)
+    e = e + x.lo * y
+    return DF(*quick_two_sum(p, e))
+
+
+def scale(x: DF, c: float) -> DF:
+    """Multiply by an exactly-representable f32 scalar (e.g. 0.5, -1, 2)."""
+    c = jnp.float32(c)
+    return DF(x.hi * c, x.lo * c)
+
+
+def div(x: DF, y: DF) -> DF:
+    """Quotient via one Newton correction of the f32 estimate —
+    relative error ~2^-45, plenty for the tolerance scalars it serves."""
+    q1 = x.hi / y.hi
+    r = add(x, neg(mul_f(y, q1)))  # x - y*q1, exact to df32
+    q2 = r.hi / y.hi
+    return DF(*quick_two_sum(q1, q2))
+
+
+def sqrt(x: DF) -> DF:
+    """Square root via one Newton correction of the f32 estimate."""
+    s1 = jnp.sqrt(x.hi)
+    p, e = two_prod(s1, s1)  # s1^2 exactly, as a df pair
+    r = add(x, DF(-p, -e))
+    s2 = r.hi / (2.0 * s1)
+    return DF(*quick_two_sum(s1, s2))
+
+
+# ---------------------------------------------------------------------------
+# Reductions / contractions
+# ---------------------------------------------------------------------------
+
+def fold_sum(x: DF, axis: int = -1) -> DF:
+    """Pairwise (tree) df32 sum along ``axis``: O(log n) sequential
+    vectorized df-adds.  Error ~ eps_df * log2(n) * sum|terms|."""
+    hi = jnp.moveaxis(x.hi, axis, -1)
+    lo = jnp.moveaxis(x.lo, axis, -1)
+    n = hi.shape[-1]
+    m = 1 << max(0, (n - 1)).bit_length()  # next power of two
+    if m != n:
+        pad = [(0, 0)] * (hi.ndim - 1) + [(0, m - n)]
+        hi, lo = jnp.pad(hi, pad), jnp.pad(lo, pad)
+    cur = DF(hi, lo)
+    while cur.hi.shape[-1] > 1:
+        half = cur.hi.shape[-1] // 2
+        cur = add(DF(cur.hi[..., :half], cur.lo[..., :half]),
+                  DF(cur.hi[..., half:], cur.lo[..., half:]))
+    return DF(cur.hi[..., 0], cur.lo[..., 0])
+
+
+def dot(x: DF, y: DF, axis: int = -1) -> DF:
+    """df32 inner product along ``axis`` (pairwise-folded)."""
+    return fold_sum(mul(x, y), axis=axis)
+
+
+def matmul_small(x: DF, y: DF) -> DF:
+    """Batched matmul ``[..., m, k] @ [..., k, n]`` with the contraction
+    UNROLLED over k (static, small — pose-graph dims d, d+1, r).  Stays
+    on the VPU in df32; never touches the MXU (whose f32 is not exact)."""
+    k = x.hi.shape[-1]
+    assert y.hi.shape[-2] == k
+    acc = None
+    for t in range(k):
+        term = mul(DF(x.hi[..., :, t, None], x.lo[..., :, t, None]),
+                   DF(y.hi[..., None, t, :], y.lo[..., None, t, :]))
+        acc = term if acc is None else add(acc, term)
+    return acc
+
+
+def transpose(x: DF, axes=None) -> DF:
+    return DF(jnp.transpose(x.hi, axes), jnp.transpose(x.lo, axes))
+
+
+def index(x: DF, idx) -> DF:
+    """Exact gather (indexing applies to both components)."""
+    return DF(x.hi[idx], x.lo[idx])
+
+
+def sym(x: DF) -> DF:
+    """0.5 * (M + M^T) on the last two axes (exact halving in f32)."""
+    xt = DF(jnp.swapaxes(x.hi, -1, -2), jnp.swapaxes(x.lo, -1, -2))
+    return scale(add(x, xt), 0.5)
+
+
+def precise_jit(fn, **jit_kw):
+    """``jax.jit`` for df32-heavy functions.
+
+    On the CPU backend, LLVM's optimizer re-associates the error-free
+    transforms even through HLO optimization barriers (instruction-level
+    fast-math flags; measured: ``quick_two_sum`` loses its defining
+    property s + lo == a + b and df32 collapses to f32 accuracy).  TPU's
+    Mosaic/VPU path is unaffected (measured exact by
+    ``experiments/df32_spike.py``).  Compiling the df32 sections at
+    backend optimization level 0 on CPU restores correctness; these
+    functions run once per recenter, so the CPU-side slowdown only
+    affects tests."""
+    if jax.default_backend() == "cpu":
+        jit_kw.setdefault("compiler_options",
+                          {"xla_backend_optimization_level": 0})
+    return jax.jit(fn, **jit_kw)

@@ -244,7 +244,9 @@ def solve_staircase_sharded(meas, num_robots: int, mesh=None,
     ``models.certify.solve_staircase`` is the centralized counterpart.
 
     Returns ``(T, X_agents, rank, CertificateResult, history)`` with ``T``
-    the rounded global trajectory.
+    the rounded global trajectory and ``history`` a list of per-rank
+    4-tuples ``(rank, cost_f64, lambda_min, wall_seconds)`` — one entry
+    per staircase level, wall covering that level's solve + certificate.
     """
     import numpy as np
 

@@ -1,0 +1,102 @@
+"""Spike: do error-free f32 transforms survive XLA on this TPU?
+
+Double-f32 (two-float) arithmetic needs two primitives to be EXACT:
+  * two_sum(a, b)  -> (s, e) with a + b == s + e exactly (Knuth),
+  * two_prod(a, b) -> (p, e) with a * b == p + e exactly (Dekker split).
+Both break if the compiler reassociates, contracts a*b+c into fma with
+different rounding, or flushes subnormals in the error terms.  This spike
+measures the achieved precision of df32 add/mul/dot against numpy f64 on
+the actual backend (TPU when present) — the go/no-go for the on-device
+recenter (VERDICT r5 item 1).
+"""
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def two_sum(a, b):
+    s = a + b
+    bb = s - a
+    e = (a - (s - bb)) + (b - bb)
+    return s, e
+
+
+_SPLIT = np.float32(4097.0)  # 2^12 + 1 for f32 (24-bit mantissa)
+
+
+def split(a):
+    c = _SPLIT * a
+    hi = c - (c - a)
+    return hi, a - hi
+
+
+def two_prod(a, b):
+    p = a * b
+    ah, al = split(a)
+    bh, bl = split(b)
+    e = ((ah * bh - p) + ah * bl + al * bh) + al * bl
+    return p, e
+
+
+def df_add(xh, xl, yh, yl):
+    s, e = two_sum(xh, yh)
+    e = e + (xl + yl)
+    return two_sum(s, e)
+
+
+def df_mul(xh, xl, yh, yl):
+    p, e = two_prod(xh, yh)
+    e = e + (xh * yl + xl * yh)
+    return two_sum(p, e)
+
+
+def to_df(v64):
+    hi = np.asarray(v64, np.float32)
+    lo = np.asarray(v64 - hi.astype(np.float64), np.float32)
+    return hi, lo
+
+
+@jax.jit
+def run(ah, al, bh, bl):
+    sh, sl = df_add(ah, al, bh, bl)
+    ph, pl = df_mul(ah, al, bh, bl)
+    # dot product of 4096 terms via df accumulation (sequential fold)
+    def body(i, c):
+        ch, cl = c
+        th, tl = df_mul(ah[i], al[i], bh[i], bl[i])
+        return df_add(ch, cl, th, tl)
+    dh, dl = jax.lax.fori_loop(0, ah.shape[0], body,
+                               (jnp.float32(0), jnp.float32(0)))
+    return sh, sl, ph, pl, dh, dl
+
+
+def main():
+    print("backend:", jax.default_backend(), jax.devices())
+    rng = np.random.default_rng(0)
+    n = 4096
+    a64 = rng.standard_normal(n) * np.exp(rng.uniform(-8, 8, n))
+    b64 = rng.standard_normal(n) * np.exp(rng.uniform(-8, 8, n))
+    ah, al = to_df(a64)
+    bh, bl = to_df(b64)
+    sh, sl, ph, pl, dh, dl = [np.asarray(x, np.float64)
+                              for x in run(*map(jnp.asarray, (ah, al, bh, bl)))]
+    # reference in f64 on the df32-representable inputs
+    a_r = ah.astype(np.float64) + al.astype(np.float64)
+    b_r = bh.astype(np.float64) + bl.astype(np.float64)
+    s_ref, p_ref = a_r + b_r, a_r * b_r
+    d_ref = float(np.sum(a_r * b_r))
+    rel = lambda got, ref: np.max(np.abs(got - ref) /
+                                  np.maximum(np.abs(ref), 1e-300))
+    print(f"add  max rel err: {rel(sh + sl, s_ref):.3e}")
+    print(f"mul  max rel err: {rel(ph + pl, p_ref):.3e}")
+    print(f"dot  rel err:     {abs((dh + dl - d_ref) / d_ref):.3e}")
+    print(f"f32-only dot rel: "
+          f"{abs((float(np.float32(np.sum(ah * bh))) - d_ref) / d_ref):.3e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,11 @@ sys.path.insert(0, "/root/repo")
 DATA = "/root/reference/data"
 CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      ".fopt_cache.json")
+# Cache-key protocol version: bump whenever the f* solve recipe or the
+# corruption protocol changes, so stale cached optima cannot silently
+# skew reported gaps (ADVICE r4).  v1 = solve_local gn<=1e-7 +
+# corrupt_loop_closures as of round 4.
+FOPT_KEY_VERSION = 1
 
 # (file, agents, rank, rounds) — 3000 rounds = 100 GNC weight updates at
 # the default inner_iters=30, the reference's full annealing budget
@@ -63,7 +68,13 @@ def fopt_inliers(fname: str, rank: int, fraction: float, seed: int = 0) -> float
     if os.path.exists(CACHE):
         with open(CACHE) as f:
             cache = json.load(f)
-    key = f"{fname}_r{rank}_p{fraction}_s{seed}"
+    key = f"{fname}_r{rank}_p{fraction}_s{seed}_v{FOPT_KEY_VERSION}"
+    legacy = f"{fname}_r{rank}_p{fraction}_s{seed}"
+    v1key = f"{legacy}_v1"
+    if legacy in cache and v1key not in cache:  # pre-versioning entry = v1
+        cache[v1key] = cache.pop(legacy)
+        with open(CACHE, "w") as f:
+            json.dump(cache, f)
     if key in cache:
         return cache[key]
     code = f"""

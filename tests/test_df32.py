@@ -1,0 +1,103 @@
+"""df32 (double-float32) arithmetic: accuracy against numpy float64.
+
+These bounds pin the error-free transforms (two_sum / Dekker two_prod)
+against compiler regressions: if XLA ever starts reassociating f32 adds
+or contracting ``a*b - p`` into an fma on some backend, the measured
+~1e-13 relative accuracy collapses to f32's ~1e-7 and these tests fail
+loudly.  The on-device recenter (``models.refine_fused``) is built on
+exactly these guarantees.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dpgo_tpu.ops import df32
+
+
+def _rand(n, lo=-8, hi=8, seed=7):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(n) * np.exp(rng.uniform(lo, hi, n))
+
+
+def _relerr(got64, ref64):
+    return np.max(np.abs(got64 - ref64) / np.maximum(np.abs(ref64), 1e-300))
+
+
+def test_from_f64_roundtrip():
+    # df32 carries ~49 mantissa bits: the roundtrip is not bit-exact for
+    # full f64 inputs, but must be ~2^-49 relative (vs f32's 2^-24).
+    a = _rand(1000, seed=1)
+    assert _relerr(df32.to_f64(df32.from_f64(a)), a) < 2.0 ** -48
+    # f32-representable inputs ARE exact.
+    a32 = a.astype(np.float32).astype(np.float64)
+    assert np.array_equal(df32.to_f64(df32.from_f64(a32)), a32)
+
+
+def test_add_mul_relative_accuracy():
+    a, b = _rand(4096, seed=2), _rand(4096, seed=3)
+    da, db = df32.from_f64(a), df32.from_f64(b)
+
+    run = df32.precise_jit(
+        lambda da, db: (df32.add(da, db), df32.mul(da, db)))
+
+    s, p = run(da, db)
+    # a, b are exactly representable (from_f64), so f64 is the truth.
+    # Sums can cancel arbitrarily, so bound the ABSOLUTE error against
+    # the df32 ulp of the larger operand instead of the relative error.
+    s_ref, p_ref = a + b, a * b
+    mag = np.maximum(np.abs(a), np.abs(b))
+    assert np.max(np.abs(df32.to_f64(s) - s_ref) / mag) < 1e-13
+    assert _relerr(df32.to_f64(p), p_ref) < 1e-13
+
+
+def test_dot_and_fold_sum():
+    a, b = _rand(5000, seed=4), _rand(5000, seed=5)
+    da, db = df32.from_f64(a), df32.from_f64(b)
+    d = df32.precise_jit(lambda x, y: df32.dot(x, y))(da, db)
+    ref = float(np.sum(a * b))
+    assert abs(df32.to_f64(d) - ref) / abs(ref) < 1e-12
+    s = df32.precise_jit(lambda x: df32.fold_sum(x))(da)
+    assert abs(df32.to_f64(s) - a.sum()) / max(abs(a.sum()), 1e-300) < 1e-11
+
+
+def test_fold_sum_cancellation():
+    """Catastrophic cancellation: +x and -x pairs plus a tiny residual —
+    f32 loses it entirely, df32 keeps ~1e-9 relative."""
+    x = _rand(512, 0, 6, seed=6)
+    tiny = _rand(512, -14, -10, seed=8)
+    seq = np.concatenate([x, -x, tiny])
+    ref = seq.sum()  # == tiny.sum() up to f64 roundoff
+    s = df32.to_f64(df32.precise_jit(df32.fold_sum)(df32.from_f64(seq)))
+    f32_s = float(np.float32(seq.astype(np.float32).sum()))
+    assert abs(s - ref) / abs(ref) < 1e-6
+    assert abs(s - ref) < abs(f32_s - ref) / 100
+
+
+def test_matmul_small():
+    a = _rand(6 * 5 * 3, seed=9).reshape(6, 5, 3)
+    b = _rand(6 * 3 * 4, seed=10).reshape(6, 3, 4)
+    got = df32.to_f64(df32.precise_jit(df32.matmul_small)(
+        df32.from_f64(a), df32.from_f64(b)))
+    # Entries can cancel, so scale the error by the no-cancellation
+    # magnitude sum |a| @ |b| (the backward-error yardstick).
+    mag = np.abs(a) @ np.abs(b)
+    assert np.max(np.abs(got - a @ b) / mag) < 1e-13
+
+
+def test_div_sqrt():
+    a = np.abs(_rand(2048, seed=11)) + 1e-6
+    b = np.abs(_rand(2048, seed=12)) + 1e-6
+    q = df32.to_f64(df32.precise_jit(df32.div)(df32.from_f64(a), df32.from_f64(b)))
+    assert _relerr(q, a / b) < 1e-12
+    r = df32.to_f64(df32.precise_jit(df32.sqrt)(df32.from_f64(a)))
+    assert _relerr(r, np.sqrt(a)) < 1e-12
+
+
+def test_sym_scale_sub():
+    m = _rand(4 * 3 * 3, seed=13).reshape(4, 3, 3)
+    s = df32.to_f64(df32.precise_jit(df32.sym)(df32.from_f64(m)))
+    assert _relerr(s, 0.5 * (m + np.swapaxes(m, -1, -2))) < 1e-13
+    d = df32.to_f64(df32.precise_jit(df32.sub)(df32.from_f64(m), df32.from_f64(m)))
+    assert np.all(d == 0.0)

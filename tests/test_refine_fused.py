@@ -1,0 +1,153 @@
+"""Single-readback fused refinement (models.refine_fused): the on-device
+df32 recenter must reproduce the host f64 recenter, and the fused
+pipeline must reach a HOST-VERIFIED 1e-6 gap with no mid-pipeline sync.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpgo_tpu.config import AgentParams, SolverParams
+from dpgo_tpu.models import rbcd, refine, refine_fused
+from dpgo_tpu.ops import df32
+from dpgo_tpu.utils.partition import partition_contiguous
+from synthetic import make_measurements
+
+
+def _problem(rng, n=40, A=3, r=5, rounds=60):
+    meas, _ = make_measurements(rng, n=n, d=3, num_lc=n // 2,
+                                rot_noise=0.02, trans_noise=0.02)
+    params = AgentParams(d=3, r=r, num_robots=A, rel_change_tol=0.0,
+                         solver=SolverParams(grad_norm_tol=1e-12,
+                                             max_inner_iters=10))
+    part = partition_contiguous(meas, A)
+    graph, meta = rbcd.build_graph(part, r, jnp.float32)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float32)
+    state = rbcd.init_state(graph, meta, X0, params=params)
+    state = rbcd.rbcd_steps(state, graph, rounds, meta, params)
+    Xg32 = np.asarray(rbcd.gather_to_global(state.X, graph,
+                                            meas.num_poses), np.float32)
+    return meas, part, graph, meta, params, Xg32
+
+
+def test_recenter_device_matches_host(rng):
+    """Device df32 recenter vs host f64 recenter at the same f32 input:
+    reference point, f_ref, and the shipped f32 constants must agree."""
+    meas, part, graph, meta, params, Xg32 = _problem(rng)
+    gp = refine_fused.build_global_df(part.meas_global)
+    edges_g = refine.host_edges_f64(part.meas_global)
+
+    fns = refine_fused.make_fused_fns(meta, params, meas.num_poses)
+    target = df32.from_f64(np.float64(0.0))  # unused by recenter outputs
+    R, f_ref, consts, rho32, thr = fns.recenter(
+        jnp.asarray(Xg32), gp, graph, target)
+
+    host = refine.recenter(np.asarray(Xg32, np.float64), graph, meta,
+                           params, edges_g)
+
+    # Projected reference point: polar factors are unique -> df32 vs f64
+    # projection agree to the df32 floor.
+    R64 = df32.to_f64(R)
+    assert np.max(np.abs(R64 - host.Xg)) < 1e-9
+
+    # Reference cost to ~1e-11 relative (df32 pairwise fold vs numpy f64).
+    f_dev = float(df32.to_f64(f_ref))
+    assert abs(f_dev - host.f_ref) / host.f_ref < 1e-9
+
+    # Reference point / neighbor tables round the same f64 projection.
+    for name in ("R", "Rz"):
+        dev = np.asarray(getattr(consts, name), np.float64)
+        hst = np.asarray(getattr(host.consts, name), np.float64)
+        scale = max(np.abs(hst).max(), 1e-12)
+        assert np.max(np.abs(dev - hst)) < 3e-6 * scale, name
+
+    # Gradient-family constants: the device path computes them from the
+    # f64-GRADE measurement data (gp carries df32 of the f64 parse),
+    # while refine.recenter uses the graph's f32-rounded edges — so the
+    # truth here is a direct f64 global recompute from the f64 edges.
+    e64 = {f: np.asarray(getattr(edges_g, f), np.float64)[None]
+           for f in ("R", "t", "kappa", "tau", "weight", "mask")}
+    e64["i"] = np.asarray(edges_g.i)[None]
+    e64["j"] = np.asarray(edges_g.j)[None]
+    G_glob, rR64, rt64, _ = refine._np_egrad(host.Xg[None], e64,
+                                             host.Xg.shape[0])
+    G_glob = G_glob[0]
+    d = meta.d
+    RY = host.Xg[..., :d]
+    S0_glob = refine._np_sym(np.swapaxes(RY, -1, -2) @ G_glob[..., :d])
+    g0_glob = G_glob.copy()
+    g0_glob[..., :d] -= RY @ S0_glob
+    gi_np = np.asarray(graph.global_index)
+    pm = np.asarray(graph.pose_mask)[..., None, None]
+    for name, ref_arr in (("G_ref", G_glob[gi_np] * pm),
+                          ("g0", g0_glob[gi_np] * pm),
+                          ("S0", S0_glob[gi_np] * pm)):
+        dev = np.asarray(getattr(consts, name), np.float64)
+        scale = max(np.abs(ref_arr).max(), 1e-12)
+        assert np.max(np.abs(dev - ref_arr)) < 3e-6 * scale, name
+
+    # Global residuals (oracle inputs) against the f64 recompute.
+    rho_R, rho_t = [np.asarray(x, np.float64) for x in rho32]
+    assert np.max(np.abs(rho_R - rR64[0])) < 3e-6 * max(
+        np.abs(rR64).max(), 1e-12)
+    assert np.max(np.abs(rho_t - rt64[0])) < 3e-6 * max(
+        np.abs(rt64).max(), 1e-12)
+
+    # Preconditioner factors agree with the host build (f32 vs f64 build
+    # of the same blocks: looser tolerance).
+    dev = np.asarray(consts.chol, np.float64)
+    hst = np.asarray(host.consts.chol, np.float64)
+    assert np.max(np.abs(dev - hst)) < 1e-4 * max(np.abs(hst).max(), 1.0)
+
+
+def test_fused_pipeline_reaches_verified_gap(rng):
+    """End-to-end: descent iterate -> two fused cycles -> single readback
+    -> HOST f64 verify at 1e-6 relative suboptimality."""
+    from dpgo_tpu.models.local_pgo import solve_local
+
+    meas, part, graph, meta, params, Xg32 = _problem(rng, rounds=80)
+    res = solve_local(meas, rank=meta.rank, grad_norm_tol=1e-11,
+                      max_iters=400, dtype=jnp.float64)
+    f_opt = float(res.cost)
+
+    rel_gap = 1e-6
+    gp = refine_fused.build_global_df(part.meas_global)
+    edges_g = refine.host_edges_f64(part.meas_global)
+    target = df32.from_f64(np.float64(f_opt * (1.0 + 0.3 * rel_gap)))
+
+    fns = refine_fused.make_fused_fns(meta, params, meas.num_poses,
+                                      max_rounds=96, check_every=4)
+    out = refine_fused.run_fused_cycles(fns, jnp.asarray(Xg32), gp, graph,
+                                        target, cycles=2)
+    X64 = refine_fused.assemble_f64(out, graph)
+    X64 = refine._np_project_manifold(X64, meta.d)
+    f = refine.global_cost(X64, edges_g)
+    gap = f / f_opt - 1.0
+    assert gap <= rel_gap, f"verified gap {gap:.3e}"
+
+    # The on-device oracle's estimate must agree with the host verify at
+    # the oracle's error budget (<< the 0.7x stopping margin).
+    f_oracle = float(df32.to_f64(df32.DF(out.f_ref_hi, out.f_ref_lo))) \
+        + float(out.delta)
+    assert abs(f_oracle - f) / f_opt < 1e-8
+
+
+def test_oracle_exits_immediately_when_converged(rng):
+    """A cycle starting below target must exit its while_loop at round 0
+    (this is what makes over-provisioned cycle counts nearly free)."""
+    meas, part, graph, meta, params, Xg32 = _problem(rng, rounds=60)
+    gp = refine_fused.build_global_df(part.meas_global)
+    edges_g = refine.host_edges_f64(part.meas_global)
+    f_now = refine.global_cost(
+        refine._np_project_manifold(np.asarray(Xg32, np.float64), meta.d),
+        edges_g)
+    # Target ABOVE the current cost: already converged by construction.
+    target = df32.from_f64(np.float64(f_now * (1.0 + 1e-3)))
+    fns = refine_fused.make_fused_fns(meta, params, meas.num_poses,
+                                      max_rounds=64, check_every=4)
+    R, f_ref, consts, rho32, thr = fns.recenter(
+        jnp.asarray(Xg32), gp, graph, target)
+    D, rounds, delta = fns.refine(consts, graph, gp, rho32, thr)
+    assert int(rounds) == 0
+    assert float(delta) <= float(thr)
