@@ -177,6 +177,29 @@ def _np_project_manifold(Xg64: np.ndarray, d: int) -> np.ndarray:
     return out
 
 
+def _refine_kernel_fits(graph, meta) -> bool:
+    """VMEM gate for ``pallas_tcg.rtr_refine_full_call``: the refine
+    kernel stages the tCG working set PLUS the reference-point constants
+    (rho tiles, Rc/g0/Gref component-major, S0, Lc — ~9 extra [rows, n]
+    buffers), so it outgrows the plain-tCG budget before the rbcd gate
+    (``rbcd._pallas_vmem_ok``) trips: measured 20.1 MiB requested at
+    n=7558, r=3, d=2 (ais2klinik A=2) against the 16 MiB scoped limit.
+    Without this gate the Mosaic compile ABORTS; with it the recenter
+    simply skips the kernel-layout constants and ``refine_round`` takes
+    the XLA formulation."""
+    from .rbcd import pallas_vmem_ok
+
+    A, nt, _, T = graph.eidx_i.shape
+    d = meta.d
+    rk = meta.rank * (d + 1)
+    # Extra refine-kernel residents beyond the tCG working set.
+    extra = (nt * T * (meta.rank * d + meta.rank) * 4        # rho tiles
+             + (3 * rk + d * d + (d + 1) ** 2) * meta.n_max * 4)
+    from .rbcd import PALLAS_TCG_VMEM_BUDGET_BYTES
+    return pallas_vmem_ok(meta.n_max, meta.s_max, meta.rank, d, T, nt) \
+        and extra <= 0.35 * PALLAS_TCG_VMEM_BUDGET_BYTES
+
+
 def recenter(Xg64: np.ndarray, graph, meta, params: AgentParams,
              edges_global, chol=None, weights=None,
              pre_projected: bool = False,
@@ -253,7 +276,7 @@ def recenter(Xg64: np.ndarray, graph, meta, params: AgentParams,
     fields = dict(
         R=R_loc, Rz=Rz, G_ref=G_ref, g0=g0, S0=S0,
     )
-    if graph.eidx_i is not None:
+    if graph.eidx_i is not None and _refine_kernel_fits(graph, meta):
         # Kernel-layout constants: reference residuals at R over the edge
         # tiles, R component-major, weight tiles (weights are fixed
         # during refinement).
@@ -311,6 +334,18 @@ def _unpack_consts(packed, chol, layout, kernel) -> RefineConstants:
         A, n, k, _ = chol.shape
         out["Lc"] = jnp.transpose(chol, (0, 2, 3, 1)).reshape(A, k * k, n)
     return RefineConstants(chol=chol, **out)
+
+
+def np_edges_batched(edges) -> dict:
+    """The ``[1, ...]``-batched f64 edge dict ``_np_egrad``/
+    ``_np_edge_terms`` consume, from any EdgeSet-like (host or device
+    arrays) — one definition for the recenter, the certificate's f64
+    verification, and the experiment drivers."""
+    e = {f: np.asarray(getattr(edges, f), np.float64)[None]
+         for f in ("R", "t", "kappa", "tau", "weight", "mask")}
+    e["i"] = np.asarray(edges.i)[None]
+    e["j"] = np.asarray(edges.j)[None]
+    return e
 
 
 def host_edges_f64(meas) -> EdgeSet:
@@ -519,26 +554,57 @@ def _agent_refine(D, Dz, consts_a, edges, inc, params: AgentParams,
 
 
 def refine_round(D, consts: RefineConstants, graph, meta,
-                 params: AgentParams):
-    """One Jacobi re-centered round over all agents: exchange D, solve each
-    agent's correction with neighbors fixed.  Returns (D_new, gradnorms).
+                 params: AgentParams, active=None):
+    """One re-centered round: exchange D, solve each agent's correction
+    with neighbors fixed.  Returns (D_new, gradnorms).
 
-    Runs the VMEM kernel when the recenter built kernel-layout constants
-    (graph has edge tiles); interpreter mode off-TPU keeps tests honest.
+    ``active [A] bool`` restricts the update to a subset of agents
+    (colored Gauss-Seidel — see ``refine_rounds_colored``); default is
+    the Jacobi all-agents round.  Runs the VMEM kernel when the recenter
+    built kernel-layout constants (graph has edge tiles); interpreter
+    mode off-TPU keeps tests honest.
     """
     Dz = rbcd.neighbor_buffer(rbcd.public_table(D, graph), graph)
     if consts.Rc is not None:
         interp = jax.default_backend() != "tpu"
-        return jax.vmap(
+        D_new, gn = jax.vmap(
             lambda dd, dz, ca, e, s, m, ii, ij, rc, tc: _agent_refine(
                 dd, dz, ca, e, (s, m), params, eidx=(ii, ij, rc, tc),
                 interpret=interp))(
             D, Dz, consts, graph.edges, graph.inc_slot, graph.inc_mask,
             graph.eidx_i, graph.eidx_j, graph.rot_t, graph.trn_t)
-    return jax.vmap(
-        lambda dd, dz, ca, e, s, m: _agent_refine(dd, dz, ca, e, (s, m),
-                                                  params))(
-        D, Dz, consts, graph.edges, graph.inc_slot, graph.inc_mask)
+    else:
+        D_new, gn = jax.vmap(
+            lambda dd, dz, ca, e, s, m: _agent_refine(dd, dz, ca, e,
+                                                      (s, m), params))(
+            D, Dz, consts, graph.edges, graph.inc_slot, graph.inc_mask)
+    if active is not None:
+        D_new = jnp.where(active[:, None, None, None], D_new, D)
+    return D_new, gn
+
+
+def refine_rounds_colored(D, consts: RefineConstants, graph, meta,
+                          params: AgentParams, num_rounds):
+    """Colored Gauss-Seidel re-centered rounds: each round updates ONE
+    color class of the agent coloring (``graph.color``), so adjacent
+    blocks never move simultaneously.
+
+    Exists for the strongly-coupled graphs where simultaneous (Jacobi)
+    block updates of the correction oscillate or diverge — the same
+    failure mode Schedule.COLORED fixes for the main RBCD loop (measured
+    on ais2klinik: plain Jacobi refine rounds sent the centralized
+    gradnorm 5.8 -> 26 per cycle; colored rounds descend).  Mirrors the
+    RBCD theory's licensed parallelism: blocks sharing no edge have
+    independent subproblems (T-RO 2021).
+    """
+    nc = max(meta.num_colors, 1)
+
+    def body(i, DD):
+        active = graph.color == (i % nc)
+        return refine_round(DD, consts, graph, meta, params,
+                            active=active)[0]
+
+    return jax.lax.fori_loop(0, num_rounds, body, D)
 
 
 def refine_rounds(D, consts: RefineConstants, graph, meta,
@@ -615,8 +681,44 @@ def accel_round_carry(carry, consts: RefineConstants, graph, meta,
 
 _refine_rounds_jit = jax.jit(refine_rounds,
                              static_argnames=("meta", "params"))
+_refine_rounds_colored_jit = jax.jit(refine_rounds_colored,
+                                     static_argnames=("meta", "params"))
 _refine_rounds_accel_jit = jax.jit(refine_rounds_accel,
                                    static_argnames=("meta", "params"))
+
+
+@partial(jax.jit, static_argnames=("meta", "params"))
+def _accel_carry_chunk_jit(carry, consts, graph, meta, params, num_rounds):
+    """``num_rounds`` accelerated rounds on an explicit momentum carry
+    (traced round count — one compile serves every chunk size)."""
+    return jax.lax.fori_loop(
+        0, num_rounds,
+        lambda _, c: accel_round_carry(c, consts, graph, meta, params),
+        carry)
+
+
+def refine_rounds_accel_chunked(D, consts: RefineConstants, graph, meta,
+                                params: AgentParams, num_rounds: int,
+                                chunk: int = 100):
+    """``refine_rounds_accel`` split into <=``chunk``-round device
+    dispatches that PRESERVE the momentum carry across dispatch
+    boundaries (no readback between chunks — the chain stays async).
+
+    Exists for the tunneled-TPU execution-time ceiling: single device
+    programs running ~35 s+ kill the remote worker outright (measured on
+    ais2klinik A=2: 300 fused rounds at 28 s survive, 400 at ~38 s
+    crash), while the same rounds as a chain of shorter programs run
+    fine.  Long Nesterov horizons therefore MUST be chunked, not
+    shortened — cycle length is the momentum horizon and the contraction
+    lever on ill-conditioned graphs."""
+    carry = (D, D, jnp.zeros((), D.dtype), jnp.asarray(False))
+    done = 0
+    while done < num_rounds:
+        k = min(chunk, num_rounds - done)
+        carry = _accel_carry_chunk_jit(carry, consts, graph, meta, params,
+                                       k)
+        done += k
+    return carry[0]
 
 
 def solve_refine(Xg64: np.ndarray, graph, meta, params: AgentParams,

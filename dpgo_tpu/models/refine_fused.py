@@ -444,8 +444,11 @@ def run_fused_cycles(fns: FusedFns, Xg0, gp: GlobalProblemDF, graph,
                      target: DF, cycles: int = 2) -> FusedCycleResult:
     """Chain ``cycles`` recenter+refine cycles with NO host round-trip:
     every call is an async dispatch on device-resident values.  A cycle
-    whose predecessor already hit the oracle target exits its while_loop
-    at round 0, so over-provisioning cycles costs ~one oracle eval each.
+    whose predecessor already hit the oracle target exits its refine
+    while_loop at round 0, but still pays its RECENTER (the most
+    expensive single program here: one extra cycle measured +0.046 s on
+    the sphere bench) — provision cycles for the problem, not 'just in
+    case'.
     Returns the LAST cycle's result (read it back ONCE, then
     ``assemble_f64`` + ``refine.global_cost`` for the f64 verify)."""
     Xg = Xg0

@@ -61,11 +61,15 @@ CONFIGS = {
                  6000, 6000, True),
     "city10000_gnc": ("city10000.g2o", 32, 3, "jacobi", True, False, 300,
                       15000, 12000, True),
-    # ais2klinik: hybrid excluded by measurement — A=1 rounds run at
-    # ~2.8/s (15k poses, deep tCG) and 3000 of them moved gn only
-    # 2.016 -> 2.004 for 1084 s; the gate row stands as a bound.
+    # ais2klinik: MATCHED caps on both arms (VERDICT r4 item 5a — the
+    # round-4 60000/6000 asymmetry made the CPU "bound" an
+    # extrapolation), with the continuation enabled: the round-4
+    # exclusion note (A=1 at 2.8 rounds/s moving gn 2.016 -> 2.004 over
+    # 1084 s) described the momentum-less inner=100 continuation; the
+    # round-5 momentum + recentered-cycle continuation is the machinery
+    # that closed kitti's row on both arms.
     "ais2klinik_gnc": ("ais2klinik.g2o", 32, 3, "colored", True, False, 500,
-                       60000, 6000, False),
+                       12000, 12000, True),
 }
 
 
@@ -122,8 +126,10 @@ def run_config(name: str):
                wall=round(wall, 2), final_gradnorm=gn,
                final_cost=float(res.cost_history[-1]),
                terminated_by=res.terminated_by)
-    if not out["reached"] and not cpu and hybrid_ok \
+    if not out["reached"] and hybrid_ok \
             and os.environ.get("GATE_HYBRID", "1") == "1":
+        # Both arms run the SAME continuation protocol (VERDICT r4 item 5:
+        # every "no" row needs same-protocol evidence on both arms).
         hyb = centralized_continuation(meas, res, A, r, dtype, ev)
         if hyb is not None:
             hyb["wall"] = round(wall + hyb.pop("cont_wall"), 2)
@@ -149,6 +155,16 @@ def centralized_continuation(meas, res, A, r, dtype, ev):
     from dpgo_tpu.types import edge_set_from_measurements
     from dpgo_tpu.utils.partition import partition_contiguous
 
+    # Release the distributed phase's device buffers and compiled
+    # executables first: on the 15k-pose ais graph the 32-agent programs
+    # plus the A=1 continuation programs together exhaust the chip and
+    # crash the TPU worker outright (reproduced round 5; isolated runs of
+    # either phase are fine).  The recompile this forces is outside any
+    # reported number's clock-critical path.
+    import gc
+    jax.clear_caches()
+    gc.collect()
+
     # Freeze the distributed solve's final weights into the measurements.
     meas_w = meas
     if res.weights is not None:
@@ -159,11 +175,44 @@ def centralized_continuation(meas, res, A, r, dtype, ev):
     Xg = jnp.asarray(gather_poses_to_global(res.X,
                                             partition_contiguous(meas, A)))
 
-    part1 = partition_contiguous(meas_w, 1)
-    graph1, meta1 = rbcd.build_graph(part1, r, dtype)
+    # Near-centralized block count: A=1 is the true centralized engine;
+    # when it does not fit (the single-block 15k-pose ais program also
+    # reproducibly crashes the tunneled TPU worker), take the SMALLEST
+    # block count whose per-block problem fits the refine VMEM kernel —
+    # on TPU the kernel is ~15x faster per refine round than the XLA
+    # fallback at these sizes (kitti A=1: 90 rounds/s kernel vs ais A=2:
+    # 0.7 s/round XLA, measured round 5), and few big Gauss-Seidel
+    # blocks keep near-centralized conditioning.
+    from dpgo_tpu.config import Schedule
+    from dpgo_tpu.models import refine as rmod
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        A_cont = 1
+        part1 = partition_contiguous(meas_w, A_cont)
+        graph1, meta1 = rbcd.build_graph(part1, r, dtype)
+    else:
+        for A_cont in (1, 2, 3, 4, 6, 8, 12, 16):
+            if A_cont == 1 and meas.num_poses > 8000:
+                continue  # worker-crash regime, see above
+            part1 = partition_contiguous(meas_w, A_cont)
+            graph1, meta1 = rbcd.build_graph(part1, r, dtype)
+            if graph1.eidx_i is not None \
+                    and rbcd._pallas_vmem_ok(meta1, graph1) \
+                    and rmod._refine_kernel_fits(graph1, meta1):
+                break
+        log(f"    [hybrid] continuation block count A={A_cont}")
     params1 = AgentParams(
-        d=meas.d, r=r, num_robots=1, rel_change_tol=0.0,
-        solver=SolverParams(grad_norm_tol=1e-9, max_inner_iters=100,
+        d=meas.d, r=r, num_robots=A_cont, rel_change_tol=0.0,
+        schedule=Schedule("colored") if A_cont > 1 else Schedule("jacobi"),
+        # (kept below: momentum + moderate tCG — see docstring)
+        # Nesterov + moderate tCG, not plain deep-tCG rounds: the round-4
+        # continuation (inner=100, no momentum) crawled — kitti floored
+        # at gn 2.2 after 3000 rounds and ais moved 2.016 -> 2.004 in
+        # 1084 s.  The refine-phase lesson (bench_convergence fallback,
+        # BASELINE.md parking-garage) is that the momentum horizon, not
+        # tCG depth, is the lever on condition-limited graphs.
+        acceleration=True, restart_interval=100,
+        solver=SolverParams(grad_norm_tol=1e-9, max_inner_iters=20,
                             pallas_sel_mode="bf16x3"))
     edges_g = edge_set_from_measurements(meas_w, dtype=dtype)
 
@@ -180,23 +229,120 @@ def centralized_continuation(meas, res, A, r, dtype, ev):
     # gate by tens of seconds here — check at most every 100 rounds,
     # where <= 10 readbacks total are negligible.
     ev1 = min(ev, 100)
-    # Warm-up compile outside the clock (steady-state convention).
-    _ = float(central_gn(rbcd.rbcd_steps(state, graph1, 1, meta1,
-                                         params1).X))
+    # Warm-up compile outside the clock (steady-state convention); both
+    # segment flavors (plain + restart-first) compile separately.
+    _ = float(central_gn(rbcd.rbcd_segment(state, graph1, 1, meta1,
+                                           params1,
+                                           first_restart=False).X))
+    _ = rbcd.rbcd_segment(state, graph1, 1, meta1, params1,
+                          first_restart=True)
     t0 = time.perf_counter()
     rounds = 0
     gn = float("inf")
+    gn_prev = float("inf")
     while rounds < 3000:
-        state = rbcd.rbcd_steps(state, graph1, ev1, meta1, params1)
+        # Momentum restart at each block boundary (ev1 == the restart
+        # cadence): mirrors bench_convergence.advance()'s segmentation.
+        state = rbcd.rbcd_segment(state, graph1, ev1, meta1, params1,
+                                  first_restart=rounds > 0)
         rounds += ev1
         gn = float(central_gn(state.X))
         if gn < GATE:
             break
+        if dtype == jnp.float32 and gn > 0.9 * gn_prev \
+                and rounds >= 3 * ev1:
+            # Contraction stalled (< 10% per block): on the f32 arm this
+            # is the gradient-noise floor (kitti: plateaus at gn ~2.2
+            # where the SAME continuation in f64 passes through to the
+            # gate — measured round 5), so fall through to the
+            # re-centered cycles below rather than burn the cap.
+            break
+        gn_prev = gn
+    out = dict(reached=bool(gn < GATE), cont_rounds=rounds,
+               final_gradnorm=gn)
+
+    if gn >= GATE and dtype == jnp.float32:
+        # Re-centered continuation: the f32 floor is eps*|G| gradient
+        # noise; the recentered refine rounds (models.refine) hold the
+        # large terms as f64-computed constants so the effective floor
+        # drops by orders of magnitude — the gate analog of the
+        # certified-gap pipeline's refine phase.  Gate checks run on the
+        # HOST in f64 from the assembled iterate (one readback per
+        # cycle, negligible at gate time scales).
+        from dpgo_tpu.models import refine as rmod
+        edges_np = rmod.host_edges_f64(meas_w)
+        Xg64 = np.asarray(rbcd.gather_to_global(state.X, graph1,
+                                                meas.num_poses),
+                          np.float64)
+        e64 = rmod.np_edges_batched(edges_np)
+        d = meas.d
+
+        def central_gn64(Xg64p):
+            G = rmod._np_egrad(Xg64p[None], e64, meas.num_poses)[0][0]
+            Y = Xg64p[..., :d]
+            S1 = rmod._np_sym(np.swapaxes(Y, -1, -2) @ G[..., :d])
+            rg = G.copy()
+            rg[..., :d] -= Y @ S1
+            return float(np.sqrt((rg * rg).sum()))
+
+        chol = None
+        cycles = 0
+        # Long cycles: Nesterov's effective horizon is the cycle length
+        # (momentum restarts at D=0 each recenter), and kitti's
+        # near-chain conditioning needs hundreds of rounds of horizon —
+        # 150-round cycles stalled at gn 0.44 where 400-round cycles
+        # pass the gate (measured round 5).  Cycle-boundary safeguard
+        # (solve_refine's): momentum over simultaneous block updates can
+        # diverge on strongly-coupled graphs (ais went gn -> nan without
+        # it) — revert to the best verified iterate and continue with
+        # plain (un-accelerated) refine rounds.
+        import jax.numpy as jnp2
+        best = None
+        accel_on = True
+        for cycles in range(1, 13):
+            if np.isfinite(Xg64).all():
+                Xg64 = rmod._np_project_manifold(Xg64, d)
+                gn = central_gn64(Xg64)
+            else:
+                gn = float("nan")
+            log(f"      [recentered] cycle {cycles}: gn "
+                f"{gn:.4f} (accel={accel_on})")
+            if best is not None and not (gn < best[0] * 1.02):
+                accel_on = False
+                Xg64, gn = best[1], best[0]
+                continue
+            if best is None or gn < best[0]:
+                best = (gn, Xg64)
+            if gn < GATE:
+                break
+            ref = rmod.recenter(Xg64, graph1, meta1, params1, edges_np,
+                                chol=chol, pre_projected=True)
+            chol = ref.consts.chol
+            D0 = jnp2.zeros(ref.consts.R.shape, jnp2.float32)
+            if accel_on:
+                D = rmod.refine_rounds_accel_chunked(
+                    D0, ref.consts, graph1, meta1, params1, 400,
+                    chunk=100)
+            else:
+                # Un-accelerated fallback uses COLORED sweeps: plain
+                # Jacobi refine rounds also oscillate on ais (gn 5.8 ->
+                # 26 per cycle, measured round 5).
+                D = D0
+                for _ in range(4):
+                    D = rmod._refine_rounds_colored_jit(
+                        D, ref.consts, graph1, meta1, params1, 100)
+            Xg64 = rmod.global_x(ref, np.asarray(D), graph1)
+        out.update(recentered_cycles=cycles, final_gradnorm=gn,
+                   reached=bool(gn < GATE))
+
     cont_wall = time.perf_counter() - t0
     log(f"    [hybrid] centralized continuation: gn {gn:.3f} after "
-        f"{rounds} A=1 rounds / {cont_wall:.1f}s")
-    return dict(reached=bool(gn < GATE), cont_rounds=rounds,
-                final_gradnorm=gn, cont_wall=cont_wall)
+        f"{out['cont_rounds']} A=1 rounds"
+        + (f" + {out.get('recentered_cycles', 0)} recentered cycles"
+           if out.get("recentered_cycles") else "")
+        + f" / {cont_wall:.1f}s")
+    out["cont_wall"] = cont_wall
+    return out
 
 
 def main():

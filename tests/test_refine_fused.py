@@ -66,10 +66,7 @@ def test_recenter_device_matches_host(rng):
     # f64-GRADE measurement data (gp carries df32 of the f64 parse),
     # while refine.recenter uses the graph's f32-rounded edges — so the
     # truth here is a direct f64 global recompute from the f64 edges.
-    e64 = {f: np.asarray(getattr(edges_g, f), np.float64)[None]
-           for f in ("R", "t", "kappa", "tau", "weight", "mask")}
-    e64["i"] = np.asarray(edges_g.i)[None]
-    e64["j"] = np.asarray(edges_g.j)[None]
+    e64 = refine.np_edges_batched(edges_g)
     G_glob, rR64, rt64, _ = refine._np_egrad(host.Xg[None], e64,
                                              host.Xg.shape[0])
     G_glob = G_glob[0]
