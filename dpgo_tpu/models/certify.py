@@ -197,33 +197,56 @@ def certify_solution(
     tol = eta * wscale
 
     import numpy as np
-    eps = float(jnp.finfo(X.dtype).eps)
-    # ~10 ulps of the shifted operator: the LOBPCG works on sigma I - S.
-    err_est = 10.0 * eps * sigma_f
-    decidable = err_est <= 0.5 * tol
-    lam_f64 = None
-    if not decidable and f64_verify == "auto":
-        lam_f64, vec64, resid = lambda_min_f64(
-            np.asarray(X, np.float64), edges,
-            warm=np.asarray(vec, np.float64), tol=0.25 * tol)
-        lam_used = lam_f64
+
+    def f64_solve(t):
+        return lambda_min_f64(np.asarray(X, np.float64), edges,
+                              warm=np.asarray(vec, np.float64), tol=t)
+
+    certified, decidable, lam_used, lam_f64, vec64 = decide_certificate(
+        lam_min_f, sigma_f, tol, float(jnp.finfo(X.dtype).eps),
+        f64_solve if f64_verify == "auto" else None)
+    if vec64 is not None:
         vec = jnp.asarray(vec64, X.dtype)
-        # An unconverged f64 eigensolve must not decide either: its Ritz
-        # value sits ABOVE lambda_min, which only ever over-certifies.
-        decidable = resid <= 0.5 * tol
-    else:
-        lam_used = lam_min_f
     return CertificateResult(
-        certified=bool(decidable and lam_used >= -tol),
+        certified=certified,
         lambda_min=lam_min_f,
         direction=vec,
         stationarity_gap=float(stat),
         sigma=sigma_f,
         tol=tol,
         weight_scale=wscale,
-        decidable=bool(decidable),
+        decidable=decidable,
         lambda_min_f64=lam_f64,
     )
+
+
+def decide_certificate(lam_eig: float, sigma: float, tol: float,
+                       dtype_eps: float, f64_solve=None):
+    """The post-eigensolve certificate decision, shared by
+    ``certify_solution`` and ``parallel.certify.certify_sharded`` so the
+    two paths cannot desynchronize (round-5 review).
+
+    Semantics (VERDICT r4 item 3): the eigensolve's error is ~10 ulps of
+    the shifted operator (the LOBPCG works on ``sigma I - S``); when that
+    cannot resolve ``tol`` the dtype verdict is NOT trusted — the caller's
+    ``f64_solve(tol_f64) -> (lam_f64, vec64_or_None, resid)`` host
+    verification decides instead, and an UNCONVERGED f64 eigensolve
+    (``resid > tol/2``) refuses: Ritz values approach lambda_min from
+    above, so accepting one could only ever over-certify.
+
+    Returns ``(certified, decidable, lam_used, lam_f64, vec64)``.
+    """
+    err_est = 10.0 * dtype_eps * sigma
+    decidable = err_est <= 0.5 * tol
+    lam_f64 = vec64 = None
+    if not decidable and f64_solve is not None:
+        lam_f64, vec64, resid = f64_solve(0.25 * tol)
+        lam_used = lam_f64
+        decidable = resid <= 0.5 * tol
+    else:
+        lam_used = lam_eig
+    return (bool(decidable and lam_used >= -tol), bool(decidable),
+            lam_used, lam_f64, vec64)
 
 
 def lambda_min_f64(X64, edges: EdgeSet, warm=None, num_probe: int = 4,
@@ -235,7 +258,12 @@ def lambda_min_f64(X64, edges: EdgeSet, warm=None, num_probe: int = 4,
     ~1.6e7 makes f32 blind below ~16); this scipy LOBPCG runs the same
     operator in f64 via the numpy edge-gradient (``refine._np_egrad``),
     warm-started from the f32 eigenvector so it polishes rather than
-    searches.  Returns ``(lambda_min, eigenvector [n, d+1])``.
+    searches.  Returns ``(lambda_min, eigenvector [n, d+1], resid)`` —
+    ``resid`` is the eigenpair residual ``||S v - lambda v||``, and it is
+    load-bearing: an unconverged Ritz value approaches lambda_min from
+    ABOVE, so callers MUST refuse certification unless ``resid`` resolves
+    their tolerance (see the refusal gates in ``certify_solution`` /
+    ``parallel.certify.certify_sharded``).
     """
     import numpy as np
     from scipy.sparse.linalg import LinearOperator, lobpcg

@@ -471,6 +471,8 @@ def _edge_tile_shape(n_max: int, s_max: int, e_max: int) -> tuple[int, int]:
     from ..ops.pallas_tcg import TILE
 
     T = TILE if (n_max + s_max) <= 1024 else TILE // 2
+    import os
+    T = int(os.environ.get("PALLAS_TILE", T))  # A/B override (round 5)
     return T, max(1, -(-e_max // T))
 
 

@@ -57,6 +57,7 @@ the kernel indexes tiles on the leading axis —
 from __future__ import annotations
 
 import functools
+import os
 from types import SimpleNamespace
 
 import jax
@@ -68,6 +69,12 @@ HI = jax.lax.Precision.HIGHEST
 
 #: Edge-tile lane width: tiles are [n, T] one-hots and [*, T] payload rows.
 TILE = 256
+
+#: Experiment gates (read once at import; experiments/kernel_breakdown.py
+#: A/Bs these at the 100k shape — see BASELINE.md round-5 VPU entry).
+_UNROLL_TILES = os.environ.get("PALLAS_UNROLL_TILES", "0") == "1"
+_NS_SWEEPS = int(os.environ.get("PALLAS_NS_SWEEPS", "24"))
+_SEL_PACKED = os.environ.get("PALLAS_SEL_PACKED", "0") == "1"
 
 
 def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
@@ -140,8 +147,23 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         # accuracy.  precision must be DEFAULT explicitly: with bf16
         # operands and no precision, Mosaic resolves contract precision to
         # fp32 and rejects the matmul ("Bad lhs type").
+        parts = _split(V, sel_passes)
+        if _SEL_PACKED:
+            # PACKED: one dot on the row-stacked splits instead of
+            # ``sel_passes`` separate dots.  At the 100k shape the kernel
+            # is dot-ISSUE-bound, not MAC-bound (round-5 breakdown) —
+            # identical MXU work, 1/passes the issues.  The contraction
+            # axis is the same for every split (dims contracts V's axis
+            # ``cdim`` with Sel), so stacking rides the output row axis.
+            stacked = jnp.concatenate(parts, axis=0)
+            t = jax.lax.dot_general(stacked, Sel, dims,
+                                    precision=jax.lax.Precision.DEFAULT,
+                                    preferred_element_type=f32)
+            rows_out = t.shape[0] // sel_passes
+            return sum(t[p * rows_out:(p + 1) * rows_out]
+                       for p in range(sel_passes))
         acc = None
-        for part in _split(V, sel_passes):
+        for part in parts:
             t = jax.lax.dot_general(part, Sel, dims,
                                     precision=jax.lax.Precision.DEFAULT,
                                     preferred_element_type=f32)
@@ -186,6 +208,15 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         local_sel2 = lambda ti: onehot2(idx_i_ref[ti], idx_j_ref[ti], n, 0)
 
     def tile_loop(tile_fn, init):
+        if _UNROLL_TILES:
+            # Static unroll: nt is compile-time, so the Python loop frees
+            # Mosaic to software-pipeline each tile's MXU dots against the
+            # previous tile's VPU edge math (the fori_loop body is a
+            # scheduling barrier per tile).
+            acc = init
+            for ti in range(nt):
+                acc = tile_fn(ti, acc)
+            return acc
         return jax.lax.fori_loop(0, nt, tile_fn, init)
 
     Xr = rows(X)
@@ -534,7 +565,7 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
             T_ = 0.5 * (3.0 * eye - matmul3(Z, Y))
             return matmul3(Y, T_), matmul3(T_, Z)
 
-        _, Zc = jax.lax.fori_loop(0, 24, sweep, (An, eye))
+        _, Zc = jax.lax.fori_loop(0, _NS_SWEEPS, sweep, (An, eye))
         inv_sqrt_s = jax.lax.rsqrt(s)
         out = [None] * rk
         for a in range(r):

@@ -233,6 +233,8 @@ def solve_staircase_sharded(meas, num_robots: int, mesh=None,
                             rounds_per_rank: int = 300,
                             grad_norm_tol: float = 1e-8,
                             eta: float = 1e-5, dtype=None, X0=None,
+                            accel: bool = False,
+                            restart_interval: int = 100,
                             verbose: bool = False):
     """Distributed certifiably correct PGO, end to end on the mesh.
 
@@ -274,6 +276,12 @@ def solve_staircase_sharded(meas, num_robots: int, mesh=None,
         t_rank = _time.perf_counter()
         params = AgentParams(
             d=d, r=r, num_robots=num_robots, rel_change_tol=0.0,
+            # Post-escape descent is a long-wavelength coherent mode
+            # (e.g. cycle unwinding); Nesterov momentum traverses it in
+            # O(sqrt) of the plain-BCD round count (``accel=True`` is the
+            # at-scale escape configuration, experiments/
+            # staircase_escape_100k.py).
+            acceleration=accel, restart_interval=restart_interval,
             solver=SolverParams(grad_norm_tol=grad_norm_tol,
                                 max_inner_iters=10))
         graph, meta = rbcd.build_graph(part, r, dtype)
@@ -283,7 +291,15 @@ def solve_staircase_sharded(meas, num_robots: int, mesh=None,
                                 params=params)
         state, graph_s = shard_problem(mesh, state, graph)
         steps = make_sharded_multi_step(mesh, meta, params)
-        state = steps(state, graph_s, rounds_per_rank)
+        # Chunked dispatch: a single >~35 s device program kills the
+        # tunneled TPU worker (measured round 5 — 400 rounds at the 100k
+        # SE(2) shape crashed it); sync between ~100-round programs.
+        left = rounds_per_rank
+        while left > 0:
+            k = min(100, left)
+            state = steps(state, graph_s, k)
+            jax.block_until_ready(state.X)
+            left -= k
         Xa = state.X
 
         Xg = np.asarray(rbcd.gather_to_global(Xa, graph, n_total),
@@ -306,8 +322,17 @@ def solve_staircase_sharded(meas, num_robots: int, mesh=None,
             return T, Xa, r, cert, history
 
         # Saddle escape per agent: append the negative-curvature row, pick
-        # alpha by backtracking on the global cost (scalar consensus).
-        v = np.asarray(cert.direction)                    # [A, n, dh]
+        # alpha by a geometric sweep on the global cost (scalar consensus).
+        # The eigendirection is GLOBALLY unit-norm, so at N poses its
+        # per-pose rows are O(1/sqrt(N)) — the round-4 backtracking from
+        # alpha=1e-2 produced O(1e-5) per-pose nudges at 100k, which
+        # descent could not carry out of the saddle basin (measured round
+        # 5: cost moved 2.8e-4 of 3946 in 400 rounds).  Normalize to unit
+        # MAX per-pose row norm and take the best alpha of a sweep, so the
+        # escape amplitude is scale-free.
+        v = np.asarray(cert.direction, np.float64)        # [A, n, dh]
+        vmax = np.sqrt((v * v).sum(-1).max())
+        v = v / max(vmax, 1e-30)
         Xa_np = np.asarray(Xa, np.float64)
         f0 = f
 
@@ -317,16 +342,15 @@ def solve_staircase_sharded(meas, num_robots: int, mesh=None,
             return np.asarray(jax.vmap(manifold.project)(
                 jnp.asarray(Xp)), np.float64)
 
-        alpha, ok = 1e-2, False
-        for _ in range(20):
-            Xp = lifted(alpha)  # on-manifold: lifted() projects per pose
+        best_alpha, best_f = 0.0, f0
+        for p in range(22):
+            alpha = 2.0 ** (-p)                           # 1.0 ... ~2.4e-7
             Xg_p = np.asarray(rbcd.gather_to_global(
-                jnp.asarray(Xp), graph, n_total), np.float64)
-            if refine.global_cost(Xg_p, edges_g) < f0:
-                ok = True
-                break
-            alpha *= 0.5
-        Xa = Xp if ok else lifted(0.0)
+                jnp.asarray(lifted(alpha)), graph, n_total), np.float64)
+            f_p = refine.global_cost(Xg_p, edges_g)
+            if f_p < best_f:
+                best_alpha, best_f = alpha, f_p
+        Xa = lifted(best_alpha)
     raise AssertionError("unreachable")
 
 
@@ -390,15 +414,13 @@ def certify_sharded(X, graph: MultiAgentGraph, mesh=None,
     # shared semantics with models.certify.certify_solution.  The
     # per-agent edge table holds each cross edge in both endpoint agents,
     # which leaves the MEDIAN weighted concentration unchanged.
-    from ..models.certify import lambda_min_f64, weight_scale
+    from ..models.certify import (decide_certificate, lambda_min_f64,
+                                  weight_scale)
     wscale = weight_scale(graph.edges)
     tol = eta * wscale
     import numpy as np
-    eps = float(jnp.finfo(jnp.asarray(X).dtype).eps)
-    err_est = 10.0 * eps * sigma_f
-    decidable = err_est <= 0.5 * tol
-    lam_f64 = None
-    if not decidable and global_ctx is not None:
+
+    def f64_solve(t):
         # Host-f64 verification: polish the distributed eigenvector on
         # the GLOBAL operator (Xg64, global EdgeSet supplied by the
         # caller, e.g. solve_staircase_sharded).
@@ -414,29 +436,29 @@ def certify_sharded(X, graph: MultiAgentGraph, mesh=None,
             mid = np.asarray(graph.meas_id).ravel()
             msk = np.asarray(graph.edges.mask).ravel() > 0
             w_glob[mid[msk]] = np.asarray(weights).ravel()[msk]
-            edges_global = edges_global._replace(
+            edges_g = edges_global._replace(
                 weight=np.asarray(edges_global.weight) * w_glob)
+        else:
+            edges_g = edges_global
         gi = np.asarray(graph.global_index)
         pmask = np.asarray(graph.pose_mask) > 0
         warm = np.zeros((Xg64.shape[0], Xg64.shape[2]))
         warm[gi[pmask]] = np.asarray(direction, np.float64)[pmask]
-        lam_f64, _, resid = lambda_min_f64(np.asarray(Xg64, np.float64),
-                                           edges_global, warm=warm,
-                                           tol=0.25 * tol)
-        lam_used = lam_f64
-        # An unconverged f64 eigensolve only ever over-certifies
-        # (Ritz values approach lambda_min from above) — refuse then.
-        decidable = resid <= 0.5 * tol
-    else:
-        lam_used = lam_min_f
+        lam64, _, resid = lambda_min_f64(np.asarray(Xg64, np.float64),
+                                         edges_g, warm=warm, tol=t)
+        return lam64, None, resid
+
+    certified, decidable, _, lam_f64, _ = decide_certificate(
+        lam_min_f, sigma_f, tol, float(jnp.finfo(jnp.asarray(X).dtype).eps),
+        f64_solve if global_ctx is not None else None)
     return CertificateResult(
-        certified=bool(decidable and lam_used >= -tol),
+        certified=certified,
         lambda_min=lam_min_f,
         direction=direction,
         stationarity_gap=float(stat),
         sigma=sigma_f,
         tol=tol,
         weight_scale=wscale,
-        decidable=bool(decidable),
+        decidable=decidable,
         lambda_min_f64=lam_f64,
     )

@@ -229,6 +229,15 @@ def corrupt_loop_closures_correlated(
             b = int(rng.integers(0, n - m))
             if abs(a - b) >= max(min_sep, m):
                 break
+        else:
+            # Unsatisfiable geometry (cluster size ~ graph size): falling
+            # through would silently create overlapping or self-loop
+            # segments, breaking the two-distinct-places invariant the
+            # aliasing protocol models.
+            raise ValueError(
+                f"cannot place two disjoint segments of {m} poses "
+                f">= {max(min_sep, m)} apart in a {n}-pose graph; "
+                "reduce fraction or increase clusters")
         R_T = random_rotation(rng, d)
         t_T = rng.standard_normal(d)
         t_T *= rng.uniform(0.3, 1.0) * extent / max(np.linalg.norm(t_T),

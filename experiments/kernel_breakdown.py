@@ -1,0 +1,152 @@
+"""Per-edge/per-pose VPU breakdown at the 100k/64 shape (VERDICT r4
+item 6: "restructure the per-edge VPU math").
+
+Round 4 established the kernel is VPU/loop-bound after the selection
+split + paired tiles.  This driver locates the time and A/Bs the round-5
+structural levers, each env-gated in ``ops.pallas_tcg`` so every variant
+runs in a fresh subprocess against the SAME problem:
+
+* ``unroll``  — PALLAS_UNROLL_TILES=1: static-unroll the edge-tile loop
+  (nt is compile-time) so Mosaic can software-pipeline MXU dots against
+  VPU edge math across tiles.
+* ``ns8``     — PALLAS_NS_SWEEPS=8: the retraction's Newton-Schulz polar
+  runs 24 fixed sweeps (~1.9k [n]-wide FMAs, sized for near-singular
+  M = X + eta); a trust-region step is never near-singular, so 8 sweeps
+  reach f32-grade orthonormality (drift checked below).
+* ``t256``    — PALLAS_TILE=256: the adaptive tile halves to T=128 when
+  the pose buffer exceeds 1024; at 100k/64 VMEM still fits T=256, which
+  halves the per-tile loop/dispatch overhead and doubles dot width.
+* ``inner2``  — max_inner_iters=2 (vs the production 10): NOT a
+  candidate (changes semantics) — isolates per-tCG-iteration cost.
+
+Parity: every variant reports the f64 global cost after 60 rounds; a
+variant is acceptable only within 1e-5 relative of the baseline arm.
+
+Usage: python experiments/kernel_breakdown.py [rounds]
+       (worker: KB_MODE=worker KB_VARIANT=... internal)
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+VARIANTS = {
+    "base": {},
+    "unroll": {"PALLAS_UNROLL_TILES": "1"},
+    "ns8": {"PALLAS_NS_SWEEPS": "8"},
+    "t256": {"PALLAS_TILE": "256"},
+    "t512": {"PALLAS_TILE": "512"},
+    "packed": {"PALLAS_SEL_PACKED": "1"},
+    "packed+unroll+t256": {"PALLAS_SEL_PACKED": "1",
+                           "PALLAS_UNROLL_TILES": "1", "PALLAS_TILE": "256"},
+    "all": {"PALLAS_SEL_PACKED": "1", "PALLAS_UNROLL_TILES": "1",
+            "PALLAS_TILE": "256", "PALLAS_NS_SWEEPS": "8"},
+}
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def worker():
+    import jax
+    import jax.numpy as jnp
+    from dpgo_tpu.config import AgentParams, SolverParams
+    from dpgo_tpu.models import rbcd, refine
+    from dpgo_tpu.utils.partition import partition_contiguous
+    from dpgo_tpu.utils.synthetic import make_measurements
+
+    rounds = int(os.environ.get("KB_ROUNDS", "60"))
+    inner = int(os.environ.get("KB_INNER", "10"))
+    sel = os.environ.get("KB_SEL", "f32")
+    rng = np.random.default_rng(0)
+    meas, _ = make_measurements(rng, n=100000, d=3, num_lc=20000,
+                                rot_noise=0.01, trans_noise=0.01)
+    A, r = 64, 3
+    params = AgentParams(d=3, r=r, num_robots=A,
+                         solver=SolverParams(pallas_sel_mode=sel,
+                                             max_inner_iters=inner))
+    part = partition_contiguous(meas, A)
+    graph, meta = rbcd.build_graph(part, r, jnp.float32)
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float32)
+    state = rbcd.init_state(graph, meta, X0, params=params)
+    form = rbcd._formulation(meta, params, graph)
+    assert form == "pallas", form
+    steps = lambda s, k: rbcd.rbcd_steps(s, graph, k, meta, params)
+    t0 = time.perf_counter()
+    st = steps(state, 1)
+    jax.block_until_ready(st.X)
+    compile_s = time.perf_counter() - t0
+    jax.block_until_ready(steps(st, min(20, rounds)).X)
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = steps(state, rounds)
+        jax.block_until_ready(out.X)
+        rates.append(rounds / (time.perf_counter() - t0))
+    # Parity: f64 cost of the 60-round iterate on the global edge set.
+    st60 = steps(state, 60)
+    Xg = np.asarray(rbcd.gather_to_global(st60.X, graph,
+                                          part.meas_global.num_poses),
+                    np.float64)
+    from dpgo_tpu.types import edge_set_from_measurements
+    edges = edge_set_from_measurements(part.meas_global, dtype=jnp.float64)
+    f60 = float(refine.global_cost(Xg, edges))
+    print(json.dumps(dict(rounds_per_s=round(float(np.median(rates)), 2),
+                          rates=[round(x, 2) for x in rates],
+                          compile_s=round(compile_s, 1), f60=f60)))
+
+
+def main():
+    if os.environ.get("KB_MODE") == "worker":
+        worker()
+        return
+    rounds = sys.argv[1] if len(sys.argv) > 1 else "60"
+    results = {}
+    for sel in ("f32", "bf16x3"):
+        for name, env in VARIANTS.items():
+            e = dict(os.environ, KB_MODE="worker", KB_ROUNDS=rounds,
+                     KB_SEL=sel, PYTHONPATH="/root/repo", **env)
+            t0 = time.perf_counter()
+            out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                 env=e, capture_output=True, text=True,
+                                 timeout=1800)
+            if out.returncode != 0:
+                log(f"[{sel}/{name}] FAILED:\n{out.stderr[-800:]}")
+                results[f"{sel}/{name}"] = dict(error=out.stderr[-200:])
+                continue
+            row = json.loads(out.stdout.strip().splitlines()[-1])
+            base = results.get(f"{sel}/base")
+            if base and "f60" in base:
+                row["f60_rel_drift"] = abs(row["f60"] - base["f60"]) / base["f60"]
+            results[f"{sel}/{name}"] = row
+            log(f"[{sel}/{name}] {row['rounds_per_s']} rounds/s "
+                f"(wall {time.perf_counter()-t0:.0f}s, "
+                f"drift {row.get('f60_rel_drift', 0):.2e})")
+    # Per-iteration isolation on the winning f32 variant.
+    for inner in ("10", "2"):
+        e = dict(os.environ, KB_MODE="worker", KB_ROUNDS=rounds, KB_SEL="f32",
+                 KB_INNER=inner, PYTHONPATH="/root/repo")
+        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             env=e, capture_output=True, text=True,
+                             timeout=1800)
+        if out.returncode == 0:
+            row = json.loads(out.stdout.strip().splitlines()[-1])
+            results[f"f32/inner{inner}"] = row
+            log(f"[f32/inner{inner}] {row['rounds_per_s']} rounds/s")
+    print(json.dumps(results, indent=1))
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "kernel_breakdown_results.json")
+    with open(path, "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -40,7 +40,7 @@ def main():
     from dpgo_tpu.utils.partition import partition_contiguous
     from dpgo_tpu.utils.synthetic import make_stitched_winding
 
-    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 400
+    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
     n_cycles, cycle_len = 1000, 100
     log(f"generating stitched-winding dataset: {n_cycles} x {cycle_len} "
         f"= {n_cycles * cycle_len} poses ...")
@@ -57,7 +57,7 @@ def main():
     t0 = time.perf_counter()
     T, Xa, rank, cert, hist = dcert.solve_staircase_sharded(
         meas, 64, r_min=2, r_max=5, rounds_per_rank=rounds,
-        X0=Xa0, verbose=True)
+        X0=Xa0, accel=True, verbose=True)
     total = time.perf_counter() - t0
 
     rows = [dict(rank=r, cost=f, lambda_min=lam, wall_s=w)
