@@ -679,6 +679,74 @@ def accel_round_carry(carry, consts: RefineConstants, graph, meta,
     return D_new, V, gamma, restart
 
 
+def accel_sweep_carry(carry, consts: RefineConstants, graph, meta,
+                      params: AgentParams):
+    """One Nesterov-accelerated FULL COLORED SWEEP on the momentum carry
+    ``(D, V, gamma, restart)``.
+
+    The base operator is ``num_colors`` sequential color sub-rounds
+    (Gauss-Seidel) instead of one simultaneous Jacobi round — for the
+    strongly-coupled graphs where momentum over simultaneous updates
+    diverges (ais2klinik: Jacobi+momentum oscillates, plain colored
+    descends but crawls at ~0.3 gradnorm/cycle — measured round 5; this
+    operator keeps sequential stability AND the momentum horizon).  One
+    sweep updates every block exactly once, so the momentum algebra is
+    the single-block recursion (A_eff = 1), not the 1/A-scaled one of
+    ``accel_round_carry``.
+    """
+    D, V, gamma, restart = carry
+    V = jnp.where(restart, D, V)
+    gamma = jnp.where(restart, jnp.zeros_like(gamma), gamma)
+    gamma = (1.0 + jnp.sqrt(1.0 + 4.0 * gamma ** 2)) / 2.0
+    alpha = 1.0 / gamma
+    Ynes = jax.vmap(_retract_d0)((1.0 - alpha) * D + alpha * V, consts.R)
+    nc = max(meta.num_colors, 1)
+
+    def body(i, DD):
+        active = graph.color == (i % nc)
+        return refine_round(DD, consts, graph, meta, params,
+                            active=active)[0]
+
+    D_new = jax.lax.fori_loop(0, nc, body, Ynes)
+    V = jax.vmap(_retract_d0)(V + gamma * (D_new - Ynes), consts.R)
+    # Same adaptive-restart test as accel_round_carry (>= 0: a zero
+    # step must restart, see the note there).
+    restart = jnp.sum((Ynes - D_new) * (D_new - D)) >= 0.0
+    return D_new, V, gamma, restart
+
+
+@partial(jax.jit, static_argnames=("meta", "params"))
+def _accel_sweep_chunk_jit(carry, consts, graph, meta, params, num_sweeps):
+    """``num_sweeps`` accelerated colored sweeps on an explicit momentum
+    carry (traced count — one compile serves every chunk size)."""
+    return jax.lax.fori_loop(
+        0, num_sweeps,
+        lambda _, c: accel_sweep_carry(c, consts, graph, meta, params),
+        carry)
+
+
+def refine_rounds_accel_colored_chunked(D, consts: RefineConstants, graph,
+                                        meta, params: AgentParams,
+                                        num_rounds: int, chunk: int = 100):
+    """Accelerated colored sweeps in <=``chunk``-ROUND device dispatches
+    with the momentum carry preserved across boundaries (the colored
+    analog of ``refine_rounds_accel_chunked``; same tunneled-TPU ~35 s
+    program ceiling).  ``num_rounds`` counts color sub-rounds, so the
+    device time budget matches the other drivers; the sweep count is
+    ``num_rounds // num_colors``."""
+    nc = max(meta.num_colors, 1)
+    sweeps = max(1, num_rounds // nc)
+    per_chunk = max(1, chunk // nc)
+    carry = (D, D, jnp.zeros((), D.dtype), jnp.asarray(False))
+    done = 0
+    while done < sweeps:
+        k = min(per_chunk, sweeps - done)
+        carry = _accel_sweep_chunk_jit(carry, consts, graph, meta, params,
+                                       k)
+        done += k
+    return carry[0]
+
+
 _refine_rounds_jit = jax.jit(refine_rounds,
                              static_argnames=("meta", "params"))
 _refine_rounds_colored_jit = jax.jit(refine_rounds_colored,
@@ -719,6 +787,86 @@ def refine_rounds_accel_chunked(D, consts: RefineConstants, graph, meta,
                                        k)
         done += k
     return carry[0]
+
+
+def polish(Xg64: np.ndarray, graph, meta, params: AgentParams, meas,
+           cycles: int = 3, rounds_per_cycle: int = 200, chunk: int = 100,
+           gn_tol: float = 0.0, colored: bool = True):
+    """Drive the centralized f64 GRADNORM down with re-centered refine
+    cycles — the stationarity polish.
+
+    Exists for certification (round 5): lambda_min of the dual operator
+    S = Q - Lambda(X) at a non-stationary X carries an -O(||rgrad||)
+    error term, so an iterate at the f32 descent floor (gn ~1e-3 at 100k
+    scale) reads as "not certified" even AT the global optimum — the
+    certificate is answering stationarity, not optimality.  Polishing to
+    the re-centered floor (f64-grade gn) makes lambda_min reflect the
+    actual curvature; ``solve_staircase_sharded`` calls this before every
+    certificate.
+
+    Returns ``(Xg64_polished, gn_history)`` with one gn entry per cycle
+    boundary (f64, centralized).  ``colored`` selects momentum over full
+    colored sweeps (``accel_sweep_carry`` — the stable operator on
+    strongly-coupled graphs) when the graph carries a coloring; plain
+    Jacobi momentum otherwise.  The best-gn iterate is returned (an
+    accelerated tail can overshoot).
+    """
+    edges_np = host_edges_f64(meas)
+    e64 = np_edges_batched(edges_np)
+    n_out = Xg64.shape[0]
+    d = meta.d
+
+    def gn64(Xp):
+        G = _np_egrad(Xp[None], e64, n_out)[0][0]
+        Y = Xp[..., :d]
+        S1 = _np_sym(np.swapaxes(Y, -1, -2) @ G[..., :d])
+        rg = G.copy()
+        rg[..., :d] -= Y @ S1
+        return float(np.sqrt((rg * rg).sum()))
+
+    use_colored = colored and graph.color is not None \
+        and meta.num_colors > 1
+    chol = None
+    best = None
+    hist = []
+    Xg64 = _np_project_manifold(np.asarray(Xg64, np.float64), d)
+    for _ in range(cycles):
+        if not np.isfinite(Xg64).all():
+            # Divergence safeguard (momentum over strongly-coupled
+            # blocks can blow up — the solve_refine lesson): revert to
+            # the best verified iterate (or the entry iterate when the
+            # very first cycle diverged) and stop.
+            if best is not None:
+                Xg64 = best[1]
+            break
+        gn = gn64(Xg64)
+        hist.append(gn)
+        if best is None or gn < best[0]:
+            best = (gn, Xg64)
+        if gn_tol and gn < gn_tol:
+            break
+        ref = recenter(Xg64, graph, meta, params, edges_np, chol=chol,
+                       pre_projected=True)
+        chol = ref.consts.chol
+        D0 = jnp.zeros(ref.consts.R.shape, jnp.float32)
+        if use_colored:
+            D = refine_rounds_accel_colored_chunked(
+                D0, ref.consts, graph, meta, params, rounds_per_cycle,
+                chunk=chunk)
+        else:
+            D = refine_rounds_accel_chunked(
+                D0, ref.consts, graph, meta, params, rounds_per_cycle,
+                chunk=chunk)
+        Xg64 = _np_project_manifold(
+            np.asarray(global_x(ref, np.asarray(D), graph), np.float64), d)
+    if np.isfinite(Xg64).all():
+        gn = gn64(Xg64)
+        hist.append(gn)
+        if best is None or gn < best[0]:
+            best = (gn, Xg64)
+    if best is None:   # non-finite entry iterate (or cycles = 0 on one)
+        raise ValueError("polish: entry iterate is non-finite")
+    return best[1], hist
 
 
 def solve_refine(Xg64: np.ndarray, graph, meta, params: AgentParams,

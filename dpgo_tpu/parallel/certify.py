@@ -304,6 +304,23 @@ def solve_staircase_sharded(meas, num_robots: int, mesh=None,
 
         Xg = np.asarray(rbcd.gather_to_global(Xa, graph, n_total),
                         np.float64)
+        # Stationarity polish before certifying: lambda_min(S) at a
+        # non-stationary X carries a -O(||rgrad||) term, so the f32
+        # descent floor (gn ~1e-3 at 100k) reads "not certified" even at
+        # the optimum (measured round 5).  Re-centered refine cycles
+        # drive gn to f64 grade; the certificate then answers curvature,
+        # not leftover gradient.  (f32 solves only: an f64 solve reaches
+        # tight gn by plain descent, and the tests' virtual-mesh runs
+        # would pay interpreter-mode kernels for nothing.)
+        if dtype == jnp.float32:
+            Xg, gn_hist = refine.polish(Xg, graph, meta, params,
+                                        part.meas_global, cycles=3,
+                                        rounds_per_cycle=200)
+            Xa = jnp.asarray(rbcd.scatter_to_agents(
+                jnp.asarray(Xg, dtype), graph))
+            if verbose:
+                print(f"[staircase-sharded] rank {r}: polish gn "
+                      f"{gn_hist[0]:.2e} -> {gn_hist[-1]:.2e}")
         f = refine.global_cost(Xg, edges_g)
         cert = certify_sharded(Xa, graph_s, mesh=mesh, eta=eta, seed=r,
                                global_ctx=(Xg, edges_g))
@@ -314,7 +331,10 @@ def solve_staircase_sharded(meas, num_robots: int, mesh=None,
         if verbose:
             print(f"[staircase-sharded] rank {r}: cost {f:.6f}, "
                   f"lambda_min {cert.lambda_min:.3e}, "
-                  f"certified={cert.certified}")
+                  f"certified={cert.certified} "
+                  f"(tol {cert.tol:.1e}, sigma {cert.sigma:.1e}, "
+                  f"decidable={cert.decidable}, "
+                  f"lam_f64={cert.lambda_min_f64})")
         if cert.certified or r == r_max:
             X64 = jnp.asarray(Xg)
             ylift = _recover_rounding_basis(X64, d)
@@ -445,7 +465,8 @@ def certify_sharded(X, graph: MultiAgentGraph, mesh=None,
         warm = np.zeros((Xg64.shape[0], Xg64.shape[2]))
         warm[gi[pmask]] = np.asarray(direction, np.float64)[pmask]
         lam64, _, resid = lambda_min_f64(np.asarray(Xg64, np.float64),
-                                         edges_g, warm=warm, tol=t)
+                                         edges_g, warm=warm, tol=t,
+                                         tol_cert=tol)
         return lam64, None, resid
 
     certified, decidable, _, lam_f64, _ = decide_certificate(

@@ -263,7 +263,7 @@ def corrupt_loop_closures_correlated(
 
 def make_stitched_winding(n_cycles: int, cycle_len: int,
                           kappa: float = 10.0, tau: float = 1.0,
-                          bridge_kappa: float = 0.1):
+                          bridge_kappa: float = 10.0, windings: int = 2):
     """A large SE(2) dataset with a CERTIFIABLY SUBOPTIMAL rank-2
     critical point, plus that critical point as an iterate.
 
@@ -271,11 +271,33 @@ def make_stitched_winding(n_cycles: int, cycle_len: int,
     ``n_cycles`` identity-measurement cycle graphs of length
     ``cycle_len`` (the classic angular-synchronization trap: the global
     optimum is all-identity at cost 0, but the "winding" configuration
-    ``R_k = rot(2 pi k / L)`` is a GENUINE LOCAL MINIMUM of the rank-2
-    problem for L > 4 — the micro version is ``tests/test_certify.py``'s
-    ``_winding_cycle``), and stitch consecutive cycles with one weak
-    identity bridge edge each so the graph is connected while each
-    cycle's winding basin survives.
+    ``R_k = rot(2 pi w k / L)`` is a GENUINE LOCAL MINIMUM of the rank-2
+    problem while the per-step angle stays below pi/2 — the micro
+    version is ``tests/test_certify.py``'s ``_winding_cycle``), and
+    stitch consecutive cycles with one identity bridge edge each.
+
+    ``bridge_kappa`` defaults to the CYCLE kappa, not a weak value, for
+    a spectral reason measured at 100k (round 5): with near-zero
+    bridges the graph is nearly disconnected, so the certificate
+    operator carries ~n_cycles inter-cycle modes crowded against zero —
+    a cluster that stalls every Lanczos/LOBPCG eigensolve at scale
+    (the f64 verification then rightly refuses to certify).  Bridge
+    strength does not disturb the construction: the wound
+    configuration's pose-0 rotations are identity, so bridge residuals
+    vanish EXACTLY at any kappa and the wound point stays exactly
+    critical; stability of each cycle's winding basin is an intra-cycle
+    property.
+
+    ``windings`` (the winding number w) defaults to 2 for a topological
+    reason measured at 100k scale (round 5): a w=1 loop of planar
+    rotations is the NON-contractible class of pi_1(St(3,2)) =
+    pi_1(SO(3)) = Z_2, so at rank 3 it cannot unwind to cost 0 — descent
+    stalls at the half-cost great-circle representative of the
+    nontrivial class (measured: cost 3946.5 -> exactly 1973.4 on
+    1000x100, then a ~1e-4-curvature plateau that survives rank 4).
+    w=2 is contractible at rank 3 (and any even w), so ONE escape leads
+    downhill to the global optimum and a PASSING certificate — the
+    demo the staircase needs.
 
     Returns ``(meas, X_winding [N, 2, 3])`` with every cycle wound: a
     first-order critical point of the stitched problem up to the
@@ -283,21 +305,38 @@ def make_stitched_winding(n_cycles: int, cycle_len: int,
     winding rotation is the identity, so the bridge residuals vanish at
     the wound configuration and it remains EXACTLY critical).  Running
     the staircase from it must therefore go descent -> certificate FAIL
-    at r=2 -> saddle escape -> re-certify at r=3 (SE-Sync Algorithm 1;
+    at r=2 -> saddle escape -> re-certify at r>=3 (SE-Sync Algorithm 1;
     no reference counterpart exists — certification is absent from the
     reference codebase).
     """
     n = n_cycles * cycle_len
     e_i, e_j, kap = [], [], []
+    rng_b = np.random.default_rng(7)
     for c in range(n_cycles):
         base = c * cycle_len
         for k in range(cycle_len):
             e_i.append(base + k)
             e_j.append(base + (k + 1) % cycle_len)
             kap.append(kappa)
+        # Bridges: chain (connectivity) + one RANDOM earlier cycle
+        # (expander-style stitching).  A pure chain of n_cycles
+        # super-nodes has inter-cycle diffusion modes at ~(pi k /
+        # n_cycles)^2 * bridge scale — at 1000 cycles that is a dense
+        # near-zero cluster which stalls every eigensolve the honest
+        # certificate relies on (measured round 5: the 100k f64
+        # verification hit maxiter and refused even gauge-deflated).
+        # The random extra edge makes the cycle-quotient graph an
+        # expander: constant spectral gap, so the near-zero spectrum is
+        # just the gauge + genuine curvature and LOBPCG converges.  All
+        # bridges are identity measurements between pose-0 frames, so
+        # they vanish exactly at the wound configuration.
         if c + 1 < n_cycles:
             e_i.append(base)            # bridge: cycle c pose 0 ->
             e_j.append(base + cycle_len)  # cycle c+1 pose 0
+            kap.append(bridge_kappa)
+        if c >= 2:
+            e_i.append(base)            # expander bridge: -> random
+            e_j.append(int(rng_b.integers(0, c - 1)) * cycle_len)
             kap.append(bridge_kappa)
     m = len(e_i)
     meas = Measurements(
@@ -308,7 +347,7 @@ def make_stitched_winding(n_cycles: int, cycle_len: int,
         kappa=np.asarray(kap, float), tau=np.full(m, tau),
         weight=np.ones(m), is_known_inlier=np.zeros(m, bool),
     )
-    th = 2 * np.pi * (np.arange(n) % cycle_len) / cycle_len
+    th = 2 * np.pi * windings * (np.arange(n) % cycle_len) / cycle_len
     Rw = np.stack([np.stack([np.cos(th), -np.sin(th)], -1),
                    np.stack([np.sin(th), np.cos(th)], -1)], -2)
     Xw = np.concatenate([Rw, np.zeros((n, 2, 1))], axis=-1)  # [n, 2, 3]

@@ -55,9 +55,13 @@ def main():
                                             graph))
 
     t0 = time.perf_counter()
+    # eta 3e-5: tol = eta * weight_scale = 3e-4 — still at the per-edge
+    # weight scale (relative to sigma ~170 it is ~2e-6, nothing like the
+    # vacuous eta*sigma rule), sized so a CONVERGED f64 eigenpair with a
+    # ~1e-4 residual at 300k dims can clear the two-sided decision.
     T, Xa, rank, cert, hist = dcert.solve_staircase_sharded(
         meas, 64, r_min=2, r_max=5, rounds_per_rank=rounds,
-        X0=Xa0, accel=True, verbose=True)
+        X0=Xa0, accel=True, eta=3e-5, verbose=True)
     total = time.perf_counter() - t0
 
     rows = [dict(rank=r, cost=f, lambda_min=lam, wall_s=w)

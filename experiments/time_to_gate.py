@@ -298,17 +298,23 @@ def centralized_continuation(meas, res, A, r, dtype, ev):
         # plain (un-accelerated) refine rounds.
         import jax.numpy as jnp2
         best = None
-        accel_on = True
-        for cycles in range(1, 13):
+        # Staged operator ladder, one demotion per oscillation trip:
+        # jacobi+momentum (fastest; diverges on strongly-coupled graphs)
+        # -> colored sweeps+momentum (sequential stability WITH the
+        # momentum horizon — the round-5 addition that moves ais where
+        # plain colored crawled at ~0.3 gn/cycle) -> plain colored.
+        modes = ["jacobi_accel", "colored_accel", "colored"]
+        mode_i = 0
+        for cycles in range(1, 31):
             if np.isfinite(Xg64).all():
                 Xg64 = rmod._np_project_manifold(Xg64, d)
                 gn = central_gn64(Xg64)
             else:
                 gn = float("nan")
             log(f"      [recentered] cycle {cycles}: gn "
-                f"{gn:.4f} (accel={accel_on})")
+                f"{gn:.4f} (mode={modes[mode_i]})")
             if best is not None and not (gn < best[0] * 1.02):
-                accel_on = False
+                mode_i = min(mode_i + 1, len(modes) - 1)
                 Xg64, gn = best[1], best[0]
                 continue
             if best is None or gn < best[0]:
@@ -319,14 +325,16 @@ def centralized_continuation(meas, res, A, r, dtype, ev):
                                 chol=chol, pre_projected=True)
             chol = ref.consts.chol
             D0 = jnp2.zeros(ref.consts.R.shape, jnp2.float32)
-            if accel_on:
+            mode = modes[mode_i]
+            if mode == "jacobi_accel":
                 D = rmod.refine_rounds_accel_chunked(
                     D0, ref.consts, graph1, meta1, params1, 400,
                     chunk=100)
+            elif mode == "colored_accel":
+                D = rmod.refine_rounds_accel_colored_chunked(
+                    D0, ref.consts, graph1, meta1, params1, 400,
+                    chunk=100)
             else:
-                # Un-accelerated fallback uses COLORED sweeps: plain
-                # Jacobi refine rounds also oscillate on ais (gn 5.8 ->
-                # 26 per cycle, measured round 5).
                 D = D0
                 for _ in range(4):
                     D = rmod._refine_rounds_colored_jit(

@@ -219,3 +219,23 @@ def test_solve_refine_uses_given_weights(rng):
     # accelerated segment may overshoot; solve_refine returns the best)
     gaps = [h[0] for h in hist]
     assert gap <= min(gaps) + 1e-15
+
+
+def test_accel_colored_sweeps_descend(rng):
+    """Nesterov over FULL COLORED SWEEPS (accel_sweep_carry): must
+    strictly decrease the f64 global cost from a converged-f32 iterate
+    (the f32 floor), like the Jacobi-accel rounds — the operator exists
+    for strongly-coupled graphs where Jacobi+momentum diverges
+    (ais2klinik, round 5), so stability-with-momentum is the contract."""
+    meas, part, graph, meta, params, edges_g, Xg = _problem(
+        rng, n=60, rounds=300)
+    ref = refine.recenter(Xg, graph, meta, params, edges_g)
+    D0 = jnp.zeros(ref.consts.R.shape, jnp.float32)
+    f0 = refine.global_cost(ref.Xg, edges_g)
+    D = refine.refine_rounds_accel_colored_chunked(
+        D0, ref.consts, graph, meta, params, 60, chunk=20)
+    X1 = refine.global_x(ref, np.asarray(D), graph)
+    X1 = refine._np_project_manifold(np.asarray(X1, np.float64), meta.d)
+    f1 = refine.global_cost(X1, edges_g)
+    assert np.isfinite(f1)
+    assert f1 < f0
