@@ -362,7 +362,8 @@ def sparse_certificate(X64, edges: EdgeSet):
 
 
 def lambda_min_f64_shift_invert(X64, edges: EdgeSet, tol_cert: float,
-                                k: int = 12, maxiter: int = 2000):
+                                k: int = 12, maxiter: int = 2000,
+                                warm=None):
     """Minimum eigenvalue of S near the certification threshold via
     shift-invert Lanczos on the explicit sparse operator.
 
@@ -375,9 +376,22 @@ def lambda_min_f64_shift_invert(X64, edges: EdgeSet, tol_cert: float,
     transformed spectrum, so ``k`` directions cover it; ``k`` should
     comfortably exceed the gauge dimension (r gauge rows + slack).
 
-    Returns ``(lam_min, eigenvector [n, d+1], resid)`` with ``resid``
-    the explicit eigenpair residual of the reported pair on S —
-    ``decide_certificate``'s two-sided interval rule consumes it.
+    Returns ``(lam_min, eigenvector [n, d+1] or None, resid)`` with
+    ``resid`` the explicit eigenpair residual of the reported pair on S —
+    ``decide_certificate``'s two-sided interval rule consumes it.  The
+    vector is ``None`` when no eigenpair could be computed (the caller
+    must then keep its own direction estimate, e.g. the f32 one).
+
+    Soundness guard against the shift-invert window: ``eigsh(sigma)``
+    returns the eigenvalues NEAREST the shift, so a near-zero cluster
+    larger than ``k`` could crowd a genuinely negative lambda_min out of
+    the window and the window pair alone would falsely PASS.  Every
+    available direction is therefore screened by its RAYLEIGH QUOTIENT
+    on S — RQ(v) >= lambda_min for ANY v, so RQ(v) < -tol_cert is an
+    unconditional proof of failure (no residual required).  Screened
+    directions: the SA pass's Ritz vectors (converged or not) and the
+    caller's ``warm`` vector (the f32 eigensolve's direction — exactly
+    the direction a crowded window would miss).
     """
     import numpy as np
     from scipy.sparse.linalg import ArpackNoConvergence, eigsh
@@ -392,13 +406,33 @@ def lambda_min_f64_shift_invert(X64, edges: EdgeSet, tol_cert: float,
         resid = float(np.linalg.norm(S @ v - lam * v))
         return lam, v, resid
 
+    def rq_veto(v):
+        """(rayleigh_quotient, normalized v) — RQ < -tol_cert is a sound
+        FAIL certificate for any v."""
+        v = np.asarray(v, np.float64).reshape(-1)
+        nv = np.linalg.norm(v)
+        if not np.isfinite(nv) or nv < 1e-300:
+            return None
+        v = v / nv
+        return float(v @ (S @ v)), v
+
+    # A veto returns resid 0.0: the RQ bound is ONE-SIDED for free
+    # (lambda_min <= RQ < -tol needs no eigenpair residual), and
+    # decide_certificate's FAIL branch (lam + resid < -tol) then draws
+    # exactly the sound conclusion.  The reported value is an upper
+    # bound on lambda_min, which only ever understates the deficit.
+    if warm is not None:
+        r_w = rq_veto(warm)
+        if r_w is not None and r_w[0] < -tol_cert:
+            return r_w[0], r_w[1].reshape(n, dh), 0.0
+
     # Pass 1 — plain smallest-algebraic Lanczos: converges fast exactly
     # when lambda_min is a SEPARATED negative outlier (the case the
     # shift-invert pass below can rank beneath the gauge cluster in its
-    # transformed spectrum).  Its verdict is consumed through the
-    # two-sided interval rule, so an unconverged pair (large resid, the
-    # clustered-bottom case) simply fails to decide here and falls
-    # through to shift-invert.
+    # transformed spectrum).  Its Ritz values are Rayleigh quotients of
+    # the Ritz vectors, so ANY Ritz value < -tol is a sound FAIL even
+    # unconverged; an inconclusive pass (all Ritz >= -tol) falls through
+    # to shift-invert.
     try:
         vals, vecs = eigsh(S, k=4, which="SA", maxiter=60, tol=1e-7)
         lam_sa, v_sa, r_sa = pair(vals, vecs)
@@ -407,8 +441,8 @@ def lambda_min_f64_shift_invert(X64, edges: EdgeSet, tol_cert: float,
         if getattr(e, "eigenvalues", None) is not None \
                 and len(e.eigenvalues):
             lam_sa, v_sa, r_sa = pair(e.eigenvalues, e.eigenvectors)
-    if lam_sa is not None and lam_sa + r_sa < -tol_cert:
-        return lam_sa, v_sa.reshape(n, dh), r_sa
+    if lam_sa is not None and lam_sa < -tol_cert:
+        return lam_sa, v_sa.reshape(n, dh), 0.0
 
     # Pass 2 — shift-invert at the threshold: the sparse LU of
     # S + tol I separates the near-zero clusters (gauge + graph bands)
@@ -430,14 +464,19 @@ def lambda_min_f64_shift_invert(X64, edges: EdgeSet, tol_cert: float,
     if vals is None:
         if lam_sa is not None:
             return lam_sa, v_sa.reshape(n, dh), r_sa
+        # Total failure: refuse (a huge residual can never pass the
+        # interval rule).  Vector is None so the caller KEEPS its own
+        # (f32) direction — a zero direction would silently no-op the
+        # staircase's saddle escape.
         big = float(np.abs(S).sum(axis=1).max())  # >= spectral radius
-        return 0.0, np.zeros((n, dh)), big
+        return 0.0, None, big
     lam, v, resid = pair(vals, vecs)
-    if lam_sa is not None and lam_sa + r_sa < lam - resid:
-        # The SA pair's interval sits strictly below anything the
-        # shift-invert window saw — report the more pessimistic pair
-        # (refusal rather than a possibly-false PASS).
-        return lam_sa, v_sa.reshape(n, dh), r_sa
+    # The window's Ritz values are RQs too: pair() took the argmin, so a
+    # window member below -tol decides FAIL through the interval rule
+    # with its (tiny) residual.  At this point every screened direction
+    # (warm, SA Ritz, window) has RQ >= -tol; the PASS still rests on
+    # the documented trust assumption that SOME screened direction
+    # tracks the minimal subspace.
     return lam, v.reshape(n, dh), resid
 
 
@@ -473,7 +512,8 @@ def lambda_min_f64(X64, edges: EdgeSet, warm=None, num_probe: int = 4,
         # ``tol_cert`` is the CERTIFICATION threshold (the certify
         # callers pass their -tol decision point explicitly); ``tol``
         # remains the LOBPCG convergence tolerance of the small path.
-        return lambda_min_f64_shift_invert(X64, edges, tol_cert)
+        return lambda_min_f64_shift_invert(X64, edges, tol_cert,
+                                           warm=warm)
     e64 = np_edges_batched(edges)
 
     G, _, _, _ = _np_egrad(X64[None], e64, n)

@@ -789,6 +789,20 @@ def refine_rounds_accel_chunked(D, consts: RefineConstants, graph, meta,
     return carry[0]
 
 
+def central_gradnorm64(Xg64p: np.ndarray, e64, n_out: int,
+                       d: int) -> float:
+    """f64 centralized Riemannian gradient norm of a global iterate —
+    THE stationarity yardstick shared by ``polish`` and the gate
+    experiments (one implementation so the polish stopping rule and the
+    gate measurement cannot desynchronize)."""
+    G = _np_egrad(Xg64p[None], e64, n_out)[0][0]
+    Y = Xg64p[..., :d]
+    S1 = _np_sym(np.swapaxes(Y, -1, -2) @ G[..., :d])
+    rg = G.copy()
+    rg[..., :d] -= Y @ S1
+    return float(np.sqrt((rg * rg).sum()))
+
+
 def polish(Xg64: np.ndarray, graph, meta, params: AgentParams, meas,
            cycles: int = 3, rounds_per_cycle: int = 200, chunk: int = 100,
            gn_tol: float = 0.0, colored: bool = True):
@@ -817,12 +831,7 @@ def polish(Xg64: np.ndarray, graph, meta, params: AgentParams, meas,
     d = meta.d
 
     def gn64(Xp):
-        G = _np_egrad(Xp[None], e64, n_out)[0][0]
-        Y = Xp[..., :d]
-        S1 = _np_sym(np.swapaxes(Y, -1, -2) @ G[..., :d])
-        rg = G.copy()
-        rg[..., :d] -= Y @ S1
-        return float(np.sqrt((rg * rg).sum()))
+        return central_gradnorm64(Xp, e64, n_out, d)
 
     use_colored = colored and graph.color is not None \
         and meta.num_colors > 1

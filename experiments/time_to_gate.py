@@ -278,12 +278,7 @@ def centralized_continuation(meas, res, A, r, dtype, ev):
         d = meas.d
 
         def central_gn64(Xg64p):
-            G = rmod._np_egrad(Xg64p[None], e64, meas.num_poses)[0][0]
-            Y = Xg64p[..., :d]
-            S1 = rmod._np_sym(np.swapaxes(Y, -1, -2) @ G[..., :d])
-            rg = G.copy()
-            rg[..., :d] -= Y @ S1
-            return float(np.sqrt((rg * rg).sum()))
+            return rmod.central_gradnorm64(Xg64p, e64, meas.num_poses, d)
 
         chol = None
         cycles = 0
