@@ -64,7 +64,7 @@ def build(dtype):
     params = AgentParams(d=3, r=RANK, num_robots=NUM_ROBOTS,
                          solver=SolverParams(pallas_sel_mode=SEL_MODE))
     part = partition_contiguous(meas, NUM_ROBOTS)
-    graph, meta = rbcd.build_graph(part, RANK, dtype)
+    graph, meta = rbcd.build_graph(part, RANK, dtype, sel_mode=SEL_MODE)
     X0 = rbcd.centralized_chordal_init(part, meta, graph, dtype)
     state = rbcd.init_state(graph, meta, X0, params=params)
     return state, graph, meta, params

@@ -201,7 +201,7 @@ def _build_problem(dtype, init: str = "chordal") -> BenchProblem:
         solver=SolverParams(grad_norm_tol=1e-9, max_inner_iters=INNER_ITERS,
                             pallas_sel_mode=SEL_MODE))
     part = partition_contiguous(meas, NUM_ROBOTS)
-    graph, meta = rbcd.build_graph(part, RANK, dtype)
+    graph, meta = rbcd.build_graph(part, RANK, dtype, sel_mode=SEL_MODE)
     state0 = None
     if init == "chordal":
         X0 = rbcd.centralized_chordal_init(part, meta, graph, dtype)

@@ -442,9 +442,61 @@ def lambda_min_f64_shift_invert(X64, edges: EdgeSet, tol_cert: float,
                 and len(e.eigenvalues):
             lam_sa, v_sa, r_sa = pair(e.eigenvalues, e.eigenvectors)
     if lam_sa is not None and lam_sa < -tol_cert:
-        return lam_sa, v_sa.reshape(n, dh), 0.0
+        # Recompute the RQ explicitly: a salvaged unconverged ARPACK
+        # Ritz value can deviate from the true RQ of its vector (lost
+        # orthogonality ~ eps * sigma); the SOUND bound is the explicit
+        # v @ S v of the actual unit vector, not the reported value.
+        r_sa_rq = rq_veto(v_sa)
+        if r_sa_rq is not None and r_sa_rq[0] < -tol_cert:
+            return r_sa_rq[0], r_sa_rq[1].reshape(n, dh), 0.0
 
-    # Pass 2 — shift-invert at the threshold: the sparse LU of
+    # Pass 2 — gauge-deflated LOBPCG on the SPARSE operator.  Complement
+    # of the shift-invert pass below: on well-connected graphs (random
+    # long-range loop closures — e.g. the 100k synthetic) the spectrum
+    # has a healthy gap above the gauge kernel, so deflated LOBPCG with
+    # ~10 ms sparse matvecs converges in seconds, while the sparse LU of
+    # the SAME graph explodes (expander fill-in: measured round 5, >25
+    # min and ~7 GB at 400k dims before being killed).  On chain/planar
+    # graphs the roles flip (tiny fill, clustered bottom) — which is
+    # exactly the case pass 3 handles.
+    from scipy.sparse.linalg import lobpcg as _lobpcg
+
+    Yc = np.stack([np.asarray(X64[:, a, :], np.float64).reshape(n * dh)
+                   for a in range(r)], axis=1)
+    Yc, _ = np.linalg.qr(Yc)
+    rng = np.random.default_rng(0)
+    V0 = rng.standard_normal((n * dh, 4))
+    if warm is not None:
+        w = np.asarray(warm, np.float64).reshape(n * dh)
+        if np.isfinite(w).all() and np.linalg.norm(w) > 1e-300:
+            V0[:, 0] = w
+    # Deflation-validity bound for the PASS direction: the constrained
+    # search cannot see eigenvalue content INSIDE span(Yc), so a PASS is
+    # only sound if Yc really is near-kernel.  With ||S yc|| <= delta, a
+    # missing direction u (lambda_u < -tol) satisfies
+    # |<u, yc>| <= delta / |lambda_u| <= delta / tol, so delta <=
+    # 0.1 * tol leaves >= 99% of u's mass in the complement where the
+    # LOBPCG sees it.  An iterate stopped far from stationarity (gauge
+    # columns not near-kernel) therefore falls through instead of
+    # certifying blind.  The sound-FAIL RQ veto needs no such guard.
+    SYc = S @ Yc
+    defl_ok = float(np.linalg.norm(SYc, axis=0).max()) <= 0.1 * tol_cert
+    try:
+        vals_l, vecs_l = _lobpcg(S, V0, Y=Yc, largest=False,
+                                 maxiter=300, tol=min(1e-8, 0.1 * tol_cert),
+                                 verbosityLevel=0)
+        lam_l, v_l, r_l = pair(vals_l, vecs_l)
+        rq_l = float(v_l @ (S @ v_l))  # explicit RQ of the unit vector
+        lam_l_full = min(lam_l, 0.0)  # gauge zeros complete the spectrum
+        if rq_l < -tol_cert:
+            # Rayleigh quotient of a genuine unit vector: sound FAIL.
+            return rq_l, v_l.reshape(n, dh), 0.0
+        if defl_ok and lam_l_full - r_l >= -tol_cert:
+            return lam_l_full, v_l.reshape(n, dh), r_l
+    except Exception:
+        pass  # fall through to shift-invert
+
+    # Pass 3 — shift-invert at the threshold: the sparse LU of
     # S + tol I separates the near-zero clusters (gauge + graph bands)
     # where plain Krylov eigenvector residuals never resolve; the
     # eigenvalues NEAREST the threshold are exactly the ones that
@@ -452,6 +504,26 @@ def lambda_min_f64_shift_invert(X64, edges: EdgeSet, tol_cert: float,
     # shift lands on an eigenvalue) must REFUSE, not crash a multi-hour
     # staircase: salvage partial eigenpairs when present, else return a
     # pair whose residual can never pass the interval rule.
+    # FILL GUARD: sparse LU is only viable on chain/planar-ish graphs.
+    # A high fraction of long-range edges (random loop closures) makes
+    # the graph an expander whose LU fill is near-dense — measured
+    # round 5: >25 min and ~7 GB at 400k dims, twice, on the noisy 100k
+    # synthetic (17% random LCs), vs seconds on the stitched-winding
+    # chain (1% long-range bridges).  When the guard trips and the
+    # Krylov tiers above were inconclusive, the honest outcome is
+    # REFUSAL, not an unbounded factorization.
+    i_np = np.asarray(edges.i)
+    j_np = np.asarray(edges.j)
+    msk = (np.asarray(edges.mask) > 0) if hasattr(edges, "mask") \
+        else np.ones_like(i_np, bool)
+    span = np.abs(i_np[msk] - j_np[msk])
+    long_frac = float(np.mean(span > max(64, n // 100))) if span.size \
+        else 0.0
+    if n * dh > 100_000 and long_frac > 0.05:
+        if lam_sa is not None:
+            return lam_sa, v_sa.reshape(n, dh), r_sa
+        big = float(np.abs(S).sum(axis=1).max())
+        return 0.0, None, big
     try:
         vals, vecs = eigsh(S, k=k, sigma=-tol_cert, which="LM",
                            maxiter=maxiter, tol=1e-10)
@@ -471,6 +543,12 @@ def lambda_min_f64_shift_invert(X64, edges: EdgeSet, tol_cert: float,
         big = float(np.abs(S).sum(axis=1).max())  # >= spectral radius
         return 0.0, None, big
     lam, v, resid = pair(vals, vecs)
+    if lam_sa is not None and lam_sa + r_sa < lam - resid:
+        # The SA interval proves a true eigenvalue strictly below
+        # everything the shift-invert window saw — the window missed
+        # the bottom, so its PASS would be unsound; report the more
+        # pessimistic SA pair (refusal) instead.
+        return lam_sa, v_sa.reshape(n, dh), r_sa
     # The window's Ritz values are RQs too: pair() took the argmin, so a
     # window member below -tol decides FAIL through the interval rule
     # with its (tiny) residual.  At this point every screened direction

@@ -140,7 +140,9 @@ class RBCDState(NamedTuple):
 
 
 def build_graph(part: Partition, rank: int, dtype=jnp.float32,
-                pallas_sel: bool | None = None, planner: str = "auto"):
+                pallas_sel: bool | None = None, planner: str = "auto",
+                wide_tiles: bool | None = None,
+                sel_mode: str | None = None):
     """Assemble padded per-agent arrays from a partitioned measurement set.
 
     Each shared measurement appears in both endpoint agents' edge lists with
@@ -190,7 +192,14 @@ def build_graph(part: Partition, rank: int, dtype=jnp.float32,
     if pallas_sel is None:
         pallas_sel = jax.default_backend() == "tpu"
     if pallas_sel:
-        T, nt = _edge_tile_shape(n_max, s_max, e_max)
+        # Wide (T=256) tiles are sound only for bf16 selection modes
+        # (half-size one-hot transients; f32 aborts in Mosaic — see
+        # _edge_tile_shape).  Derive from ``sel_mode`` (the kernel
+        # selection mode this graph will run under, e.g.
+        # ``resolved_sel_mode(params)``) unless explicitly overridden.
+        if wide_tiles is None:
+            wide_tiles = sel_mode is not None and sel_mode != "f32"
+        T, nt = _edge_tile_shape(n_max, s_max, e_max, wide=wide_tiles)
         Ep = nt * T
         pad_idx = n_max + s_max  # one-hots to all-zero in both ranges
         idx_i = np.full((A, Ep), pad_idx, np.int32)
@@ -464,13 +473,24 @@ def use_dense_q(meta: GraphMeta, params: AgentParams | None,
 PALLAS_TCG_VMEM_BUDGET_BYTES = 10 << 20
 
 
-def _edge_tile_shape(n_max: int, s_max: int, e_max: int) -> tuple[int, int]:
+def _edge_tile_shape(n_max: int, s_max: int, e_max: int,
+                     wide: bool = False) -> tuple[int, int]:
     """(T, nt) of the kernel's tile-major edge layout.  Adaptive tile: the
     kernel's transient one-hots are [n, T]; halve the tile for large pose
-    buffers to keep them inside VMEM."""
+    buffers to keep them inside VMEM.
+
+    ``wide``: the caller runs a bf16 selection mode, whose one-hot
+    transients are HALF size — T stays at 256 up to ~3000-pose buffers.
+    Measured round 5 at 100k/64 (buffer 2288): bf16x3 T=128 -> 256 is
+    50.1 -> 58.5 rounds/s (fewer, wider dot issues); the SAME widening
+    in f32 mode aborts in Mosaic (scoped VMEM 17.8M > 16M), which is why
+    this is mode-gated rather than unconditional."""
     from ..ops.pallas_tcg import TILE
 
-    T = TILE if (n_max + s_max) <= 1024 else TILE // 2
+    if wide and (n_max + s_max) <= 3000:
+        T = TILE
+    else:
+        T = TILE if (n_max + s_max) <= 1024 else TILE // 2
     import os
     T = int(os.environ.get("PALLAS_TILE", T))  # A/B override (round 5)
     return T, max(1, -(-e_max // T))
@@ -1421,7 +1441,8 @@ def solve_rbcd(
     max_iters = params.max_num_iters if max_iters is None else max_iters
 
     part = part or partition_contiguous(meas, num_robots)
-    graph, meta = build_graph(part, params.r, dtype)
+    graph, meta = build_graph(part, params.r, dtype,
+                              sel_mode=resolved_sel_mode(params))
     X0 = initial_state_for(init, part, meta, graph, params, dtype)
     state = init_state(graph, meta, X0, params=params)
     step = lambda s, uw, rs: rbcd_step(s, graph, meta, params,

@@ -74,7 +74,12 @@ TILE = 256
 #: A/Bs these at the 100k shape — see BASELINE.md round-5 VPU entry).
 _UNROLL_TILES = os.environ.get("PALLAS_UNROLL_TILES", "0") == "1"
 _NS_SWEEPS = int(os.environ.get("PALLAS_NS_SWEEPS", "24"))
-_SEL_PACKED = os.environ.get("PALLAS_SEL_PACKED", "0") == "1"
+#: Packed selection is the production DEFAULT (round-5 A/B at 100k/64:
+#: bf16x3 33.8 -> 50.1 rounds/s from this alone — the kernel is
+#: dot-ISSUE-bound there, and packing the split passes into one
+#: row-stacked dot cuts issues 3x at identical MACs).  f32 mode is
+#: unaffected (no split passes).  "0" restores per-pass dots.
+_SEL_PACKED = os.environ.get("PALLAS_SEL_PACKED", "1") == "1"
 
 
 def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,

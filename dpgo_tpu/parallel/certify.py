@@ -284,7 +284,8 @@ def solve_staircase_sharded(meas, num_robots: int, mesh=None,
             acceleration=accel, restart_interval=restart_interval,
             solver=SolverParams(grad_norm_tol=grad_norm_tol,
                                 max_inner_iters=10))
-        graph, meta = rbcd.build_graph(part, r, dtype)
+        graph, meta = rbcd.build_graph(
+            part, r, dtype, sel_mode=rbcd.resolved_sel_mode(params))
         if Xa is None:
             Xa = rbcd.centralized_chordal_init(part, meta, graph, dtype)
         state = rbcd.init_state(graph, meta, jnp.asarray(Xa, dtype),

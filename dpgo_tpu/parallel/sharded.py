@@ -271,7 +271,8 @@ def solve_rbcd_sharded(
     max_iters = params.max_num_iters if max_iters is None else max_iters
 
     part = part or partition_contiguous(meas, num_robots)
-    graph, meta = rbcd.build_graph(part, params.r, dtype)
+    graph, meta = rbcd.build_graph(
+        part, params.r, dtype, sel_mode=rbcd.resolved_sel_mode(params))
     X0 = rbcd.initial_state_for(init, part, meta, graph, params, dtype)
     state = init_state(graph, meta, X0, params=params)
     state, graph = shard_problem(mesh, state, graph)

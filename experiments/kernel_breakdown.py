@@ -37,17 +37,22 @@ import numpy as np
 
 sys.path.insert(0, "/root/repo")
 
+# "unroll" (PALLAS_UNROLL_TILES) is a MEASURED DEAD END at the 100k
+# shape: Mosaic keeps every unrolled tile's transient one-hots live
+# concurrently instead of reusing the loop-carried buffer, so scoped
+# VMEM overflows (16.55M > 16M at T=128 bf16x3; 36.1M with t256+f32).
+#
+# NOTE: after the round-5 promotion, "base" = the production defaults
+# (PACKED selection on, wide T=256 tiles for bf16 modes).  The ablation
+# variants therefore TURN THINGS OFF to reproduce the A/B:
 VARIANTS = {
     "base": {},
-    "unroll": {"PALLAS_UNROLL_TILES": "1"},
-    "ns8": {"PALLAS_NS_SWEEPS": "8"},
-    "t256": {"PALLAS_TILE": "256"},
+    "unpacked": {"PALLAS_SEL_PACKED": "0"},
+    "t128": {"PALLAS_TILE": "128"},
+    "unpacked+t128": {"PALLAS_SEL_PACKED": "0",   # the round-4 config
+                      "PALLAS_TILE": "128"},
     "t512": {"PALLAS_TILE": "512"},
-    "packed": {"PALLAS_SEL_PACKED": "1"},
-    "packed+unroll+t256": {"PALLAS_SEL_PACKED": "1",
-                           "PALLAS_UNROLL_TILES": "1", "PALLAS_TILE": "256"},
-    "all": {"PALLAS_SEL_PACKED": "1", "PALLAS_UNROLL_TILES": "1",
-            "PALLAS_TILE": "256", "PALLAS_NS_SWEEPS": "8"},
+    "ns8": {"PALLAS_NS_SWEEPS": "8"},
 }
 
 
@@ -74,22 +79,24 @@ def worker():
                          solver=SolverParams(pallas_sel_mode=sel,
                                              max_inner_iters=inner))
     part = partition_contiguous(meas, A)
-    graph, meta = rbcd.build_graph(part, r, jnp.float32)
+    graph, meta = rbcd.build_graph(part, r, jnp.float32, sel_mode=sel)
     X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float32)
     state = rbcd.init_state(graph, meta, X0, params=params)
     form = rbcd._formulation(meta, params, graph)
     assert form == "pallas", form
     steps = lambda s, k: rbcd.rbcd_steps(s, graph, k, meta, params)
+    # Timing convention (bench.py / selmode_100k): end with a REAL
+    # readback — the tunneled TPU's block_until_ready returns early.
     t0 = time.perf_counter()
     st = steps(state, 1)
-    jax.block_until_ready(st.X)
+    _ = np.asarray(st.X)
     compile_s = time.perf_counter() - t0
-    jax.block_until_ready(steps(st, min(20, rounds)).X)
+    _ = np.asarray(steps(st, min(20, rounds)).X)
     rates = []
     for _ in range(3):
         t0 = time.perf_counter()
         out = steps(state, rounds)
-        jax.block_until_ready(out.X)
+        _ = np.asarray(out.X)
         rates.append(rounds / (time.perf_counter() - t0))
     # Parity: f64 cost of the 60-round iterate on the global edge set.
     st60 = steps(state, 60)
@@ -112,8 +119,11 @@ def main():
     results = {}
     for sel in ("f32", "bf16x3"):
         for name, env in VARIANTS.items():
+            # PYTHONPATH must APPEND: /root/.axon_site hosts the
+            # axon-tunnel sitecustomize (see verify SKILL.md).
             e = dict(os.environ, KB_MODE="worker", KB_ROUNDS=rounds,
-                     KB_SEL=sel, PYTHONPATH="/root/repo", **env)
+                     KB_SEL=sel,
+                     PYTHONPATH="/root/.axon_site:/root/repo", **env)
             t0 = time.perf_counter()
             out = subprocess.run([sys.executable, os.path.abspath(__file__)],
                                  env=e, capture_output=True, text=True,
@@ -133,7 +143,8 @@ def main():
     # Per-iteration isolation on the winning f32 variant.
     for inner in ("10", "2"):
         e = dict(os.environ, KB_MODE="worker", KB_ROUNDS=rounds, KB_SEL="f32",
-                 KB_INNER=inner, PYTHONPATH="/root/repo")
+                 KB_INNER=inner,
+                 PYTHONPATH="/root/.axon_site:/root/repo")
         out = subprocess.run([sys.executable, os.path.abspath(__file__)],
                              env=e, capture_output=True, text=True,
                              timeout=1800)

@@ -36,7 +36,7 @@ def build(meas, A, r, mode):
     params = AgentParams(d=meas.d, r=r, num_robots=A,
                          solver=SolverParams(pallas_sel_mode=mode))
     part = partition_contiguous(meas, A)
-    graph, meta = rbcd.build_graph(part, r, jnp.float32)
+    graph, meta = rbcd.build_graph(part, r, jnp.float32, sel_mode=mode)
     X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float32)
     state = rbcd.init_state(graph, meta, X0, params=params)
     return state, graph, meta, params
