@@ -127,6 +127,7 @@ SLOW_TESTS = {
     "test_two_process_tcp_solve_converges",
     "test_comm_model_matches_compiled_collectives",
     "test_sharded_staircase_escapes_winding_minimum",
+    "test_f32_staircase_polishes_before_certifying",
     "test_sharded_staircase_certifies_clean_graph",
 }
 

@@ -48,3 +48,24 @@ def test_stitched_winding_escape_through_sharded_staircase():
     costs = [f for _, f, *_ in hist]
     assert costs[0] > 1.0       # stayed wound through the r=2 descent
     assert costs[-1] < 1e-2     # unwound after the escape
+
+
+def test_f32_staircase_polishes_before_certifying():
+    """The f32 staircase path must run the stationarity POLISH before
+    each certificate (round 5: lambda_min(S) at the f32 descent floor
+    reads -O(||rgrad||) even at the optimum, so an unpolished f32
+    certificate falsely fails).  Small instance on the CPU mesh in f32,
+    end to end: escape -> unwind -> polished -> certified."""
+    meas, Xw = make_stitched_winding(6, 12)
+    part = partition_contiguous(meas, 6)
+    graph, meta = rbcd.build_graph(part, 2, jnp.float32)
+    Xa0 = rbcd.scatter_to_agents(jnp.asarray(Xw, jnp.float32), graph)
+    T, Xa, rank, cert, hist = dcert.solve_staircase_sharded(
+        meas, 6, mesh=make_mesh(6), r_min=2, r_max=6,
+        rounds_per_rank=900, dtype=jnp.float32, X0=np.asarray(Xa0),
+        accel=True)
+    assert cert.certified
+    assert rank >= 3
+    costs = [f for _, f, *_ in hist]
+    assert costs[0] > 1.0
+    assert costs[-1] < 1e-2
