@@ -292,20 +292,26 @@ def main():
     clean = [w["ips"] for w in windows if not w.get("contended")] or rates_all
     cpu_med = float(np.median(clean))
 
-    out = {
-        "metric": "rbcd_rounds_per_sec_sphere2500_8agents_r5",
-        "value": round(ips, 3),
-        "unit": "rounds/s",
-        "vs_baseline": round(ips / cpu_med, 3),
-        "sel_mode": SEL_MODE,
-        "cpu_arm_band": {"min": round(min(rates_all), 2),
-                         "median": round(cpu_med, 2),
-                         "max": round(max(rates_all), 2),
-                         "windows": [round(r, 2) for r in rates_all],
-                         "spacing_s": CPU_WINDOW_SPACING_S},
-        "vs_baseline_band": {"min": round(ips / max(rates_all), 2),
-                             "max": round(ips / min(rates_all), 2)},
-    }
+    # The final line goes through the obs event schema (same leading
+    # metric/value/unit keys as BENCH_r0*.json and the telemetry stream's
+    # metric events), so bench records and run telemetry parse with one
+    # reader (dpgo_tpu.obs.events.metric_record).
+    from dpgo_tpu.obs.events import metric_record
+
+    out = metric_record(
+        "rbcd_rounds_per_sec_sphere2500_8agents_r5",
+        round(ips, 3),
+        "rounds/s",
+        vs_baseline=round(ips / cpu_med, 3),
+        sel_mode=SEL_MODE,
+        cpu_arm_band={"min": round(min(rates_all), 2),
+                      "median": round(cpu_med, 2),
+                      "max": round(max(rates_all), 2),
+                      "windows": [round(r, 2) for r in rates_all],
+                      "spacing_s": CPU_WINDOW_SPACING_S},
+        vs_baseline_band={"min": round(ips / max(rates_all), 2),
+                          "max": round(ips / min(rates_all), 2)},
+    )
     if parity is not None:
         out["kernel_parity_max_abs_diff"] = parity
     if any(w.get("contended") for w in windows):

@@ -44,12 +44,14 @@ import dataclasses
 import enum
 import os
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .config import AgentParams, RobustCostType
+from . import obs
 from . import robust as robust_mod
 from .types import EdgeSet, Measurements
 from .utils import logger as logger_mod
@@ -245,6 +247,7 @@ class PGOAgent:
                 self._lift_and_initialize(self._T_local)
             else:
                 self._status.state = AgentState.WAIT_FOR_INITIALIZATION
+                self._obs_state_event()
 
     def _slot(self, robot: int, pose: int) -> int:
         key = (robot, pose)
@@ -264,6 +267,7 @@ class PGOAgent:
         self._gamma = 0.0
         self._alpha = 0.0
         self._status.state = AgentState.INITIALIZED
+        self._obs_state_event()
         self._build_step()
 
     def _build_step(self):
@@ -329,6 +333,38 @@ class PGOAgent:
         interpret = jax.default_backend() != "tpu"
         return (eidx_i, eidx_j, rot_t, trn_t, interpret)
 
+    # -- observability hooks (dpgo_tpu.obs; no-ops when telemetry is off) ---
+
+    def _obs_state_event(self) -> None:
+        """Emit a lifecycle transition event (WAIT_FOR_DATA ->
+        WAIT_FOR_INITIALIZATION -> INITIALIZED).  Called at the points the
+        state actually changes; zero work when no run is ambient."""
+        run = obs.get_run()
+        if run is None:
+            return
+        run.event("agent_state", phase="lifecycle", robot=self.robot_id,
+                  state=self._status.state.name,
+                  instance=self._status.instance_number,
+                  iteration=self._status.iteration_number)
+
+    def _obs_comms(self, direction: str, pose_dict: PoseDict,
+                   neighbor_id: int | None = None) -> None:
+        """Account one pose message: messages + bytes, labeled by robot and
+        (for receives) the peer — the per-neighbor communication volume the
+        reference driver hand-counts (``MultiRobotExample.cpp:274-279``)."""
+        run = obs.get_run()
+        if run is None or not pose_dict:
+            return
+        nbytes = sum(np.asarray(b).nbytes for b in pose_dict.values())
+        labels = {"robot": self.robot_id}
+        if neighbor_id is not None:
+            labels["neighbor"] = neighbor_id
+        run.counter(f"comms_messages_{direction}",
+                    f"pose-dict messages {direction}").inc(1, **labels)
+        run.counter(f"comms_bytes_{direction}",
+                    f"pose-dict payload bytes {direction}",
+                    unit="bytes").inc(nbytes, **labels)
+
     # -- pose sharing (the message vocabulary, SURVEY.md section 2.4) -------
 
     def get_shared_pose_dict(self) -> PoseDict:
@@ -337,7 +373,9 @@ class PGOAgent:
         with self._lock:
             if self.X is None:
                 return {}
-            return {(self.robot_id, p): self.X[p].copy() for p in self._public}
+            out = {(self.robot_id, p): self.X[p].copy() for p in self._public}
+        self._obs_comms("sent", out)
+        return out
 
     def get_aux_shared_pose_dict(self) -> PoseDict:
         """Public poses of the Nesterov aux sequence Y
@@ -345,13 +383,16 @@ class PGOAgent:
         with self._lock:
             if self._Y is None:
                 return {}
-            return {(self.robot_id, p): self._Y[p].copy() for p in self._public}
+            out = {(self.robot_id, p): self._Y[p].copy() for p in self._public}
+        self._obs_comms("sent", out)
+        return out
 
     def update_neighbor_poses(self, neighbor_id: int, pose_dict: PoseDict) -> None:
         """Receive a neighbor's public poses (``updateNeighborPoses``,
         ``PGOAgent.cpp:434-458``).  The first message from an INITIALIZED
         neighbor triggers robust frame alignment (``PGOAgent.cpp:369-432``).
         """
+        self._obs_comms("received", pose_dict, neighbor_id)
         with self._lock:
             for key, block in pose_dict.items():
                 if key in self._nbr_slot:
@@ -362,6 +403,7 @@ class PGOAgent:
 
     def update_aux_neighbor_poses(self, neighbor_id: int, pose_dict: PoseDict) -> None:
         """(``updateAuxNeighborPoses``, ``PGOAgent.cpp:460-479``)."""
+        self._obs_comms("received", pose_dict, neighbor_id)
         with self._lock:
             for key, block in pose_dict.items():
                 if key in self._nbr_slot:
@@ -598,6 +640,26 @@ class PGOAgent:
         self._weights = np.where(upd, w_new, self._weights)
         self._mu = float(robust_mod.gnc_update_mu(
             jnp.asarray(self._mu), self.params.robust))
+        run = obs.get_run()
+        if run is not None:
+            # ``w_new`` is already a host array (the residual evaluation
+            # above materialized it) — no device readback happens here.
+            w_lc = self._weights[self._lc_upd]
+            inl = float((w_lc > 0.5).mean()) if w_lc.size else 1.0
+            run.gauge("gnc_mu", "GNC control parameter").set(
+                self._mu, robot=self.robot_id)
+            run.gauge("gnc_inlier_fraction",
+                      "fraction of updatable LC edges at w>0.5").set(
+                inl, robot=self.robot_id)
+            run.histogram(
+                "gnc_weight", "GNC weight distribution over updatable "
+                "loop closures",
+                buckets=(0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0),
+            ).observe_many(w_lc, robot=self.robot_id)
+            run.metric("gnc_mu", self._mu, phase="weight_update",
+                       robot=self.robot_id,
+                       iteration=self._status.iteration_number,
+                       inlier_fraction=inl, num_lc=int(w_lc.size))
         if not self.params.robust_opt_warm_start and self._X_init is not None:
             self.X = self._X_init.copy()  # PGOAgent.cpp:657-662
         # initializeAcceleration after a weight update (PGOAgent.cpp:1054-1063)
@@ -659,6 +721,8 @@ class PGOAgent:
         bookkeeping (X <- Y), as ``updateX(false, true)`` does
         (``PGOAgent.cpp:1094-1098``).
         """
+        run = obs.get_run()
+        t0 = time.perf_counter() if run is not None else 0.0
         with self._lock:
             if self._status.state != AgentState.INITIALIZED:
                 return False
@@ -727,6 +791,26 @@ class PGOAgent:
                     ready = ready and conv.mean() >= \
                         params.robust_opt_min_convergence_ratio
             self._status.ready_to_terminate = bool(ready)
+            if run is not None:
+                # self.X is a host array by here (``np.asarray(X_new)``
+                # materialized the step) — the latency below includes the
+                # device work, with no telemetry-added sync.
+                dt = time.perf_counter() - t0
+                run.histogram(
+                    "agent_iterate_seconds",
+                    "PGOAgent.iterate wall-clock (lock + step + readback)",
+                    unit="s").observe(dt, robot=self.robot_id)
+                run.counter("agent_iterations",
+                            "iterate() calls that took an optimization "
+                            "step").inc(int(stepped), robot=self.robot_id)
+                run.gauge("agent_rel_change",
+                          "per-agent iterate relative change").set(
+                    rel, robot=self.robot_id)
+                run.event("agent_iterate", phase="iterate",
+                          robot=self.robot_id,
+                          iteration=self._status.iteration_number,
+                          stepped=stepped, rel_change=rel,
+                          ready=bool(ready), latency_s=dt)
             return stepped
 
     # -- async runtime ------------------------------------------------------
@@ -784,6 +868,7 @@ class PGOAgent:
             self._clear_problem()
             self._status.instance_number = instance
             self._neighbor_status.clear()
+            self._obs_state_event()
 
     def log_trajectory(self) -> None:
         """Mid-run dump with per-robot file names (reference

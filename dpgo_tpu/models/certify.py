@@ -31,11 +31,13 @@ centralized monitoring metrics):
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..config import SolverParams
 from ..types import EdgeSet, Measurements, edge_set_from_measurements
 from ..utils.lie import lifting_matrix
@@ -184,6 +186,8 @@ def certify_solution(
     ``f64_verify="never"`` the result reports ``decidable=False`` and
     refuses to certify.
     """
+    run = obs.get_run()
+    t0 = time.perf_counter() if run is not None else 0.0
     key = jax.random.PRNGKey(seed)
     # lobpcg_standard requires 5*k < dim; clamp the probe count so tiny
     # graphs (triangle/line test fixtures) certify instead of crashing.
@@ -208,6 +212,26 @@ def certify_solution(
         f64_solve if f64_verify == "auto" else None)
     if vec64 is not None:
         vec = jnp.asarray(vec64, X.dtype)
+    if run is not None:
+        # The eigenvalue gap is how far the decisive minimum eigenvalue
+        # clears the certification threshold -tol: positive = certified
+        # margin, negative = descent-direction depth the staircase escapes
+        # along.  ``float(lam_min)`` above already materialized the
+        # eigensolve, so the timing fence is the existing readback.
+        gap = lam_used + tol
+        run.gauge("certificate_eigenvalue_gap",
+                  "lambda_min + tol of the dual certificate").set(gap)
+        run.gauge("certificate_lambda_min",
+                  "minimum eigenvalue of the certificate operator").set(
+            lam_used)
+        run.counter("certificates_evaluated",
+                    "certify_solution calls").inc()
+        run.event("certificate", phase="certify",
+                  certified=certified, decidable=decidable,
+                  lambda_min=lam_min_f, lambda_min_f64=lam_f64,
+                  eigenvalue_gap=gap, tol=tol, sigma=sigma_f,
+                  stationarity_gap=float(stat), dim=dim,
+                  duration_s=time.perf_counter() - t0)
     return CertificateResult(
         certified=certified,
         lambda_min=lam_min_f,

@@ -36,6 +36,7 @@ Mapping to the reference:
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import NamedTuple
 
@@ -45,6 +46,7 @@ import numpy as np
 
 from ..config import (AgentParams, ROptAlg, RobustCostParams,
                       RobustCostType, Schedule)
+from .. import obs
 from .. import robust
 from ..types import EdgeSet, Measurements, edge_set_from_measurements
 from ..utils.graph_plan import plan_topology
@@ -1285,16 +1287,34 @@ def run_rbcd(
     num_meas = len(part.meas_global)
     edges_g = edge_set_from_measurements(part.meas_global, dtype=dtype)
 
+    # Telemetry (dpgo_tpu.obs): resolved ONCE per solve.  When off, the
+    # eval program below is byte-identical to the uninstrumented driver —
+    # zero events, zero registry calls, zero added transfers.  When on, the
+    # extra per-eval scalars (GNC mu, inlier fraction, per-agent relative
+    # change) ride the SAME stacked readback the driver already pays for,
+    # so telemetry never adds a device->host round-trip to the hot loop.
+    obs_run = obs.get_run()
+    telemetry = obs_run is not None
+
     @jax.jit
-    def central_metrics(Xa, weights, ready):
+    def central_metrics(Xa, weights, ready, mu, rel_change):
         # One stacked output = ONE device->host readback per eval (each
         # separate scalar fetch costs a full round-trip on a tunneled TPU).
         Xg = gather_to_global(Xa, graph, n_total)
         eg = edges_g._replace(weight=global_weights(weights, graph, num_meas))
         f = quadratic.cost(Xg, eg)
         g = manifold.rgrad(Xg, quadratic.egrad(Xg, eg))
-        return jnp.stack([f, manifold.norm(g),
-                          jnp.all(ready).astype(f.dtype)])
+        vals = [f, manifold.norm(g), jnp.all(ready).astype(f.dtype)]
+        if telemetry:
+            e = graph.edges
+            upd = e.mask * e.is_lc * (1.0 - e.fixed_weight)
+            n_upd = jnp.maximum(jnp.sum(upd), 1.0)
+            vals += [mu.astype(f.dtype),
+                     jnp.sum((weights > 0.5) * upd) / n_upd,
+                     jnp.sum(weights * upd) / n_upd]
+            return jnp.concatenate(
+                [jnp.stack(vals), rel_change.astype(f.dtype)])
+        return jnp.stack(vals)
 
     robust_on = params is not None and \
         params.robust.cost_type != RobustCostType.L2
@@ -1356,6 +1376,31 @@ def run_rbcd(
                   ((n0 - 1) // eval_every + 1) * eval_every, max_iters)
         return uw, rs, end
 
+    if telemetry:
+        obs_run.event("solve_start", phase="solve",
+                      num_robots=meta.num_robots, max_iters=max_iters,
+                      eval_every=eval_every, grad_norm_tol=grad_norm_tol,
+                      robust=robust_on, acceleration=accel_on)
+        g_cost = obs_run.gauge("solver_cost", "centralized SE(d) cost")
+        g_gn = obs_run.gauge("solver_grad_norm",
+                             "centralized Riemannian gradient norm")
+        c_rounds = obs_run.counter("solver_rounds", "RBCD rounds executed")
+        c_evals = obs_run.counter("solver_evals",
+                                  "centralized metric evaluations")
+        h_round = obs_run.histogram(
+            "round_latency_seconds",
+            "wall-clock per RBCD round at phase boundaries", unit="s")
+        g_agent_lat = obs_run.gauge(
+            "agent_round_latency_seconds",
+            "per-agent round latency (lockstep rounds: the eval-window "
+            "wall-clock over rounds, identical across agents)", unit="s")
+        g_agent_rel = obs_run.gauge("agent_rel_change",
+                                    "per-agent iterate relative change")
+        if robust_on:
+            g_mu = obs_run.gauge("gnc_mu", "GNC control parameter")
+            g_inl = obs_run.gauge("gnc_inlier_fraction",
+                                  "fraction of updatable LC edges at w>0.5")
+
     # Pipelined driver: advance to each eval boundary, ENQUEUE the metrics
     # program, dispatch one speculative segment past the boundary, and only
     # then fetch the metrics — the device works through the speculation
@@ -1364,6 +1409,8 @@ def run_rbcd(
     # round index, so speculation never changes which rounds are flagged;
     # a termination at the boundary simply discards the speculative state.
     spec = None  # (state, it, uw) one segment past the last eval boundary
+    t_solve0 = t_window = time.perf_counter()
+    it_window = 0
     while it < max_iters:
         target = min(((it // eval_every) + 1) * eval_every, max_iters)
         if spec is not None:
@@ -1377,13 +1424,43 @@ def run_rbcd(
             num_weight_updates += int(uw)
             state = segment(state, end - it, uw, rs)
             it = end
-        fut = central_metrics(state.X, state.weights, state.ready)
+        fut = central_metrics(state.X, state.weights, state.ready,
+                              state.mu, state.rel_change)
         if it < max_iters:
             uw, rs, end = _bounds(it, num_weight_updates)
             spec = (segment(state, end - it, uw, rs), end, uw)
-        f, gn, consensus = np.asarray(fut)
+        vec = np.asarray(fut)
+        f, gn, consensus = vec[:3]
         cost_hist.append(float(f))
         gn_hist.append(float(gn))
+        if telemetry:
+            # The fetch above already materialized everything this block
+            # reads — host-side bookkeeping only from here.
+            now = time.perf_counter()
+            dt, t_window = now - t_window, now
+            rounds = max(it - it_window, 1)
+            it_window = it
+            per_round = dt / rounds
+            mu_v, inl, mean_w = (float(x) for x in vec[3:6])
+            rel = vec[6:]
+            g_cost.set(float(f))
+            g_gn.set(float(gn))
+            c_rounds.inc(rounds)
+            c_evals.inc()
+            h_round.observe(per_round)
+            for a in range(rel.shape[0]):
+                g_agent_lat.set(per_round, agent=a)
+                g_agent_rel.set(float(rel[a]), agent=a)
+            ev = {"iteration": it, "round_latency_s": per_round,
+                  "rel_change_max": float(rel.max()) if rel.size else None}
+            obs_run.metric("solver_cost", float(f), phase="eval", **ev)
+            obs_run.metric("solver_grad_norm", float(gn), phase="eval", **ev)
+            if robust_on:
+                g_mu.set(mu_v)
+                g_inl.set(inl)
+                obs_run.metric("gnc_mu", mu_v, phase="eval", iteration=it)
+                obs_run.metric("gnc_inlier_fraction", inl, phase="eval",
+                               iteration=it, mean_weight=mean_w)
         if float(gn) < grad_norm_tol:
             terminated_by = "grad_norm"
             break
@@ -1400,6 +1477,14 @@ def run_rbcd(
                 global_weights(weights, graph, num_meas))
 
     T, w_glob = _finalize(state.X, state.weights)
+    if telemetry:
+        obs_run.event(
+            "solve_end", phase="solve", iterations=it,
+            terminated_by=terminated_by,
+            duration_s=time.perf_counter() - t_solve0,
+            cost=cost_hist[-1] if cost_hist else None,
+            grad_norm=gn_hist[-1] if gn_hist else None,
+            num_weight_updates=num_weight_updates)
     return RBCDResult(T=T, X=state.X, cost_history=cost_hist,
                       grad_norm_history=gn_hist, iterations=it,
                       terminated_by=terminated_by, weights=w_glob)

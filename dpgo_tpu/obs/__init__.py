@@ -1,0 +1,70 @@
+"""Run-scoped telemetry: metrics registry, JSONL event stream, exporters.
+
+The reference's only observability is ``ROPTResult`` wall-clock bookkeeping
+plus verbose printouts; both source papers evaluate convergence through
+per-iteration cost/gradient trajectories and per-agent status — signals the
+solvers here already compute but (before this subsystem) never collected,
+correlated, or exported.  This package is the substrate every perf and
+robustness change reports through:
+
+* ``MetricsRegistry`` (``metrics.py``) — thread-safe counters / gauges /
+  histograms with labels, safe to call from the agent's background
+  optimization thread (``agent.start_optimization_loop``).
+* ``EventStream`` (``events.py``) — structured JSONL: every line carries the
+  run id, wall + monotonic timestamps, a sequence number, and the solver
+  phase.  ``metric_record`` is the shared ``metric``/``value``/``unit``
+  record schema (``bench.py`` emits its final line through it, so bench and
+  telemetry records parse identically).
+* ``TelemetryRun`` (``run.py``) — one registry + one event stream scoped to
+  a run directory, installed as the process-ambient run (``start_run`` /
+  ``get_run`` / ``run_scope``).  Instrumented hot paths resolve the ambient
+  run and take a no-telemetry early exit when none is installed: with
+  telemetry off there are zero events, zero registry calls, and — by
+  construction — zero added device->host transfers (every device readback
+  the instrumentation performs goes through ``materialize``, which is only
+  reached behind a ``get_run() is not None`` guard; see
+  ``tests/test_obs.py::test_telemetry_off_is_zero_overhead``).
+* Exporters (``exporters.py``) — Prometheus text exposition, optional
+  TensorBoard scalars (gated on an available writer), and the JSON metrics
+  snapshot.  ``python -m dpgo_tpu.obs.report <run_dir>`` renders a
+  human-readable report from the persisted artifacts.
+
+Instrumentation discipline on accelerator hot paths: never add a host sync
+inside jitted code.  The solvers extend their *existing* phase-boundary
+readbacks (the ``run_rbcd`` eval fetch, ``PGOAgent.iterate``'s host-side
+state update) with telemetry scalars stacked into the same transfer, so a
+telemetry-on run costs one slightly-larger readback per phase boundary and
+a telemetry-off run is byte-identical to the uninstrumented driver.
+"""
+
+from __future__ import annotations
+
+from .events import EventStream, metric_record, read_events
+from .exporters import to_prometheus_text, write_tensorboard_scalars
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .run import (
+    TelemetryRun,
+    end_run,
+    get_run,
+    materialize,
+    run_scope,
+    start_run,
+)
+
+__all__ = [
+    "Counter",
+    "EventStream",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "TelemetryRun",
+    "end_run",
+    "get_run",
+    "materialize",
+    "metric_record",
+    "read_events",
+    "run_scope",
+    "start_run",
+    "to_prometheus_text",
+    "write_tensorboard_scalars",
+]

@@ -1,0 +1,121 @@
+"""Structured JSONL event stream.
+
+One event per line.  Every line carries the correlation fields up front —
+``run`` (run id), ``seq`` (per-stream sequence number), ``t_wall`` (Unix
+epoch seconds), ``t_mono`` (monotonic seconds, for intra-run latency math
+immune to clock steps), ``event`` (kind), and ``phase`` (solver phase the
+event belongs to: ``exchange`` / ``solve`` / ``eval`` / ``certify`` / ...)
+— followed by the event's own payload fields.
+
+``metric_record`` is the shared scalar-metric schema: the same
+``metric`` / ``value`` / ``unit`` leading keys as the repo's
+``BENCH_r0*.json`` records, so ``bench.py``'s final line and in-stream
+``metric`` events parse with one reader.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+
+
+def _jsonable(v):
+    """Coerce payload values to JSON-safe types (numpy scalars/arrays from
+    phase-boundary readbacks arrive here routinely; non-finite floats have
+    no JSON literal, so they become strings rather than invalid output)."""
+    if isinstance(v, (str, int, bool)) or v is None:
+        return v
+    if isinstance(v, float):
+        return v if math.isfinite(v) else str(v)
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if hasattr(v, "item") and getattr(v, "ndim", None) == 0:
+        return _jsonable(v.item())
+    if hasattr(v, "tolist"):
+        return _jsonable(v.tolist())
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    return str(v)
+
+
+def metric_record(metric: str, value, unit: str | None = None,
+                  **extra) -> dict:
+    """The canonical scalar-metric record: ``metric``/``value``/``unit``
+    first (the ``BENCH_r0*.json`` key set), extras after."""
+    rec = {"metric": str(metric), "value": _jsonable(value)}
+    if unit is not None:
+        rec["unit"] = str(unit)
+    for k, v in extra.items():
+        rec[k] = _jsonable(v)
+    return rec
+
+
+class EventStream:
+    """Append-only JSONL writer for one run.
+
+    Thread-safe: one lock serializes sequence assignment and the write, so
+    lines from the agent's optimization thread and a transport thread
+    interleave whole, never torn.  Lines are flushed per event — an event
+    stream that loses its tail on a crash is the one that mattered.
+    """
+
+    def __init__(self, path: str, run_id: str):
+        self.path = path
+        self.run_id = run_id
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._fh = open(path, "a", encoding="utf-8")
+        self._closed = False
+
+    def emit(self, event: str, phase: str | None = None, **fields) -> dict:
+        rec = {"run": self.run_id, "seq": 0,
+               "t_wall": time.time(), "t_mono": time.monotonic(),
+               "event": str(event)}
+        if phase is not None:
+            rec["phase"] = str(phase)
+        for k, v in fields.items():
+            rec[k] = _jsonable(v)
+        line = None
+        with self._lock:
+            if self._closed:
+                return rec
+            rec["seq"] = self._seq
+            self._seq += 1
+            line = json.dumps(rec)
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        return rec
+
+    def metric(self, metric: str, value, unit: str | None = None,
+               phase: str | None = None, **extra) -> dict:
+        """Emit one scalar-metric event in the shared schema."""
+        return self.emit("metric", phase=phase,
+                         **metric_record(metric, value, unit, **extra))
+
+    @property
+    def num_emitted(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                self._fh.close()
+
+
+def read_events(path: str) -> list[dict]:
+    """Load a JSONL event file; skips blank lines, raises on corrupt ones."""
+    out = []
+    with open(path, encoding="utf-8") as fh:
+        for ln, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{ln}: corrupt event line") from e
+    return out

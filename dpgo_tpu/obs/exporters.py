@@ -1,0 +1,117 @@
+"""Exporters: Prometheus text exposition and optional TensorBoard scalars.
+
+Both read from the registry / event stream without touching devices — the
+instrumentation layer already did its phase-boundary readbacks; exporters
+are pure host-side formatting.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+
+def _fmt_labels(labels: dict, extra: dict | None = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    parts = []
+    for k, v in sorted(merged.items()):
+        v = str(v).replace("\\", "\\\\").replace('"', '\\"')
+        parts.append(f'{k}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    return repr(float(v))
+
+
+def to_prometheus_text(registry) -> str:
+    """Prometheus text exposition (format version 0.0.4) of a
+    ``MetricsRegistry``: ``# HELP`` / ``# TYPE`` headers per family,
+    histogram families expanded to ``_bucket``/``_sum``/``_count`` with
+    cumulative ``le`` buckets."""
+    lines = []
+    for fam in registry.families():
+        if fam.help:
+            lines.append(f"# HELP {fam.name} {fam.help}")
+        lines.append(f"# TYPE {fam.name} {fam.kind}")
+        for key, val in sorted(fam.series().items()):
+            labels = dict(key)
+            if fam.kind == "histogram":
+                cum = 0
+                for bound, n in zip(fam.buckets, val["counts"]):
+                    cum += n
+                    lines.append(
+                        f"{fam.name}_bucket"
+                        f"{_fmt_labels(labels, {'le': _fmt_value(bound)})}"
+                        f" {cum}")
+                cum += val["counts"][-1]
+                lines.append(
+                    f"{fam.name}_bucket{_fmt_labels(labels, {'le': '+Inf'})}"
+                    f" {cum}")
+                lines.append(
+                    f"{fam.name}_sum{_fmt_labels(labels)}"
+                    f" {_fmt_value(val['sum'])}")
+                lines.append(
+                    f"{fam.name}_count{_fmt_labels(labels)} {val['count']}")
+            else:
+                lines.append(
+                    f"{fam.name}{_fmt_labels(labels)} {_fmt_value(val)}")
+    return "\n".join(lines) + "\n"
+
+
+def write_tensorboard_scalars(run_dir: str, events: list[dict],
+                              logdir: str | None = None) -> str | None:
+    """Export the stream's ``metric`` events as TensorBoard scalars.
+
+    Optional: uses whichever summary writer the environment already has
+    (``tensorboardX`` or TensorFlow's), returns None — without raising —
+    when neither is importable, so the core subsystem carries no
+    TensorBoard dependency.  Scalars are keyed by metric name, stepped by
+    the event's ``iteration`` field when present (else its sequence
+    number), and stamped with the event's wall time.
+    """
+    writer_cls = None
+    try:
+        from tensorboardX import SummaryWriter as writer_cls  # noqa: N813
+    except ImportError:
+        try:
+            from tensorflow.summary import create_file_writer  # noqa: F401
+            import tensorflow as tf
+        except ImportError:
+            return None
+        logdir = logdir or os.path.join(run_dir, "tensorboard")
+        w = tf.summary.create_file_writer(logdir)
+        with w.as_default():
+            for ev in events:
+                if ev.get("event") != "metric":
+                    continue
+                v = ev.get("value")
+                if not isinstance(v, (int, float)):
+                    continue
+                step = int(ev.get("iteration", ev.get("seq", 0)))
+                tf.summary.scalar(ev["metric"], v, step=step)
+        w.flush()
+        return logdir
+    logdir = logdir or os.path.join(run_dir, "tensorboard")
+    w = writer_cls(logdir)
+    try:
+        for ev in events:
+            if ev.get("event") != "metric":
+                continue
+            v = ev.get("value")
+            if not isinstance(v, (int, float)):
+                continue
+            step = int(ev.get("iteration", ev.get("seq", 0)))
+            w.add_scalar(ev["metric"], v, global_step=step,
+                         walltime=ev.get("t_wall"))
+    finally:
+        w.close()
+    return logdir

@@ -1,0 +1,169 @@
+"""Human-readable run report CLI.
+
+Usage::
+
+    python -m dpgo_tpu.obs.report <run_dir> [<run_dir>...]
+
+Reads the artifacts a ``TelemetryRun`` persisted (``events.jsonl``,
+``metrics.json``) and prints the run's story: event volume, per-iteration
+cost/gradient-norm trajectory, GNC mu annealing, round latency, per-phase
+wall-clock, and communication volume.  Pure host-side formatting — no
+devices are touched, so it runs anywhere the run directory is visible.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter as _TallyCounter
+from collections import defaultdict
+
+from .events import read_events
+from .run import EVENTS_FILE, META_FILE, METRICS_FILE
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _trajectory_lines(events: list[dict], metric: str) -> list[str]:
+    pts = [(ev.get("iteration", ev["seq"]), ev["value"]) for ev in events
+           if ev.get("event") == "metric" and ev.get("metric") == metric
+           and isinstance(ev.get("value"), (int, float))]
+    if not pts:
+        return []
+    vals = [v for _, v in pts]
+    head = (f"  {metric}: {len(pts)} points, first {_fmt(vals[0])}, "
+            f"last {_fmt(vals[-1])}, min {_fmt(min(vals))}, "
+            f"max {_fmt(max(vals))}")
+    shown = pts if len(pts) <= 8 else pts[:4] + [None] + pts[-3:]
+    rows = []
+    for p in shown:
+        rows.append("      ..." if p is None
+                    else f"      iter {p[0]:>6}: {_fmt(p[1])}")
+    return [head] + rows
+
+
+def _histogram_summary(name: str, fam: dict) -> list[str]:
+    out = []
+    bounds = fam.get("buckets", [])
+    for s in fam.get("series", []):
+        labels = ",".join(f"{k}={v}" for k, v in sorted(s["labels"].items()))
+        n = s.get("count", 0)
+        if not n:
+            continue
+        mean = s["sum"] / n
+        # Approximate median from the cumulative buckets.
+        cum, med = 0, "inf"
+        for bound, c in zip(bounds, s["counts"]):
+            cum += c
+            if cum >= n / 2:
+                med = _fmt(bound)
+                break
+        lab = f"{{{labels}}}" if labels else ""
+        out.append(f"  {name}{lab}: n={n} mean={_fmt(mean)} p50<={med}")
+    return out
+
+
+def render_report(run_dir: str) -> str:
+    lines = [f"== telemetry report: {run_dir} =="]
+    meta_path = os.path.join(run_dir, META_FILE)
+    if os.path.exists(meta_path):
+        with open(meta_path) as fh:
+            meta = json.load(fh)
+        lines.append(f"run id: {meta.get('run')}")
+
+    ev_path = os.path.join(run_dir, EVENTS_FILE)
+    events = read_events(ev_path) if os.path.exists(ev_path) else []
+    if events:
+        dur = events[-1]["t_mono"] - events[0]["t_mono"]
+        lines.append(f"events: {len(events)} over {dur:.2f}s")
+        tally = _TallyCounter(ev.get("event", "?") for ev in events)
+        kinds = ", ".join(f"{k} x{n}" for k, n in sorted(tally.items()))
+        lines.append(f"  kinds: {kinds}")
+
+        for ev in events:
+            if ev.get("event") == "solve_end":
+                lines.append(
+                    f"solve: {ev.get('iterations')} iterations, "
+                    f"terminated by {ev.get('terminated_by')} "
+                    f"in {_fmt(ev.get('duration_s'))}s")
+
+        lines.append("trajectories:")
+        metric_names = sorted({ev.get("metric") for ev in events
+                               if ev.get("event") == "metric"
+                               and ev.get("metric")})
+        any_traj = False
+        # Convergence signals first, everything else after.
+        front = [m for m in ("solver_cost", "solver_grad_norm", "gnc_mu",
+                             "gnc_inlier_fraction") if m in metric_names]
+        for m in front + [m for m in metric_names if m not in front]:
+            t = _trajectory_lines(events, m)
+            any_traj = any_traj or bool(t)
+            lines.extend(t)
+        if not any_traj:
+            lines.append("  (no metric events)")
+
+        timers = [ev for ev in events if ev.get("event") == "phase_timings"]
+        if timers:
+            lines.append("phase timings (last snapshot):")
+            for phase, row in sorted(
+                    timers[-1].get("timings", {}).items(),
+                    key=lambda kv: -kv[1].get("total_s", 0.0)):
+                lines.append(
+                    f"  {phase}: {row.get('total_s', 0.0):.4f}s "
+                    f"/ {row.get('count', 0)} "
+                    f"({row.get('avg_ms', 0.0):.2f} ms avg)")
+    else:
+        lines.append("events: none")
+
+    m_path = os.path.join(run_dir, METRICS_FILE)
+    if os.path.exists(m_path):
+        with open(m_path) as fh:
+            snap = json.load(fh)
+        metrics = snap.get("metrics", {})
+        lines.append("metrics snapshot:")
+        for name, fam in sorted(metrics.items()):
+            if fam["kind"] == "histogram":
+                lines.extend(_histogram_summary(name, fam))
+                continue
+            for s in fam.get("series", []):
+                labels = ",".join(f"{k}={v}"
+                                  for k, v in sorted(s["labels"].items()))
+                lab = f"{{{labels}}}" if labels else ""
+                unit = f" {fam['unit']}" if fam.get("unit") else ""
+                lines.append(f"  {name}{lab}: {_fmt(s.get('value'))}{unit}")
+    else:
+        lines.append("metrics snapshot: none (run not closed?)")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dpgo_tpu.obs.report", description=__doc__)
+    ap.add_argument("run_dir", nargs="+",
+                    help="telemetry run directory (holds events.jsonl)")
+    args = ap.parse_args(argv)
+    rc = 0
+    try:
+        for rd in args.run_dir:
+            if not os.path.isdir(rd):
+                print(f"not a run directory: {rd}", file=sys.stderr)
+                rc = 2
+                continue
+            print(render_report(rd))
+    except BrokenPipeError:
+        # Downstream pager/head closed the pipe — normal CLI etiquette.
+        try:
+            sys.stdout.close()
+        except OSError:
+            pass
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,9 +35,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import obs
 from ..config import AgentParams
 from ..types import Measurements
 from ..utils.partition import Partition, partition_contiguous
+from ..utils.profiling import RoundTimer
 from ..models import rbcd
 from ..models.rbcd import (GraphMeta, MultiAgentGraph, RBCDState,
                            centralized_chordal_init, init_state)
@@ -270,14 +272,33 @@ def solve_rbcd_sharded(
     params = params or AgentParams(d=meas.d, r=5, num_robots=num_robots)
     max_iters = params.max_num_iters if max_iters is None else max_iters
 
+    # Telemetry (dpgo_tpu.obs): per-phase setup timings and the per-device
+    # communication model for this mesh.  With no ambient run the timer is
+    # never created and the path below is the uninstrumented one.
+    run = obs.get_run()
+    timer = RoundTimer() if run is not None else None
+
     part = part or partition_contiguous(meas, num_robots)
+    if timer is not None:
+        timer.start("build_graph")
     graph, meta = rbcd.build_graph(
         part, params.r, dtype, sel_mode=rbcd.resolved_sel_mode(params))
+    if timer is not None:
+        timer.stop("build_graph")
+        timer.start("init")
     X0 = rbcd.initial_state_for(init, part, meta, graph, params, dtype)
     state = init_state(graph, meta, X0, params=params)
+    if timer is not None:
+        # The init chord/odometry solve runs on device; the obs-owned fence
+        # materializes it so the phase boundary is trustworthy (telemetry-on
+        # only — the off path never reaches this transfer).
+        timer.stop("init", sync=obs.materialize(state.X))
+        timer.start("shard")
     state, graph = shard_problem(mesh, state, graph)
 
     shifts, plan = _exchange_plan(mesh, meta, graph, exchange)
+    if timer is not None:
+        timer.stop("shard")
     sharded_step = make_sharded_step(mesh, meta, params, shifts, plan)
     sharded_multi = make_sharded_multi_step(mesh, meta, params, shifts, plan)
     sharded_seg = make_sharded_segment(mesh, meta, params, shifts, plan)
@@ -285,6 +306,22 @@ def solve_rbcd_sharded(
     multi = lambda s, k: sharded_multi(s, graph, k)
     seg = lambda s, k, uw, rs: sharded_seg(s, graph, k, update_weights=uw,
                                            restart=rs)
+    if run is not None:
+        mesh_size = int(mesh.devices.size)
+        bytes_round = comm_bytes_per_round(
+            meta, mesh_size, shifts=shifts if exchange == "ppermute" else None,
+            accel=params.acceleration,
+            itemsize=np.dtype(dtype).itemsize,
+            greedy=params.schedule.value == "greedy")
+        run.event("sharded_solve", phase="setup", mesh_size=mesh_size,
+                  mesh_axes=list(mesh.axis_names), exchange=exchange,
+                  num_robots=num_robots,
+                  agents_per_shard=num_robots // mesh_size,
+                  comm_bytes_per_round=bytes_round)
+        run.gauge("sharded_comm_bytes_per_round",
+                  "modeled per-device interconnect bytes per round",
+                  unit="bytes").set(bytes_round)
+        run.event("phase_timings", phase="setup", timings=timer.as_dict())
     return rbcd.run_rbcd(state, graph, meta, step, part, max_iters,
                          grad_norm_tol, eval_every, dtype, params=params,
                          multi_step=multi, segment=seg)

@@ -100,3 +100,19 @@ class RoundTimer:
                 for k, v in sorted(self.totals.items(),
                                    key=lambda kv: -kv[1])]
         return "\n".join(rows)
+
+    def as_dict(self) -> dict[str, dict[str, float]]:
+        """Machine-readable accumulated timings:
+        ``{phase: {"total_s", "count", "avg_ms"}}`` — the payload the
+        telemetry event stream carries as ``phase_timings`` (the print-only
+        ``summary()`` renders the same numbers)."""
+        return {k: {"total_s": v, "count": self.counts[k],
+                    "avg_ms": 1e3 * v / max(self.counts[k], 1)}
+                for k, v in self.totals.items()}
+
+    def reset(self) -> None:
+        """Drop all accumulated totals/counts and any in-flight ``start``
+        marks, so one timer instance can be reused across runs/windows."""
+        self.totals.clear()
+        self.counts.clear()
+        self._t0.clear()

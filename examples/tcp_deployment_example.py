@@ -126,13 +126,23 @@ def _dial_bus(robot_id: int, port: int, out_dir: str) -> socket.socket:
 
 def run_robot(robot_id: int, dataset: str, num_robots: int, rank: int,
               rounds: int, port: int, out_dir: str, mode: str,
-              robust: bool, async_rate: float) -> None:
+              robust: bool, async_rate: float,
+              telemetry: bool = False) -> None:
     setup_jax()
+    from dpgo_tpu import obs
     from dpgo_tpu.agent import AgentState, PGOAgent, PGOAgentStatus
     from dpgo_tpu.config import AgentParams, RobustCostParams, RobustCostType
     from dpgo_tpu.utils.g2o import read_g2o
     from dpgo_tpu.utils.partition import agent_measurements, \
         partition_contiguous
+
+    # Each robot process scopes its own telemetry run (one run dir per
+    # robot, like the reference's one-logDirectory-per-process layout);
+    # once ambient, the PGOAgent hot paths (iterate latency, per-neighbor
+    # comms bytes, GNC weight updates) record into it automatically.
+    run = obs.start_run(
+        os.path.join(out_dir, "telemetry", f"robot{robot_id}")) \
+        if telemetry else None
 
     meas = read_g2o(dataset)
     rp = RobustCostParams(cost_type=RobustCostType.GNC_TLS) if robust \
@@ -230,6 +240,14 @@ def run_robot(robot_id: int, dataset: str, num_robots: int, rank: int,
              state=np.asarray(st.state.value),
              iterations=np.asarray(st.iteration_number),
              bytes_sent=np.asarray(bytes_sent))
+    if run is not None:
+        # Wire-level bytes (length-prefixed npz frames) — the real transport
+        # cost, alongside the payload bytes the agent hooks counted.
+        run.metric("tcp_bytes_sent", bytes_sent, "bytes", phase="report",
+                   robot=robot_id, rounds=rounds, mode=mode)
+        run.metric("agent_final_iterations", st.iteration_number, phase="report",
+                   robot=robot_id)
+        obs.end_run()
 
 
 # ---------------------------------------------------------------------------
@@ -299,7 +317,8 @@ def launch(args) -> int:
          "--port", str(port), "--rank", str(args.rank),
          "--rounds", str(args.rounds), "--mode", args.mode,
          "--async-rate", str(args.async_rate), "--out-dir", out_dir]
-        + (["--robust"] if args.robust else []),
+        + (["--robust"] if args.robust else [])
+        + (["--telemetry"] if args.telemetry else []),
         env=child_env) for rid in range(args.robots)]
     try:
         rcs = [p.wait(timeout=900) for p in procs]
@@ -341,6 +360,17 @@ def launch(args) -> int:
         "out_dir": out_dir,
     }
     print(json.dumps(result))
+    if args.telemetry:
+        from dpgo_tpu.obs.report import render_report
+        tdir = os.path.join(out_dir, "telemetry")
+        for rid in range(args.robots):
+            rd = os.path.join(tdir, f"robot{rid}")
+            if os.path.isdir(rd):
+                print(file=sys.stderr)
+                print(render_report(rd), file=sys.stderr)
+        print(f"\nPer-robot telemetry under {tdir} — re-render with: "
+              f"python -m dpgo_tpu.obs.report {tdir}/robot<id>",
+              file=sys.stderr)
     return 0
 
 
@@ -352,6 +382,10 @@ def main() -> None:
     ap.add_argument("--rounds", type=int, default=120)
     ap.add_argument("--mode", choices=("sync", "async"), default="sync")
     ap.add_argument("--robust", action="store_true")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="per-robot telemetry runs (dpgo_tpu.obs) under "
+                         "OUT_DIR/telemetry/robot<id>, reported after the "
+                         "solve")
     ap.add_argument("--async-rate", type=float, default=20.0,
                     help="async mode: per-robot Poisson iterate rate (Hz) "
                          "and the bus exchange cadence")
@@ -364,7 +398,7 @@ def main() -> None:
         sys.exit(launch(args))
     run_robot(args.robot, args.dataset, args.robots, args.rank, args.rounds,
               args.port, args.out_dir, args.mode, args.robust,
-              args.async_rate)
+              args.async_rate, telemetry=args.telemetry)
 
 
 if __name__ == "__main__":
