@@ -134,6 +134,13 @@ class PGOAgent:
         self._num_weight_updates = 0
         self._neighbor_poses: dict[PoseID, np.ndarray] = {}
         self._aux_neighbor_poses: dict[PoseID, np.ndarray] = {}
+        # Transport bookkeeping (dpgo_tpu.comms): last accepted pose-frame
+        # sequence per neighbor, and neighbors declared dead by the
+        # transport (excluded from the should_terminate quorum; their
+        # cached poses above stay frozen — the RA-L delay-tolerance model).
+        self._nbr_pose_seq: dict[int, int] = {}
+        self._nbr_aux_seq: dict[int, int] = {}
+        self._lost_neighbors: set[int] = set()
         self._global_anchor: np.ndarray | None = None
         # Nesterov sequences (PGOAgent.cpp:1054-1091)
         self._V: np.ndarray | None = None
@@ -387,11 +394,49 @@ class PGOAgent:
         self._obs_comms("sent", out)
         return out
 
-    def update_neighbor_poses(self, neighbor_id: int, pose_dict: PoseDict) -> None:
+    def _check_pose_seq(self, seq_cache: dict, neighbor_id: int,
+                        sequence: int | None) -> bool:
+        """Monotonic per-neighbor sequence check (under the lock).  Returns
+        True when the message is fresh; a stale/reordered/duplicate frame
+        (sequence at or below the highest already accepted) must not roll
+        the neighbor cache backwards."""
+        if sequence is None:
+            return True  # sequence-less transport (in-process calls)
+        if sequence <= seq_cache.get(neighbor_id, -1):
+            return False
+        seq_cache[neighbor_id] = int(sequence)
+        return True
+
+    def _obs_stale_dropped(self, neighbor_id: int) -> None:
+        run = obs.get_run()
+        if run is None:
+            return
+        run.counter("comms_stale_dropped",
+                    "pose messages dropped as stale/reordered").inc(
+            1, robot=self.robot_id, neighbor=neighbor_id)
+
+    def update_neighbor_poses(self, neighbor_id: int, pose_dict: PoseDict,
+                              sequence: int | None = None) -> None:
         """Receive a neighbor's public poses (``updateNeighborPoses``,
         ``PGOAgent.cpp:434-458``).  The first message from an INITIALIZED
         neighbor triggers robust frame alignment (``PGOAgent.cpp:369-432``).
+
+        ``sequence`` is the transport's monotonic frame number for this
+        neighbor (``dpgo_tpu.comms`` stamps it): a stale or reordered frame
+        is dropped and counted instead of silently overwriting fresher
+        cached poses.  A fresh frame from a neighbor previously declared
+        lost revives it (it is talking again).
         """
+        with self._lock:
+            if not self._check_pose_seq(self._nbr_pose_seq, neighbor_id,
+                                        sequence):
+                stale = True
+            else:
+                stale = False
+                self._lost_neighbors.discard(neighbor_id)
+        if stale:
+            self._obs_stale_dropped(neighbor_id)
+            return
         self._obs_comms("received", pose_dict, neighbor_id)
         with self._lock:
             for key, block in pose_dict.items():
@@ -401,8 +446,15 @@ class PGOAgent:
                     and self._neighbor_is_initialized(neighbor_id)):
                 self._try_initialize_in_global_frame(neighbor_id)
 
-    def update_aux_neighbor_poses(self, neighbor_id: int, pose_dict: PoseDict) -> None:
+    def update_aux_neighbor_poses(self, neighbor_id: int, pose_dict: PoseDict,
+                                  sequence: int | None = None) -> None:
         """(``updateAuxNeighborPoses``, ``PGOAgent.cpp:460-479``)."""
+        with self._lock:
+            stale = not self._check_pose_seq(self._nbr_aux_seq, neighbor_id,
+                                             sequence)
+        if stale:
+            self._obs_stale_dropped(neighbor_id)
+            return
         self._obs_comms("received", pose_dict, neighbor_id)
         with self._lock:
             for key, block in pose_dict.items():
@@ -487,17 +539,47 @@ class PGOAgent:
         with self._lock:
             self._neighbor_status[status.robot_id] = dataclasses.replace(status)
 
+    def mark_neighbor_lost(self, neighbor_id: int) -> None:
+        """The transport declared ``neighbor_id`` dead (closed connection,
+        heartbeat silence).  Its cached poses stay frozen — optimization
+        continues against the last received iterate, the RA-L 2020 delay
+        tolerance — and it no longer blocks the ``should_terminate``
+        quorum, so the surviving team can still finish.  A fresh pose
+        message revives the neighbor (``update_neighbor_poses``)."""
+        neighbor_id = int(neighbor_id)
+        if neighbor_id == self.robot_id:
+            return
+        with self._lock:
+            if neighbor_id in self._lost_neighbors:
+                return
+            self._lost_neighbors.add(neighbor_id)
+        run = obs.get_run()
+        if run is not None:
+            run.event("peer_lost", phase="comms", robot=self.robot_id,
+                      peer=neighbor_id,
+                      iteration=self._status.iteration_number)
+
+    @property
+    def lost_neighbors(self) -> list[int]:
+        with self._lock:
+            return sorted(self._lost_neighbors)
+
     def should_terminate(self) -> bool:
         """Team consensus (``shouldTerminate``, ``PGOAgent.cpp:1007-1031``):
-        every robot INITIALIZED on this instance and ready to terminate."""
+        every robot INITIALIZED on this instance and ready to terminate.
+        Robots declared lost by the transport (``mark_neighbor_lost``) are
+        excluded from the quorum — a dead robot must not veto forever."""
         with self._lock:
-            statuses = [self._status] + [
-                self._neighbor_status[k] for k in sorted(self._neighbor_status)]
-            if len(statuses) < self.num_robots:
+            me = self._status
+            if (me.state != AgentState.INITIALIZED
+                    or not me.ready_to_terminate):
                 return False
-            for st in statuses:
-                if (st.state != AgentState.INITIALIZED
-                        or st.instance_number != self._status.instance_number
+            for rid in range(self.num_robots):
+                if rid == self.robot_id or rid in self._lost_neighbors:
+                    continue
+                st = self._neighbor_status.get(rid)
+                if (st is None or st.state != AgentState.INITIALIZED
+                        or st.instance_number != me.instance_number
                         or not st.ready_to_terminate):
                     return False
             return True

@@ -1,0 +1,106 @@
+"""Fault-tolerant deployment transport (``dpgo_tpu.comms``).
+
+The per-robot runtime (``dpgo_tpu.agent``) deliberately owns no transport:
+the reference delegates it to the external ``dpgo_ros`` wrapper, and our
+deployment examples used to carry their own ad-hoc socket code that assumed
+a perfect network — blocking reads with no deadline, no retries, no
+staleness bookkeeping, and a hang if any robot process died.  The RA-L 2020
+asynchronous DPGO convergence result holds precisely *because* messages may
+be delayed, stale, or lost; this package makes the deployment path live up
+to that claim:
+
+* ``protocol`` — the wire format: length-prefixed ``npz`` frames (arrays
+  only, no pickle), with a validated frame-size cap (a corrupt or malicious
+  length header raises ``ProtocolError`` instead of attempting an OOM-sized
+  allocation) and an incremental ``FrameAssembler`` so a read deadline can
+  interrupt and later resume a partially received frame.
+* ``transport`` — the ``Transport`` abstraction plus the two shipped
+  implementations: ``LoopbackTransport`` (in-process pair, delay-aware
+  inboxes) and ``TcpTransport`` (localhost/TCP, lifted out of
+  ``examples/tcp_deployment_example.py``).  Both thread every outgoing
+  frame through an optional ``FaultInjector``.
+* ``faults`` — deterministic, seeded fault injection: drop / delay /
+  reorder / corrupt / partition, with per-link RNG streams so results do
+  not depend on thread scheduling across links.
+* ``reliable`` — the fault-tolerance layer: ``ReliableChannel`` wraps any
+  transport with per-message send/recv deadlines, bounded retry with
+  exponential backoff + jitter, monotonic sequence numbers (stale and
+  reordered frames are dropped, counted), corrupt-frame rejection,
+  heartbeat-based peer liveness, and ``dpgo_tpu.obs`` instrumentation
+  (``comms_retries`` / ``comms_timeouts`` / ``comms_stale_dropped`` /
+  ``comms_corrupt_dropped`` counters, terminal ``run_summary`` event)
+  behind the same zero-overhead telemetry-off fence as the solver paths.
+* ``bus`` — the hub role the launcher plays (what dpgo_ros' pub/sub does in
+  the reference's deployments): ``RoundBus`` gathers one fresh frame per
+  live robot per round and rebroadcasts the union; a silent or dead robot
+  is detected (closed transport, or consecutive misses with a stale
+  heartbeat), excluded, and announced to the survivors, so the solve
+  degrades gracefully instead of hanging.  ``BusClient`` is the robot-side
+  counterpart; ``pack_agent_frame`` / ``apply_peer_frame`` serialize the
+  ``PGOAgent`` message vocabulary onto the wire.
+
+Failure semantics on peer death: in async mode the dead robot's cached
+poses stay frozen in every survivor (the RA-L delay-tolerance argument —
+optimization continues against the last received iterate); in sync mode
+the dead robot is excluded from the ``should_terminate`` quorum
+(``PGOAgent.mark_neighbor_lost``) so the remaining team can still reach
+consensus and finish.
+"""
+
+from __future__ import annotations
+
+from .faults import FaultInjector, FaultSpec
+from .protocol import (
+    DEFAULT_MAX_FRAME_BYTES,
+    FrameAssembler,
+    ProtocolError,
+    decode_payload,
+    encode_payload,
+    pack_pose_dict,
+    recv_frame,
+    send_frame,
+    unpack_pose_dict,
+)
+from .reliable import ChannelTotals, ReliableChannel, RetryPolicy
+from .transport import (
+    LoopbackTransport,
+    TcpTransport,
+    Transport,
+    TransportClosed,
+    TransportError,
+    TransportTimeout,
+    connect_tcp,
+    listen_tcp,
+)
+from .bus import (BusClient, RoundBus, apply_peer_frame,
+                  loopback_fleet, pack_agent_frame)
+
+__all__ = [
+    "BusClient",
+    "ChannelTotals",
+    "DEFAULT_MAX_FRAME_BYTES",
+    "FaultInjector",
+    "FaultSpec",
+    "FrameAssembler",
+    "LoopbackTransport",
+    "ProtocolError",
+    "ReliableChannel",
+    "RetryPolicy",
+    "RoundBus",
+    "TcpTransport",
+    "Transport",
+    "TransportClosed",
+    "TransportError",
+    "TransportTimeout",
+    "apply_peer_frame",
+    "connect_tcp",
+    "decode_payload",
+    "encode_payload",
+    "listen_tcp",
+    "loopback_fleet",
+    "pack_agent_frame",
+    "pack_pose_dict",
+    "recv_frame",
+    "send_frame",
+    "unpack_pose_dict",
+]

@@ -1,0 +1,308 @@
+"""The round bus: hub-and-spoke relay with graceful agent dropout.
+
+The launcher of ``examples/tcp_deployment_example.py`` plays the pub/sub
+role the reference delegates to ``dpgo_ros``: it accepts one connection per
+robot and, each round, collects one frame from every robot and rebroadcasts
+the union (keys namespaced ``r{id}|...``).  ``RoundBus`` is that loop as a
+library, made fault-tolerant:
+
+* A robot whose frame misses the round deadline is *not* waited on forever:
+  its last known frame is rebroadcast (its poses freeze — the RA-L delay
+  tolerance), and a miss is counted.
+* A robot is declared **lost** when its transport closes, or after
+  ``miss_limit`` consecutive misses with a stale heartbeat (silence, not
+  slowness).  Lost robots are excluded from the gather, announced to the
+  survivors in the ``_lost`` broadcast key, and the solve continues.
+* ``poll`` draining after each fresh frame re-synchronizes a link that
+  delay faults pushed a round behind.
+
+``BusClient`` is the robot side: stamp-and-publish, collect with a
+deadline (a missed broadcast skips one update, it does not deadlock), and
+surface the bus's lost-peer announcements so the agent can adjust its
+termination quorum (``PGOAgent.mark_neighbor_lost``).
+
+``pack_agent_frame`` / ``apply_peer_frame`` serialize the ``PGOAgent``
+message vocabulary (status gossip, public poses, GNC weights, global
+anchor) onto the wire — shared by the TCP example, the in-process async
+example, and the chaos tests so every path speaks the same protocol.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import obs
+from .protocol import pack_pose_dict, unpack_pose_dict
+from .reliable import ChannelTotals, ReliableChannel, RetryPolicy
+from .transport import TcpTransport, TransportClosed, TransportTimeout
+
+
+# ---------------------------------------------------------------------------
+# Hub side
+# ---------------------------------------------------------------------------
+
+def accept_robots(srv, num_robots: int, injector=None,
+                  policy: RetryPolicy | None = None,
+                  hello_timeout_s: float = 30.0,
+                  max_frame_bytes: int | None = None
+                  ) -> dict[int, ReliableChannel]:
+    """Accept one TCP connection per robot; each must introduce itself with
+    a ``{"hello": robot_id}`` frame within the deadline."""
+    import socket as _socket
+
+    channels: dict[int, ReliableChannel] = {}
+    srv.settimeout(hello_timeout_s)
+    while len(channels) < num_robots:
+        try:
+            conn, _ = srv.accept()
+        except _socket.timeout:
+            raise ConnectionError(
+                f"only {len(channels)}/{num_robots} robots connected "
+                f"within {hello_timeout_s}s") from None
+        kw = {} if max_frame_bytes is None else \
+            {"max_frame_bytes": max_frame_bytes}
+        t = TcpTransport(conn, src="bus", dst="?", injector=injector, **kw)
+        ch = ReliableChannel(t, policy=policy)
+        hello = ch.recv(timeout=hello_timeout_s)
+        rid = int(hello["hello"])
+        t.dst = f"robot{rid}"
+        ch.name = f"bus->robot{rid}"
+        channels[rid] = ch
+    return channels
+
+
+class RoundBus:
+    """Gather one fresh frame per live robot, rebroadcast the union."""
+
+    def __init__(self, channels: dict[int, ReliableChannel],
+                 round_timeout_s: float = 5.0, miss_limit: int = 3,
+                 liveness_timeout_s: float = 2.0):
+        self.channels = channels
+        self.round_timeout_s = round_timeout_s
+        self.miss_limit = miss_limit
+        self.liveness_timeout_s = liveness_timeout_s
+        self.lost: set[int] = set()
+        self._last_frames: dict[int, dict] = {}
+        self._last_seqs: dict[int, int] = {}
+        self._misses: dict[int, int] = {rid: 0 for rid in channels}
+        self.rounds_served = 0
+
+    def _mark_lost(self, rid: int, reason: str) -> None:
+        if rid in self.lost:
+            return
+        self.lost.add(rid)
+        run = obs.get_run()
+        if run is not None:
+            run.event("peer_lost", phase="comms", peer=rid, reason=reason,
+                      round=self.rounds_served)
+
+    def _gather_one(self, rid: int) -> None:
+        ch = self.channels[rid]
+        try:
+            frame = ch.recv(timeout=self.round_timeout_s)
+        except TransportTimeout:
+            self._misses[rid] += 1
+            age = ch.last_seen_age()
+            hb_stale = age is None or age > self.liveness_timeout_s
+            if self._misses[rid] >= self.miss_limit and hb_stale:
+                self._mark_lost(rid, "silent")
+            return
+        except TransportClosed:
+            self._mark_lost(rid, "closed")
+            return
+        # Drain to the freshest queued frame: delay faults can leave a link
+        # a round behind; the channel's sequence check guarantees each
+        # poll() result is strictly newer.  A peer that closed right after
+        # its last frame is marked lost here instead of crashing the round.
+        try:
+            while True:
+                newer = ch.poll()
+                if newer is None:
+                    break
+                frame = newer
+        except TransportClosed:
+            self._mark_lost(rid, "closed")
+        self._misses[rid] = 0
+        self._last_frames[rid] = frame
+        self._last_seqs[rid] = ch.last_recv_seq
+
+    def round(self) -> dict:
+        """One relay round; returns the merged broadcast frame."""
+        for rid in sorted(self.channels):
+            if rid not in self.lost:
+                self._gather_one(rid)
+        merged: dict = {}
+        for rid, frame in sorted(self._last_frames.items()):
+            if rid in self.lost:
+                continue
+            merged.update({f"r{rid}|{k}": v for k, v in frame.items()})
+            merged[f"r{rid}|_pseq"] = np.asarray(
+                self._last_seqs.get(rid, -1), np.int64)
+        merged["_lost"] = np.asarray(sorted(self.lost), np.int64)
+        for rid, ch in sorted(self.channels.items()):
+            if rid in self.lost:
+                continue
+            try:
+                ch.send(merged, timeout=self.round_timeout_s)
+            except (TransportClosed, TransportTimeout):
+                self._mark_lost(rid, "broadcast_failed")
+        self.rounds_served += 1
+        return merged
+
+    def serve(self, total_rounds: int) -> None:
+        """Relay ``total_rounds`` rounds, stopping early if every robot is
+        gone (nothing left to serve — never hang on a dead fleet)."""
+        for _ in range(total_rounds):
+            if len(self.lost) == len(self.channels):
+                break
+            self.round()
+
+    def totals(self) -> ChannelTotals:
+        agg = ChannelTotals()
+        for ch in self.channels.values():
+            agg.add(ch.totals)
+        return agg
+
+    def close(self) -> None:
+        """Emit one aggregated ``run_summary`` for the hub, close links."""
+        run = obs.get_run()
+        if run is not None:
+            run.event("run_summary", phase="comms", channel="bus",
+                      peers_lost=sorted(self.lost),
+                      rounds_served=self.rounds_served,
+                      **self.totals().as_dict())
+        for ch in self.channels.values():
+            ch.close(emit_summary=False)
+
+
+# ---------------------------------------------------------------------------
+# Robot side
+# ---------------------------------------------------------------------------
+
+class BusClient:
+    """A robot's view of the bus: publish, collect, track lost peers."""
+
+    def __init__(self, channel: ReliableChannel, robot_id: int):
+        self.channel = channel
+        self.robot_id = int(robot_id)
+        self.lost: set[int] = set()
+
+    def hello(self, timeout: float | None = None) -> None:
+        self.channel.send({"hello": np.asarray(self.robot_id, np.int64)},
+                          timeout=timeout)
+
+    def publish(self, frame: dict, timeout: float | None = None) -> int:
+        return self.channel.send(frame, timeout=timeout)
+
+    def collect(self, timeout: float | None = None) -> dict | None:
+        """The next broadcast, or None when the deadline passed (skip this
+        round's updates and carry on — the bus caches our last frame).
+        Raises ``TransportClosed`` when the bus itself is gone."""
+        try:
+            merged = self.channel.recv(timeout=timeout)
+        except TransportTimeout:
+            return None
+        if "_lost" in merged:
+            self.lost = {int(x) for x in np.asarray(merged["_lost"]).ravel()}
+        return merged
+
+    def exchange(self, frame: dict,
+                 timeout: float | None = None) -> dict | None:
+        self.publish(frame, timeout=timeout)
+        return self.collect(timeout=timeout)
+
+    def peer_frames(self, merged: dict) -> dict[int, dict]:
+        """Split a broadcast into per-peer sub-frames (self excluded)."""
+        out: dict[int, dict] = {}
+        for key, v in merged.items():
+            if not key.startswith("r") or "|" not in key:
+                continue
+            rid_s, sub = key.split("|", 1)
+            rid = int(rid_s[1:])
+            if rid == self.robot_id:
+                continue
+            out.setdefault(rid, {})[sub] = v
+        return out
+
+    def close(self) -> None:
+        self.channel.close()
+
+
+def loopback_fleet(num_robots: int, injector=None,
+                   policy: RetryPolicy | None = None,
+                   round_timeout_s: float = 2.0, miss_limit: int = 3,
+                   liveness_timeout_s: float = 2.0
+                   ) -> tuple[RoundBus, dict[int, BusClient]]:
+    """An in-process fleet: one ``LoopbackTransport`` pair per robot, the
+    hub ends assembled into a ``RoundBus``, the robot ends into
+    ``BusClient``s.  The chaos tests and the async example run on this —
+    same framing, fault, retry, and dropout code paths as TCP, no
+    sockets."""
+    from .transport import LoopbackTransport
+
+    channels: dict[int, ReliableChannel] = {}
+    clients: dict[int, BusClient] = {}
+    for rid in range(num_robots):
+        t_bus, t_robot = LoopbackTransport.pair(
+            "bus", f"robot{rid}", injector=injector)
+        channels[rid] = ReliableChannel(t_bus, f"bus->robot{rid}", policy)
+        clients[rid] = BusClient(
+            ReliableChannel(t_robot, f"robot{rid}->bus", policy), rid)
+    bus = RoundBus(channels, round_timeout_s=round_timeout_s,
+                   miss_limit=miss_limit,
+                   liveness_timeout_s=liveness_timeout_s)
+    return bus, clients
+
+
+# ---------------------------------------------------------------------------
+# Agent frame vocabulary
+# ---------------------------------------------------------------------------
+
+def pack_agent_frame(agent, robust: bool = False,
+                     include_anchor: bool = False) -> dict:
+    """One round's outgoing frame for a ``PGOAgent``: status gossip, public
+    poses, owned GNC weights, and (robot 0) the global anchor."""
+    st = agent.get_status()
+    frame = {"status": np.asarray(
+        [st.robot_id, st.state.value, st.instance_number,
+         st.iteration_number, int(st.ready_to_terminate)], np.int64),
+        "relchange": np.asarray(st.relative_change, np.float64)}
+    frame.update(pack_pose_dict("pose", agent.get_shared_pose_dict()))
+    if robust:
+        frame.update({
+            f"wt_{r1}_{p1}_{r2}_{p2}": np.asarray(w, np.float64)
+            for ((r1, p1), (r2, p2)), w in
+            agent.get_shared_weight_dict().items()})
+    if include_anchor:
+        anchor = agent.get_global_anchor()
+        if anchor is not None:
+            frame["anchor"] = np.asarray(anchor)
+    return frame
+
+
+def apply_peer_frame(agent, peer_id: int, pf: dict, robust: bool = False,
+                     accept_anchor: bool = False) -> None:
+    """Ingest one peer's sub-frame into a ``PGOAgent``: status, poses
+    (sequence-checked via the bus's ``_pseq`` tag), weights, anchor."""
+    from ..agent import AgentState, PGOAgentStatus
+
+    if "status" in pf:
+        ps = np.asarray(pf["status"], np.int64)
+        agent.set_neighbor_status(PGOAgentStatus(
+            robot_id=int(ps[0]), state=AgentState(int(ps[1])),
+            instance_number=int(ps[2]), iteration_number=int(ps[3]),
+            ready_to_terminate=bool(ps[4]),
+            relative_change=float(pf.get("relchange", np.inf))))
+    seq = int(pf["_pseq"]) if "_pseq" in pf else None
+    agent.update_neighbor_poses(peer_id, unpack_pose_dict(pf, "pose"),
+                                sequence=seq)
+    if robust:
+        wd = {}
+        for k, v in pf.items():
+            if k.startswith("wt_"):
+                _, r1, p1, r2, p2 = k.split("_")
+                wd[((int(r1), int(p1)), (int(r2), int(p2)))] = float(v)
+        if wd:
+            agent.update_shared_weights(wd)
+    if accept_anchor and "anchor" in pf:
+        agent.set_global_anchor(pf["anchor"])

@@ -1,0 +1,148 @@
+"""Wire format: length-prefixed ``npz`` frames (arrays only — no pickle).
+
+A frame on the wire is an 8-byte little-endian unsigned length followed by
+an ``np.savez`` archive.  The length header is *untrusted input*: it is
+validated against a configurable cap (default 64 MiB) before any buffer is
+sized from it, so a corrupt or malicious header raises a clean
+``ProtocolError`` instead of attempting an OOM-sized allocation.  Payload
+decoding likewise wraps ``np.load`` failures (bit-flipped archives) in
+``ProtocolError`` so the fault-tolerance layer can count and drop corrupt
+frames rather than crash the robot.
+
+``FrameAssembler`` is the incremental decoder used by the deadline-aware
+TCP transport: bytes are fed in as they arrive, complete payloads come out,
+and a recv deadline can interrupt mid-frame and resume later without
+desynchronizing the stream.
+"""
+
+from __future__ import annotations
+
+import io
+import socket
+import struct
+
+import numpy as np
+
+HEADER = struct.Struct("<Q")
+DEFAULT_MAX_FRAME_BYTES = 64 * 2 ** 20  # 64 MiB
+
+
+class ProtocolError(Exception):
+    """The byte stream violates the frame protocol (oversized length
+    header, truncated/corrupt npz payload).  Distinct from transport errors:
+    the connection may still be usable — the *frame* is bad."""
+
+
+def encode_payload(arrays: dict) -> bytes:
+    """Serialize an array dict to npz bytes (the frame body, no header)."""
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def decode_payload(data: bytes) -> dict:
+    """Decode npz bytes; a mangled archive raises ``ProtocolError``."""
+    try:
+        with np.load(io.BytesIO(data)) as npz:
+            return {k: npz[k] for k in npz.files}
+    except Exception as e:  # zipfile/np.load raise a zoo of types
+        raise ProtocolError(f"corrupt frame payload ({len(data)} bytes): "
+                            f"{e}") from e
+
+
+def encode_frame(arrays: dict) -> bytes:
+    data = encode_payload(arrays)
+    return HEADER.pack(len(data)) + data
+
+
+class FrameAssembler:
+    """Incremental length-prefixed frame decoder with a size cap.
+
+    Feed raw bytes as they arrive; completed payloads (undecoded npz bytes)
+    come out.  State survives across calls, so a transport can stop reading
+    at a deadline mid-frame and resume on the next ``recv``.
+    """
+
+    def __init__(self, max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+        self.max_frame_bytes = int(max_frame_bytes)
+        self._buf = bytearray()
+        self._length: int | None = None
+
+    def feed(self, data: bytes) -> list[bytes]:
+        self._buf.extend(data)
+        out = []
+        while True:
+            if self._length is None:
+                if len(self._buf) < HEADER.size:
+                    break
+                (length,) = HEADER.unpack(bytes(self._buf[:HEADER.size]))
+                if length > self.max_frame_bytes:
+                    raise ProtocolError(
+                        f"frame length header {length} exceeds the "
+                        f"{self.max_frame_bytes}-byte cap (corrupt or "
+                        "malicious peer?)")
+                del self._buf[:HEADER.size]
+                self._length = int(length)
+            if len(self._buf) < self._length:
+                break
+            out.append(bytes(self._buf[:self._length]))
+            del self._buf[:self._length]
+            self._length = None
+        return out
+
+    @property
+    def pending_bytes(self) -> int:
+        """Bytes buffered toward an incomplete frame."""
+        return len(self._buf)
+
+
+# ---------------------------------------------------------------------------
+# Blocking socket helpers (the original example wire functions, now capped)
+# ---------------------------------------------------------------------------
+
+def send_frame(sock: socket.socket, arrays: dict) -> int:
+    """Send one frame; returns bytes put on the wire."""
+    frame = encode_frame(arrays)
+    sock.sendall(frame)
+    return len(frame)
+
+
+def recv_frame(sock: socket.socket,
+               max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES) -> dict:
+    """Blocking receive of one frame, header validated against the cap."""
+
+    def recv_exact(k):
+        chunks = []
+        while k:
+            c = sock.recv(k)
+            if not c:
+                raise ConnectionError("peer closed")
+            chunks.append(c)
+            k -= len(c)
+        return b"".join(chunks)
+
+    (length,) = HEADER.unpack(recv_exact(HEADER.size))
+    if length > max_frame_bytes:
+        raise ProtocolError(
+            f"frame length header {length} exceeds the "
+            f"{max_frame_bytes}-byte cap (corrupt or malicious peer?)")
+    return decode_payload(recv_exact(int(length)))
+
+
+# ---------------------------------------------------------------------------
+# Pose-dictionary packing (the agent message vocabulary on the wire)
+# ---------------------------------------------------------------------------
+
+def pack_pose_dict(prefix: str, pose_dict: dict) -> dict:
+    """Flatten {(robot, pose): block} to npz-safe ``{prefix}_{r}_{p}`` keys."""
+    return {f"{prefix}_{r}_{p}": np.asarray(block)
+            for (r, p), block in pose_dict.items()}
+
+
+def unpack_pose_dict(frame: dict, prefix: str) -> dict:
+    out = {}
+    for key, arr in frame.items():
+        if key.startswith(prefix + "_"):
+            _, r, p = key.rsplit("_", 2)
+            out[(int(r), int(p))] = arr
+    return out

@@ -108,6 +108,32 @@ def render_report(run_dir: str) -> str:
         if not any_traj:
             lines.append("  (no metric events)")
 
+        # Network health: the comms layer's terminal run_summary events
+        # (one per channel, plus the bus's aggregate) and peer-loss story.
+        summaries = [ev for ev in events if ev.get("event") == "run_summary"]
+        if summaries:
+            lines.append("network health (comms):")
+            for ev in summaries:
+                parts = [f"{ev.get('messages_received', 0)} in / "
+                         f"{ev.get('messages_sent', 0)} out"]
+                for key, label in (("retries", "retries"),
+                                   ("timeouts", "timeouts"),
+                                   ("stale_dropped", "stale"),
+                                   ("corrupt_dropped", "corrupt")):
+                    if ev.get(key):
+                        parts.append(f"{ev[key]} {label}")
+                if ev.get("peers_lost"):
+                    parts.append(f"peers lost {ev['peers_lost']}")
+                lines.append(f"  {ev.get('channel', '?')}: "
+                             + ", ".join(parts))
+        losses = [ev for ev in events if ev.get("event") == "peer_lost"]
+        if losses:
+            for ev in losses:
+                where = (f"robot {ev['robot']}" if "robot" in ev else "bus")
+                why = f" ({ev['reason']})" if ev.get("reason") else ""
+                lines.append(f"  peer_lost: {where} lost peer "
+                             f"{ev.get('peer')}{why}")
+
         timers = [ev for ev in events if ev.get("event") == "phase_timings"]
         if timers:
             lines.append("phase timings (last snapshot):")

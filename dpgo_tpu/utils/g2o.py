@@ -55,6 +55,48 @@ def read_g2o(path: str, backend: str = "auto") -> Measurements:
     return read_g2o_python(path)
 
 
+def write_g2o(meas: Measurements, path: str) -> None:
+    """Write ``Measurements`` as a standard g2o edge list (the inverse of
+    ``read_g2o`` for single-robot/global indexing).
+
+    Precisions round-trip exactly through the reader's
+    information-divergence formulas: the translation info block is
+    ``tau * I`` and the rotation block ``2 * kappa * I`` (SE(3)) /
+    ``I33 = kappa`` (SE(2)).  Edge weights and known-inlier flags have no
+    g2o representation and are dropped.  Lets tests and demos synthesize
+    datasets for the file-driven deployment examples without an external
+    dataset directory.
+    """
+    from .lie import rotation_to_quat
+
+    r1 = np.asarray(meas.r1)
+    r2 = np.asarray(meas.r2)
+    if (r1 != 0).any() or (r2 != 0).any():
+        raise ValueError("write_g2o expects global (single-robot) indexing; "
+                         "partition after reading back instead")
+    with open(path, "w") as fh:
+        for k in range(len(meas)):
+            i, j = int(meas.p1[k]), int(meas.p2[k])
+            t = np.asarray(meas.t[k], np.float64)
+            tau = float(meas.tau[k])
+            kappa = float(meas.kappa[k])
+            if meas.d == 3:
+                q = np.asarray(rotation_to_quat(np.asarray(meas.R[k])))
+                c = 2.0 * kappa
+                info = [tau, 0, 0, 0, 0, 0, tau, 0, 0, 0, 0, tau, 0, 0, 0,
+                        c, 0, 0, c, 0, c]
+                vals = [*t, *q]
+                tag = "EDGE_SE3:QUAT"
+            else:
+                theta = float(np.arctan2(meas.R[k][1, 0], meas.R[k][0, 0]))
+                info = [tau, 0, 0, tau, 0, kappa]
+                vals = [*t, theta]
+                tag = "EDGE_SE2"
+            fh.write(f"{tag} {i} {j} "
+                     + " ".join(repr(float(v)) for v in [*vals, *info])
+                     + "\n")
+
+
 def read_g2o_python(path: str) -> Measurements:
     """Pure-Python (vectorized numpy) g2o parser — the portable fallback.
 

@@ -1,18 +1,26 @@
 #!/usr/bin/env python
-"""Asynchronous multi-robot deployment demo — the RA-L 2020 operating mode.
+"""Asynchronous multi-robot deployment demo — the RA-L 2020 operating mode,
+on the fault-tolerant comms subsystem.
 
 Each robot is a ``PGOAgent`` with its own Poisson-clock optimization thread
-(``start_optimization_loop``, the analog of reference
-``PGOAgent.cpp:861-916``), while this driver plays the network the way the
-external ``dpgo_ros`` wrapper does in the reference's deployments: it
-periodically shuttles public-pose dictionaries and gossiped statuses
-between agents until team consensus (``should_terminate``).  No global
-barrier — every agent fires on its own clock against whatever neighbor
-poses it last received.
+(``start_optimization_loop``), while the network is an in-process
+``dpgo_tpu.comms`` fleet: every robot talks to a ``RoundBus`` hub over a
+``LoopbackTransport`` pair through a ``ReliableChannel`` (deadlines,
+sequence numbers, stale-frame drops), exactly the stack the TCP example
+runs over sockets.  No global barrier — agents fire on their own clocks
+against whatever neighbor poses last arrived, which is precisely the
+regime the RA-L 2020 convergence result covers.
+
+Faults are injectable (seeded drop / delay / reorder / corrupt), and a
+robot can be killed mid-run (``--kill-robot R --kill-at T``): the bus
+detects the closed transport, announces it, survivors freeze its cached
+poses, exclude it from the termination quorum, and still reach consensus.
 
 Usage:
     python examples/async_deployment_example.py NUM_ROBOTS DATASET.g2o
         [--rate-hz 20] [--comm-hz 10] [--timeout 30] [--log-dir DIR]
+        [--fault-drop P] [--fault-delay P] [--fault-seed N]
+        [--kill-robot R --kill-at T]
 """
 
 from __future__ import annotations
@@ -20,7 +28,10 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import threading
 import time
+
+import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from _common import setup_jax  # noqa: E402
@@ -38,13 +49,29 @@ def main() -> None:
     ap.add_argument("--timeout", type=float, default=30.0,
                     help="wall-clock budget in seconds")
     ap.add_argument("--log-dir", default=None)
+    ap.add_argument("--fault-drop", type=float, default=0.0)
+    ap.add_argument("--fault-delay", type=float, default=0.0)
+    ap.add_argument("--fault-delay-s", type=float, nargs=2,
+                    default=[0.05, 0.3], metavar=("MIN", "MAX"))
+    ap.add_argument("--fault-reorder", type=float, default=0.0)
+    ap.add_argument("--fault-corrupt", type=float, default=0.0)
+    ap.add_argument("--fault-seed", type=int, default=0)
+    ap.add_argument("--kill-robot", type=int, default=None,
+                    help="kill this robot's comms + optimizer mid-run")
+    ap.add_argument("--kill-at", type=float, default=None,
+                    help="seconds into the run at which --kill-robot dies")
     args = ap.parse_args()
     if args.rate_hz <= 0 or args.comm_hz <= 0:
         ap.error("--rate-hz and --comm-hz must be positive")
+    if args.kill_robot is not None and args.kill_at is None:
+        ap.error("--kill-robot requires --kill-at")
 
     setup_jax()
 
     from dpgo_tpu.agent import PGOAgent
+    from dpgo_tpu.comms import (FaultInjector, FaultSpec, RetryPolicy,
+                                TransportClosed, apply_peer_frame,
+                                loopback_fleet, pack_agent_frame)
     from dpgo_tpu.config import AgentParams
     from dpgo_tpu.utils.g2o import read_g2o
     from dpgo_tpu.utils.partition import agent_measurements, \
@@ -66,51 +93,104 @@ def main() -> None:
     for ag in agents:
         ag.set_pose_graph(*agent_measurements(part, ag.robot_id))
 
-    def shuttle():
-        """One network tick: all-to-all pose + status gossip and the
-        global-anchor broadcast (what dpgo_ros pub/sub carries)."""
-        dicts = [ag.get_shared_pose_dict() for ag in agents]
-        stats = [ag.get_status() for ag in agents]
-        anchor = agents[0].get_global_anchor()
-        for dst in agents:
-            for src_id in range(args.num_robots):
-                if src_id != dst.robot_id:
-                    dst.update_neighbor_poses(src_id, dicts[src_id])
-                    dst.set_neighbor_status(stats[src_id])
-            if anchor is not None:
-                dst.set_global_anchor(anchor)
+    spec = FaultSpec(drop=args.fault_drop, delay=args.fault_delay,
+                     delay_s=tuple(args.fault_delay_s),
+                     reorder=args.fault_reorder, corrupt=args.fault_corrupt)
+    injector = FaultInjector(spec, seed=args.fault_seed) \
+        if spec.any_active() else None
+    tick = 1.0 / args.comm_hz
+    policy = RetryPolicy(send_timeout_s=tick, recv_timeout_s=2 * tick)
+    bus, clients = loopback_fleet(
+        args.num_robots, injector=injector, policy=policy,
+        round_timeout_s=2 * tick, miss_limit=5,
+        liveness_timeout_s=max(1.0, 10 * tick))
+    stop = threading.Event()
 
-    # Initialization messages flow over the same network as everything else;
-    # agents enter INITIALIZED as robust frame alignment succeeds.
-    shuttle()
+    def bus_loop():
+        while not stop.is_set():
+            bus.round()
+        # One last broadcast flushes pending `_lost` knowledge.
+
+    def robot_loop(ag: PGOAgent):
+        """One network tick per iteration: publish status + public poses,
+        collect the broadcast, ingest peers (sequence-checked), track lost
+        robots.  A missed broadcast skips one update — never a hang."""
+        rid = ag.robot_id
+        client = clients[rid]
+        client.channel.start_heartbeat(tick / 2)
+        while not stop.is_set():
+            frame = pack_agent_frame(ag, include_anchor=(rid == 0))
+            try:
+                client.publish(frame, timeout=tick)
+                merged = client.collect(timeout=2 * tick)
+            except TransportClosed:
+                return  # killed, or the run is over
+            if merged is not None:
+                for peer, pf in client.peer_frames(merged).items():
+                    apply_peer_frame(ag, peer, pf,
+                                     accept_anchor=(rid != 0 and peer == 0))
+                for lost in client.lost:
+                    ag.mark_neighbor_lost(lost)
+            time.sleep(tick)
+
+    threads = [threading.Thread(target=bus_loop, daemon=True)]
+    threads += [threading.Thread(target=robot_loop, args=(ag,), daemon=True)
+                for ag in agents]
+    for t in threads:
+        t.start()
     for ag in agents:
         ag.start_optimization_loop(rate_hz=args.rate_hz)
     print(f"{args.num_robots} agents optimizing asynchronously at "
-          f"~{args.rate_hz} Hz, network at {args.comm_hz} Hz")
+          f"~{args.rate_hz} Hz, network at {args.comm_hz} Hz"
+          + (", faults live" if injector is not None else ""))
 
+    killed: set[int] = set()
     t0 = time.perf_counter()
     try:
         while time.perf_counter() - t0 < args.timeout:
-            time.sleep(1.0 / args.comm_hz)
-            shuttle()
-            if all(ag.get_status().ready_to_terminate for ag in agents) and \
-                    agents[0].should_terminate():
-                print("Team consensus reached.")
+            time.sleep(tick)
+            now = time.perf_counter() - t0
+            if (args.kill_robot is not None and now >= args.kill_at
+                    and args.kill_robot not in killed):
+                rid = args.kill_robot
+                killed.add(rid)
+                agents[rid].end_optimization_loop()
+                clients[rid].close()  # the bus sees a dead transport
+                print(f"[{now:5.1f}s] robot {rid} killed")
+            live = [ag for ag in agents if ag.robot_id not in killed]
+            if all(ag.get_status().ready_to_terminate for ag in live) and \
+                    live[0].should_terminate():
+                print("Team consensus reached"
+                      + (f" (without robot(s) {sorted(killed)})" if killed
+                         else "") + ".")
                 break
     finally:
+        stop.set()
         for ag in agents:
             ag.end_optimization_loop()
+        for t in threads:
+            t.join(timeout=5)
+        bus.close()
+        for c in clients.values():
+            c.close()
 
     dt = time.perf_counter() - t0
     iters = [ag.get_status().iteration_number for ag in agents]
     costs = [ag.local_cost() for ag in agents]
+    totals = bus.totals()
     print(f"Stopped after {dt:.1f}s; per-agent iterations {iters} "
           f"(no barrier — counts differ by design)")
     print("Per-agent local costs:",
           [f"{c:.3f}" if c is not None else "n/a" for c in costs])
+    print(f"Bus: {totals.messages_received} frames in / "
+          f"{totals.messages_sent} out, {totals.timeouts} timeouts, "
+          f"{totals.stale_dropped} stale dropped, "
+          f"{totals.corrupt_dropped} corrupt dropped; "
+          f"lost robots {sorted(bus.lost)}")
     if args.log_dir:
         for ag in agents:
-            ag.log_trajectory()
+            if ag.robot_id not in killed:
+                ag.log_trajectory()
         print(f"Per-robot dumps under {args.log_dir}/robot*/")
 
 

@@ -1,46 +1,55 @@
 #!/usr/bin/env python
-"""N-process TCP deployment demo: the message vocabulary over a real wire.
+"""N-process TCP deployment demo on the fault-tolerant comms subsystem.
 
-The reference defers multi-process transport to the external ``dpgo_ros``
-wrapper (``/root/reference/README.md:40-42``); the in-repo demos (ours and
-the reference's) drive agents in one process.  This example goes further
-than the reference's in-repo story: each robot is its own OS process
-holding one ``PGOAgent``, and the deployment message set —
-``get_shared_pose_dict`` / ``update_neighbor_poses``, status gossip, GNC
-weight publication (``get_shared_weight_dict`` /
-``update_shared_weights``), lifting-matrix and global-anchor broadcast —
-travels over localhost TCP as length-prefixed ``npz`` frames.  The
-launcher doubles as the message bus (the pub/sub role dpgo_ros plays):
-it accepts one connection per robot and re-broadcasts every round's
-frames to all peers, so the same code runs 2 robots or N.
+Each robot is its own OS process holding one ``PGOAgent``; the deployment
+message set — ``get_shared_pose_dict`` / ``update_neighbor_poses``, status
+gossip, GNC weight publication, lifting-matrix and global-anchor broadcast
+— travels over localhost TCP as length-prefixed ``npz`` frames.  The
+launcher doubles as the message bus (the pub/sub role dpgo_ros plays in
+the reference's deployments).
+
+Unlike the original ad-hoc wire code, everything here rides
+``dpgo_tpu.comms``: per-message deadlines, bounded retry with backoff,
+sequence numbers (stale/reordered pose frames are dropped, never applied),
+heartbeat liveness, and graceful degradation — a robot that dies mid-solve
+is detected by the bus (closed transport or heartbeat silence), announced
+to the survivors via the ``_lost`` broadcast key, excluded from the
+``should_terminate`` quorum (``PGOAgent.mark_neighbor_lost``), and the
+remaining team finishes.  Its last published poses stay frozen in every
+survivor's neighbor cache (the RA-L 2020 delay-tolerance model).
 
 Modes:
 
 * ``--mode sync`` (default): each robot takes one ``iterate()`` per bus
-  round — the deterministic in-process loop of
-  ``examples/MultiRobotExample.cpp`` stretched over processes.
-* ``--mode async``: each robot runs its Poisson-clock optimization
-  thread (``start_optimization_loop``, reference ``PGOAgent.cpp:861-898``)
-  while the main thread exchanges poses at the bus cadence — the RA-L
-  2020 deployment model: iteration and communication fully decoupled.
+  round.  With no faults injected the schedule is deterministic (the bus
+  waits ``--round-timeout`` for every live robot, so lockstep is
+  preserved).
+* ``--mode async``: each robot runs its Poisson-clock optimization thread
+  (``start_optimization_loop``) while the main thread exchanges poses at
+  the bus cadence — iteration and communication fully decoupled.
+
+Fault injection (seeded, deterministic per link) for chaos demos:
+    python examples/tcp_deployment_example.py DATA.g2o --robots 3 \
+        --rounds 60 --fault-drop 0.1 --fault-delay 0.2 \
+        --fault-delay-s 0.05 0.2 --fault-seed 7 --round-timeout 2 \
+        --kill-robot 2 --kill-round 40
 
 Usage (launcher spawns all robot processes and assembles the result):
     python examples/tcp_deployment_example.py DATASET.g2o \
         [--robots 2] [--rank 5] [--rounds 120] [--mode sync|async] \
-        [--robust] [--port 0] [--out-dir DIR]
+        [--robust] [--port 0] [--out-dir DIR] [--telemetry]
 
-Internal per-robot entry (what the launcher spawns):
+Internal per-robot entry (what the launcher spawns; the launcher binds the
+listener FIRST and passes the resolved port down — there is no ephemeral-
+port race and no port file):
     ... --robot ID --port P
 """
 
 from __future__ import annotations
 
 import argparse
-import io
 import json
 import os
-import socket
-import struct
 import sys
 import tempfile
 import time
@@ -51,202 +60,147 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from _common import setup_jax  # noqa: E402
 
 
-# ---------------------------------------------------------------------------
-# Wire format: length-prefixed npz frames (arrays only — no pickle)
-# ---------------------------------------------------------------------------
+def make_injector(args, seed_offset: int):
+    """Build the (initially disabled) per-process fault injector, or None
+    when no fault flag is set.  The lifting-matrix broadcast and the final
+    anchor sync always run clean; faults cover only solve rounds."""
+    from dpgo_tpu.comms import FaultInjector, FaultSpec
 
-def send_frame(sock: socket.socket, arrays: dict) -> int:
-    buf = io.BytesIO()
-    np.savez(buf, **arrays)
-    data = buf.getvalue()
-    sock.sendall(struct.pack("<Q", len(data)) + data)
-    return 8 + len(data)
-
-
-def recv_frame(sock: socket.socket) -> dict:
-    def recv_exact(k):
-        chunks = []
-        while k:
-            c = sock.recv(k)
-            if not c:
-                raise ConnectionError("peer closed")
-            chunks.append(c)
-            k -= len(c)
-        return b"".join(chunks)
-
-    (length,) = struct.unpack("<Q", recv_exact(8))
-    return dict(np.load(io.BytesIO(recv_exact(length))))
-
-
-def pack_pose_dict(prefix: str, pose_dict: dict) -> dict:
-    return {f"{prefix}_{r}_{p}": np.asarray(block)
-            for (r, p), block in pose_dict.items()}
-
-
-def unpack_pose_dict(frame: dict, prefix: str) -> dict:
-    out = {}
-    for key, arr in frame.items():
-        if key.startswith(prefix + "_"):
-            _, r, p = key.rsplit("_", 2)
-            out[(int(r), int(p))] = arr
-    return out
+    spec = FaultSpec(drop=args.fault_drop, delay=args.fault_delay,
+                     delay_s=tuple(args.fault_delay_s),
+                     reorder=args.fault_reorder, corrupt=args.fault_corrupt)
+    if not spec.any_active():
+        return None
+    inj = FaultInjector(spec, seed=args.fault_seed + seed_offset)
+    inj.enabled = False
+    return inj
 
 
 # ---------------------------------------------------------------------------
 # One robot process
 # ---------------------------------------------------------------------------
 
-def _dial_bus(robot_id: int, port: int, out_dir: str) -> socket.socket:
-    """Connect to the launcher's bus; with ``port`` 0 the OS-assigned
-    choice is read from out_dir/port.txt (published atomically by the
-    launcher after binding — no pick-then-rebind TOCTOU window)."""
-    port_file = os.path.join(out_dir, "port.txt")
-    dial = port
-    for _ in range(100):
-        if port == 0:
-            # Re-read every attempt: a stale file from a previous run may
-            # be consumed before this run's launcher republishes.
-            try:
-                with open(port_file) as fh:
-                    dial = int(fh.read())
-            except (FileNotFoundError, ValueError):
-                time.sleep(0.1)
-                continue
-        try:
-            conn = socket.create_connection(("127.0.0.1", dial))
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            send_frame(conn, {"hello": np.asarray(robot_id, np.int64)})
-            return conn
-        except ConnectionRefusedError:
-            time.sleep(0.1)
-    where = f"port {dial}" if dial else f"port file {port_file}"
-    raise ConnectionError(f"robot {robot_id} could not reach the bus "
-                          f"({where})")
-
-
-def run_robot(robot_id: int, dataset: str, num_robots: int, rank: int,
-              rounds: int, port: int, out_dir: str, mode: str,
-              robust: bool, async_rate: float,
-              telemetry: bool = False) -> None:
+def run_robot(args) -> None:
     setup_jax()
     from dpgo_tpu import obs
-    from dpgo_tpu.agent import AgentState, PGOAgent, PGOAgentStatus
+    from dpgo_tpu.agent import PGOAgent
+    from dpgo_tpu.comms import (BusClient, ReliableChannel, RetryPolicy,
+                                TcpTransport, TransportClosed,
+                                apply_peer_frame, connect_tcp,
+                                pack_agent_frame)
     from dpgo_tpu.config import AgentParams, RobustCostParams, RobustCostType
     from dpgo_tpu.utils.g2o import read_g2o
     from dpgo_tpu.utils.partition import agent_measurements, \
         partition_contiguous
 
-    # Each robot process scopes its own telemetry run (one run dir per
-    # robot, like the reference's one-logDirectory-per-process layout);
-    # once ambient, the PGOAgent hot paths (iterate latency, per-neighbor
-    # comms bytes, GNC weight updates) record into it automatically.
-    run = obs.start_run(
-        os.path.join(out_dir, "telemetry", f"robot{robot_id}")) \
-        if telemetry else None
+    rid, rounds, mode, robust = args.robot, args.rounds, args.mode, args.robust
+    out_dir = args.out_dir
 
-    meas = read_g2o(dataset)
+    # Each robot process scopes its own telemetry run (one run dir per
+    # robot, like the reference's one-logDirectory-per-process layout).
+    run = obs.start_run(
+        os.path.join(out_dir, "telemetry", f"robot{rid}")) \
+        if args.telemetry else None
+
+    meas = read_g2o(args.dataset)
     rp = RobustCostParams(cost_type=RobustCostType.GNC_TLS) if robust \
         else RobustCostParams()
-    params = AgentParams(d=meas.d, r=rank, num_robots=num_robots, robust=rp)
-    part = partition_contiguous(meas, num_robots)
-    agent = PGOAgent(robot_id, params)
+    params = AgentParams(d=meas.d, r=args.rank, num_robots=args.robots,
+                         robust=rp)
+    part = partition_contiguous(meas, args.robots)
+    agent = PGOAgent(rid, params)
 
-    conn = _dial_bus(robot_id, port, out_dir)
+    injector = make_injector(args, seed_offset=rid)
+    sock = connect_tcp("127.0.0.1", args.port)
+    transport = TcpTransport(sock, src=f"robot{rid}", dst="bus",
+                             injector=injector)
+    policy = RetryPolicy(send_timeout_s=args.round_timeout,
+                         recv_timeout_s=args.round_timeout)
+    client = BusClient(ReliableChannel(transport, f"robot{rid}->bus",
+                                       policy), rid)
+    client.hello(timeout=30.0)
+    client.channel.start_heartbeat(args.heartbeat_s)
 
     # Lifting-matrix broadcast (robot 0 self-generates; reference
-    # MultiRobotExample.cpp:139-146) — rides the first bus round.
-    if robot_id == 0:
-        first = {"ylift": agent.get_lifting_matrix()}
-    else:
-        first = {}
-    send_frame(conn, first)
-    merged = recv_frame(conn)
-    if robot_id != 0:
+    # MultiRobotExample.cpp:139-146) — rides the first bus round, clean.
+    first = {"ylift": agent.get_lifting_matrix()} if rid == 0 else {}
+    merged = client.exchange(first, timeout=60.0)
+    for _ in range(3):
+        if rid == 0 or (merged is not None and "r0|ylift" in merged):
+            break
+        merged = client.collect(timeout=60.0)
+    if rid != 0:
+        if merged is None or "r0|ylift" not in merged:
+            raise ConnectionError(f"robot {rid}: lifting matrix never "
+                                  "arrived")
         agent.set_lifting_matrix(merged["r0|ylift"])
-    agent.set_pose_graph(*agent_measurements(part, robot_id))
+    agent.set_pose_graph(*agent_measurements(part, rid))
 
     if mode == "async":
-        agent.start_optimization_loop(rate_hz=async_rate)
+        agent.start_optimization_loop(rate_hz=args.async_rate)
 
-    bytes_sent = 0
+    if injector is not None:
+        injector.enabled = True
+    bus_gone = False
     for it in range(rounds):
-        st = agent.get_status()
-        frame = {"status": np.asarray(
-            [st.robot_id, st.state.value, st.instance_number,
-             st.iteration_number, int(st.ready_to_terminate)], np.int64),
-            "relchange": np.asarray(st.relative_change, np.float64)}
-        frame.update(pack_pose_dict("pose", agent.get_shared_pose_dict()))
-        if robust:
-            # GNC weight publication (reference mPublishWeightsRequested,
-            # consumed by dpgo_ros): owner pushes shared-edge weights.
-            wd = agent.get_shared_weight_dict()
-            frame.update({
-                f"wt_{r1}_{p1}_{r2}_{p2}": np.asarray(w, np.float64)
-                for ((r1, p1), (r2, p2)), w in wd.items()})
-        if robot_id == 0:
-            anchor = agent.get_global_anchor()
-            if anchor is not None:
-                frame["anchor"] = np.asarray(anchor)
-        bytes_sent += send_frame(conn, frame)
-        merged = recv_frame(conn)  # bus barrier: everyone's round frames
-
-        for peer in range(num_robots):
-            if peer == robot_id:
-                continue
-            pf = {k.split("|", 1)[1]: v for k, v in merged.items()
-                  if k.startswith(f"r{peer}|")}
-            if not pf:
-                continue
-            ps = pf["status"]
-            agent.set_neighbor_status(PGOAgentStatus(
-                robot_id=int(ps[0]), state=AgentState(int(ps[1])),
-                instance_number=int(ps[2]), iteration_number=int(ps[3]),
-                ready_to_terminate=bool(ps[4]),
-                relative_change=float(pf["relchange"])))
-            agent.update_neighbor_poses(peer, unpack_pose_dict(pf, "pose"))
-            if robust:
-                wd = {}
-                for k, v in pf.items():
-                    if k.startswith("wt_"):
-                        _, r1, p1, r2, p2 = k.split("_")
-                        wd[((int(r1), int(p1)), (int(r2), int(p2)))] = \
-                            float(v)
-                if wd:
-                    agent.update_shared_weights(wd)
-            if robot_id != 0 and "anchor" in pf and peer == 0:
-                agent.set_global_anchor(pf["anchor"])
-
+        if args.die_at_round is not None and it == args.die_at_round:
+            # Simulated mid-solve crash: drop the connection, write no
+            # result.  The bus detects the closed transport, announces us
+            # in `_lost`, and the survivors finish without us.
+            if mode == "async":
+                agent.end_optimization_loop()
+            client.close()
+            return
+        frame = pack_agent_frame(agent, robust=robust,
+                                 include_anchor=(rid == 0))
+        try:
+            client.publish(frame, timeout=args.round_timeout)
+            merged = client.collect(timeout=args.round_timeout)
+        except TransportClosed:
+            bus_gone = True  # keep the local result; stop exchanging
+            break
+        if merged is not None:
+            for peer, pf in client.peer_frames(merged).items():
+                apply_peer_frame(agent, peer, pf, robust=robust,
+                                 accept_anchor=(rid != 0 and peer == 0))
+            for lost in client.lost:
+                agent.mark_neighbor_lost(lost)
         if mode == "sync":
             agent.iterate(do_optimization=True)
         else:
-            time.sleep(1.0 / async_rate)
+            time.sleep(1.0 / args.async_rate)
+    if injector is not None:
+        injector.enabled = False
 
     if mode == "async":
         agent.end_optimization_loop()
 
-    # Final anchor sync so all trajectories live in the same frame.
-    if robot_id == 0:
-        send_frame(conn, {"anchor": np.asarray(agent.get_global_anchor())})
-    else:
-        send_frame(conn, {})
-    merged = recv_frame(conn)
-    if robot_id != 0:
-        agent.set_global_anchor(merged["r0|anchor"])
-    conn.close()
+    # Final anchor sync (clean) so all trajectories share one frame; a
+    # survivor of a dead robot 0 falls back to the last anchor it cached.
+    if not bus_gone:
+        try:
+            final = {"anchor": np.asarray(agent.get_global_anchor())} \
+                if rid == 0 else {}
+            merged = client.exchange(final, timeout=60.0)
+            if rid != 0 and merged is not None and "r0|anchor" in merged:
+                agent.set_global_anchor(merged["r0|anchor"])
+        except TransportClosed:
+            pass
+    client.close()  # emits the comms run_summary into the ambient run
 
     st = agent.get_status()
-    np.savez(os.path.join(out_dir, f"robot{robot_id}.npz"),
+    np.savez(os.path.join(out_dir, f"robot{rid}.npz"),
              T=agent.trajectory_in_global_frame(),
              state=np.asarray(st.state.value),
              iterations=np.asarray(st.iteration_number),
-             bytes_sent=np.asarray(bytes_sent))
+             bytes_sent=np.asarray(client.channel.totals.bytes_sent),
+             lost=np.asarray(sorted(client.lost), np.int64))
     if run is not None:
-        # Wire-level bytes (length-prefixed npz frames) — the real transport
-        # cost, alongside the payload bytes the agent hooks counted.
-        run.metric("tcp_bytes_sent", bytes_sent, "bytes", phase="report",
-                   robot=robot_id, rounds=rounds, mode=mode)
-        run.metric("agent_final_iterations", st.iteration_number, phase="report",
-                   robot=robot_id)
+        t = client.channel.totals
+        run.metric("tcp_bytes_sent", t.bytes_sent, "bytes", phase="report",
+                   robot=rid, rounds=rounds, mode=mode)
+        run.metric("agent_final_iterations", st.iteration_number,
+                   phase="report", robot=rid)
         obs.end_run()
 
 
@@ -254,72 +208,76 @@ def run_robot(robot_id: int, dataset: str, num_robots: int, rank: int,
 # Launcher: bind the bus, spawn robots, relay rounds, assemble, report
 # ---------------------------------------------------------------------------
 
-def serve_bus(srv: socket.socket, num_robots: int, total_rounds: int):
-    """Accept one connection per robot and relay ``total_rounds`` rounds:
-    collect one frame from every robot, then broadcast the union (keys
-    namespaced ``r{id}|...``) to all — the pub/sub role the reference
-    delegates to dpgo_ros."""
-    conns: dict[int, socket.socket] = {}
-    while len(conns) < num_robots:
-        c, _ = srv.accept()
-        c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        hello = recv_frame(c)
-        conns[int(hello["hello"])] = c
-    for _ in range(total_rounds):
-        merged = {}
-        for rid in sorted(conns):
-            frame = recv_frame(conns[rid])
-            merged.update({f"r{rid}|{k}": v for k, v in frame.items()})
-        # Serialize once, broadcast the same bytes — np.savez per robot
-        # would be O(N^2) redundant CPU per round.
-        buf = io.BytesIO()
-        np.savez(buf, **merged)
-        data = struct.pack("<Q", buf.getbuffer().nbytes) + buf.getvalue()
-        for rid in sorted(conns):
-            conns[rid].sendall(data)
-    for c in conns.values():
-        c.close()
-
-
 def launch(args) -> int:
     import subprocess
     import threading
 
+    from dpgo_tpu import obs
+    from dpgo_tpu.comms import RetryPolicy, RoundBus, listen_tcp
+    from dpgo_tpu.comms.bus import accept_robots
+
     out_dir = args.out_dir or tempfile.mkdtemp(prefix="dpgo_tcp_")
     os.makedirs(out_dir, exist_ok=True)
-    port_file = os.path.join(out_dir, "port.txt")
-    if os.path.exists(port_file):  # reused --out-dir: drop the stale one
-        os.unlink(port_file)
 
-    # Bind FIRST (port 0 = OS-assigned), then publish atomically — no
-    # pick-then-rebind TOCTOU window for another process to steal it.
-    srv = socket.create_server(("127.0.0.1", args.port))
+    # Bind FIRST (port 0 = OS-assigned), then pass the RESOLVED port down
+    # on each robot's command line — no ephemeral-port race, no port file.
+    srv = listen_tcp(port=args.port)
     port = srv.getsockname()[1]
-    tmp = port_file + ".tmp"
-    with open(tmp, "w") as fh:
-        fh.write(str(port))
-    os.replace(tmp, port_file)
 
-    # ylift round + solve rounds + final anchor round
-    bus = threading.Thread(target=serve_bus,
-                           args=(srv, args.robots, args.rounds + 2),
-                           daemon=True)
-    bus.start()
+    run = obs.start_run(os.path.join(out_dir, "telemetry", "bus")) \
+        if args.telemetry else None
 
     # Robot processes always run on CPU unless told otherwise: N python
     # processes cannot share the single tunneled-TPU grant (they would
     # deadlock at backend init), and the per-agent problems are tiny.
     child_env = dict(os.environ,
                      DPGO_PLATFORM=os.environ.get("DPGO_PLATFORM", "cpu"))
-    procs = [subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), args.dataset,
-         "--robot", str(rid), "--robots", str(args.robots),
-         "--port", str(port), "--rank", str(args.rank),
-         "--rounds", str(args.rounds), "--mode", args.mode,
-         "--async-rate", str(args.async_rate), "--out-dir", out_dir]
-        + (["--robust"] if args.robust else [])
-        + (["--telemetry"] if args.telemetry else []),
-        env=child_env) for rid in range(args.robots)]
+    procs = []
+    for rid in range(args.robots):
+        cmd = [sys.executable, os.path.abspath(__file__), args.dataset,
+               "--robot", str(rid), "--robots", str(args.robots),
+               "--port", str(port), "--rank", str(args.rank),
+               "--rounds", str(args.rounds), "--mode", args.mode,
+               "--async-rate", str(args.async_rate), "--out-dir", out_dir,
+               "--round-timeout", str(args.round_timeout),
+               "--heartbeat-s", str(args.heartbeat_s),
+               "--fault-drop", str(args.fault_drop),
+               "--fault-delay", str(args.fault_delay),
+               "--fault-delay-s", str(args.fault_delay_s[0]),
+               str(args.fault_delay_s[1]),
+               "--fault-reorder", str(args.fault_reorder),
+               "--fault-corrupt", str(args.fault_corrupt),
+               "--fault-seed", str(args.fault_seed)]
+        if args.robust:
+            cmd.append("--robust")
+        if args.telemetry:
+            cmd.append("--telemetry")
+        if args.kill_robot is not None and rid == args.kill_robot:
+            cmd += ["--die-at-round", str(args.kill_round)]
+        procs.append(subprocess.Popen(cmd, env=child_env))
+
+    injector = make_injector(args, seed_offset=1000)
+    channels = accept_robots(
+        srv, args.robots, injector=injector,
+        policy=RetryPolicy(send_timeout_s=args.round_timeout,
+                           recv_timeout_s=args.round_timeout))
+    bus = RoundBus(channels, round_timeout_s=args.round_timeout,
+                   miss_limit=3,
+                   liveness_timeout_s=max(1.0, 8 * args.heartbeat_s))
+
+    def serve():
+        bus.round()                     # lifting-matrix round (clean)
+        if injector is not None:
+            injector.enabled = True
+        bus.serve(args.rounds)          # solve rounds (faults live)
+        if injector is not None:
+            injector.enabled = False
+        bus.round()                     # final anchor round (clean)
+        bus.close()                     # aggregated comms run_summary
+
+    bus_thread = threading.Thread(target=serve, daemon=True)
+    bus_thread.start()
+
     try:
         rcs = [p.wait(timeout=900) for p in procs]
     finally:
@@ -327,12 +285,17 @@ def launch(args) -> int:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    bus_thread.join(timeout=60)
     srv.close()
+    if run is not None:
+        obs.end_run()
     if any(rcs):
         print(f"robot processes failed: {rcs}", file=sys.stderr)
         return 1
 
-    # Assemble the global trajectory and evaluate the SE(d) cost.
+    # Assemble the global trajectory and evaluate the SE(d) cost over the
+    # edges whose BOTH endpoints belong to surviving robots (a killed
+    # robot's block never made it to disk).
     setup_jax()
     from dpgo_tpu.ops import quadratic
     from dpgo_tpu.types import edge_set_from_measurements
@@ -342,29 +305,41 @@ def launch(args) -> int:
 
     meas = read_g2o(args.dataset)
     part = partition_contiguous(meas, args.robots)
-    outs = [np.load(os.path.join(out_dir, f"robot{r}.npz"))
-            for r in range(args.robots)]
+    outs, survivors = {}, []
+    for r in range(args.robots):
+        path = os.path.join(out_dir, f"robot{r}.npz")
+        if os.path.exists(path):
+            outs[r] = np.load(path)
+            survivors.append(r)
     d = meas.d
     T = np.zeros((meas.num_poses, d, d + 1))
-    for r, o in enumerate(outs):
+    for r, o in outs.items():
         ids = part.global_index[r][part.global_index[r] >= 0]
         T[ids] = o["T"]
-    edges_g = edge_set_from_measurements(part.meas_global)
-    X = jnp.asarray(T)
-    cost = float(quadratic.cost(X, edges_g))
+    # Robot ownership lives in the robot-local view (meas_global keeps
+    # r1 == r2 == 0 by construction); the two share row order.
+    pm = part.meas
+    keep = np.isin(np.asarray(pm.r1), survivors) & \
+        np.isin(np.asarray(pm.r2), survivors)
+    edges_g = edge_set_from_measurements(part.meas_global.select(keep))
+    cost = float(quadratic.cost(jnp.asarray(T), edges_g))
     result = {
         "cost": cost,
-        "states": [int(o["state"]) for o in outs],
-        "iterations": [int(o["iterations"]) for o in outs],
-        "bytes_sent": [int(o["bytes_sent"]) for o in outs],
+        "states": [int(outs[r]["state"]) if r in outs else None
+                   for r in range(args.robots)],
+        "iterations": [int(outs[r]["iterations"]) if r in outs else None
+                       for r in range(args.robots)],
+        "bytes_sent": [int(outs[r]["bytes_sent"]) if r in outs else None
+                       for r in range(args.robots)],
+        "lost": sorted(set(range(args.robots)) - set(survivors)),
         "out_dir": out_dir,
     }
     print(json.dumps(result))
     if args.telemetry:
         from dpgo_tpu.obs.report import render_report
         tdir = os.path.join(out_dir, "telemetry")
-        for rid in range(args.robots):
-            rd = os.path.join(tdir, f"robot{rid}")
+        for sub in ["bus"] + [f"robot{r}" for r in range(args.robots)]:
+            rd = os.path.join(tdir, sub)
             if os.path.isdir(rd):
                 print(file=sys.stderr)
                 print(render_report(rd), file=sys.stderr)
@@ -383,22 +358,42 @@ def main() -> None:
     ap.add_argument("--mode", choices=("sync", "async"), default="sync")
     ap.add_argument("--robust", action="store_true")
     ap.add_argument("--telemetry", action="store_true",
-                    help="per-robot telemetry runs (dpgo_tpu.obs) under "
-                         "OUT_DIR/telemetry/robot<id>, reported after the "
-                         "solve")
+                    help="telemetry runs (dpgo_tpu.obs) under "
+                         "OUT_DIR/telemetry/{bus,robot<id>}, reported "
+                         "after the solve")
     ap.add_argument("--async-rate", type=float, default=20.0,
                     help="async mode: per-robot Poisson iterate rate (Hz) "
                          "and the bus exchange cadence")
+    ap.add_argument("--round-timeout", type=float, default=120.0,
+                    help="per-message send/recv deadline (s).  The large "
+                         "default preserves deterministic lockstep on "
+                         "fault-free runs (first-iterate compiles take "
+                         "seconds); chaos runs should drop it to ~2s")
+    ap.add_argument("--heartbeat-s", type=float, default=0.25,
+                    help="robot->bus heartbeat interval (liveness)")
+    ap.add_argument("--fault-drop", type=float, default=0.0)
+    ap.add_argument("--fault-delay", type=float, default=0.0)
+    ap.add_argument("--fault-delay-s", type=float, nargs=2,
+                    default=[0.05, 0.2], metavar=("MIN", "MAX"))
+    ap.add_argument("--fault-reorder", type=float, default=0.0)
+    ap.add_argument("--fault-corrupt", type=float, default=0.0)
+    ap.add_argument("--fault-seed", type=int, default=0)
+    ap.add_argument("--kill-robot", type=int, default=None,
+                    help="launcher: tell this robot to crash mid-solve")
+    ap.add_argument("--kill-round", type=int, default=None,
+                    help="round at which --kill-robot dies")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--out-dir", default=None)
     ap.add_argument("--robot", type=int, default=None,
                     help="internal: run as this robot instead of launching")
+    ap.add_argument("--die-at-round", type=int, default=None,
+                    help="internal: simulate a crash at this round")
     args = ap.parse_args()
+    if args.kill_robot is not None and args.kill_round is None:
+        ap.error("--kill-robot requires --kill-round")
     if args.robot is None:
         sys.exit(launch(args))
-    run_robot(args.robot, args.dataset, args.robots, args.rank, args.rounds,
-              args.port, args.out_dir, args.mode, args.robust,
-              args.async_rate, telemetry=args.telemetry)
+    run_robot(args)
 
 
 if __name__ == "__main__":

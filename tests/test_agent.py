@@ -236,6 +236,95 @@ def test_async_rejects_acceleration():
         agents[0].start_optimization_loop()
 
 
+def test_frame_alignment_aborts_and_retries_on_incomplete_message():
+    """``_try_initialize_in_global_frame``'s abort-and-retry contract
+    (reference PGOAgent.cpp:396-400): a neighbor pose dict missing the
+    required keys — empty, wrong pose ids, or arriving before the lifting
+    matrix — must leave the agent in WAIT_FOR_INITIALIZATION, and the next
+    complete message must succeed."""
+    from dpgo_tpu.utils.synthetic import make_measurements as _mm
+
+    rng = np.random.default_rng(1)
+    meas, _ = _mm(rng, n=10, d=3, num_lc=5, rot_noise=0.005,
+                  trans_noise=0.005)
+    part = partition_contiguous(meas, 2)
+    params = AgentParams(d=3, r=5, num_robots=2)
+    a0 = PGOAgent(0, params)
+    a1 = PGOAgent(1, params)  # deliberately NO lifting matrix yet
+    a0.set_pose_graph(*agent_measurements(part, 0))
+    a1.set_pose_graph(*agent_measurements(part, 1))
+    assert a1.get_status().state == AgentState.WAIT_FOR_INITIALIZATION
+
+    full = a0.get_shared_pose_dict()
+    a1.set_neighbor_status(a0.get_status())
+
+    # 1) Empty dict: no correspondence can be built -> abort, stay waiting.
+    a1.update_neighbor_poses(0, {})
+    assert a1.get_status().state == AgentState.WAIT_FOR_INITIALIZATION
+
+    # 2) Wrong keys (pose ids this agent never references): same abort.
+    bogus = {(0, 997 + k): blk for k, blk in enumerate(full.values())}
+    a1.update_neighbor_poses(0, bogus)
+    assert a1.get_status().state == AgentState.WAIT_FOR_INITIALIZATION
+
+    # 3) Complete message but the lifting matrix has not arrived: defer.
+    a1.update_neighbor_poses(0, full)
+    assert a1.get_status().state == AgentState.WAIT_FOR_INITIALIZATION
+
+    # 4) Lifting matrix lands, next complete message initializes.
+    a1.set_lifting_matrix(a0.get_lifting_matrix())
+    a1.update_neighbor_poses(0, a0.get_shared_pose_dict())
+    assert a1.get_status().state == AgentState.INITIALIZED
+
+
+def test_stale_pose_frames_are_dropped_by_sequence():
+    """Transport sequence bookkeeping: a pose frame with a sequence at or
+    below the last accepted one must not overwrite fresher cached poses
+    (the reordered-network case the comms layer surfaces)."""
+    agents, _, _ = make_agents(2, n=10, num_lc=4)
+    a0, a1 = agents
+    fresh = a0.get_shared_pose_dict()
+    key = next(iter(fresh))
+    a1.update_neighbor_poses(0, fresh, sequence=5)
+    assert np.allclose(a1._neighbor_poses[key], fresh[key])
+    stale = {k: np.zeros_like(v) for k, v in fresh.items()}
+    a1.update_neighbor_poses(0, stale, sequence=5)   # duplicate
+    a1.update_neighbor_poses(0, stale, sequence=3)   # reordered
+    assert np.allclose(a1._neighbor_poses[key], fresh[key])
+    a1.update_neighbor_poses(0, stale, sequence=6)   # genuinely newer
+    assert np.allclose(a1._neighbor_poses[key], 0.0)
+    # Sequence-less transports (in-process method calls) keep working.
+    a1.update_neighbor_poses(0, fresh)
+    assert np.allclose(a1._neighbor_poses[key], fresh[key])
+
+
+def test_lost_neighbor_excluded_from_termination_quorum():
+    """``mark_neighbor_lost`` removes a dead robot from the
+    ``should_terminate`` quorum (sync-mode degradation), and a fresh pose
+    message revives it."""
+    # Huge tolerance: one stepped iterate makes an agent ready.
+    agents, _, _ = make_agents(3, n=18, num_lc=12, rel_change_tol=1e9)
+    for _ in range(2):
+        exchange(agents)
+    assert all(ag.get_status().state == AgentState.INITIALIZED
+               for ag in agents)
+    # Robots 0 and 1 step (become ready); robot 2 never iterates.
+    agents[0].iterate(True)
+    agents[1].iterate(True)
+    exchange(agents)
+    a0 = agents[0]
+    assert a0.get_status().ready_to_terminate
+    assert not a0.should_terminate()  # robot 2 is not ready -> no quorum
+    a0.mark_neighbor_lost(2)
+    assert a0.lost_neighbors == [2]
+    assert a0.should_terminate()      # quorum over the survivors only
+    # A fresh (sequence-stamped) message from robot 2 revives it.
+    a0.update_neighbor_poses(2, agents[2].get_shared_pose_dict(),
+                             sequence=0)
+    assert a0.lost_neighbors == []
+    assert not a0.should_terminate()
+
+
 def test_reset_while_loop_running_does_not_deadlock():
     """reset() must join the loop thread without holding the agent lock."""
     agents, _, _ = make_agents(1, n=8, num_lc=4)

@@ -1,0 +1,494 @@
+"""The fault-tolerant comms subsystem (``dpgo_tpu.comms``): wire protocol
+(frame cap, incremental assembly), seeded fault injection, loopback + TCP
+transports, the reliable channel (retry/backoff, sequence numbers, stale
+and corrupt drops, heartbeats), the round bus with graceful agent dropout,
+and the obs instrumentation incl. the zero-overhead telemetry-off fence."""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dpgo_tpu import obs
+from dpgo_tpu.comms import (BusClient, FaultInjector, FaultSpec,
+                            LoopbackTransport, ProtocolError,
+                            ReliableChannel, RetryPolicy, RoundBus,
+                            TcpTransport, Transport, TransportClosed,
+                            TransportTimeout, loopback_fleet)
+from dpgo_tpu.comms.protocol import (HEADER, FrameAssembler, decode_payload,
+                                     encode_frame, encode_payload,
+                                     recv_frame, send_frame)
+from dpgo_tpu.obs import run as obs_run_mod
+from dpgo_tpu.obs.events import EventStream, read_events
+from dpgo_tpu.obs import metrics as obs_metrics_mod
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_ambient_run():
+    obs.end_run()
+    yield
+    obs.end_run()
+
+
+FAST = RetryPolicy(max_attempts=3, base_delay_s=0.005, max_delay_s=0.02,
+                   send_timeout_s=1.0, recv_timeout_s=1.0)
+
+
+# ---------------------------------------------------------------------------
+# Protocol
+# ---------------------------------------------------------------------------
+
+def test_payload_roundtrip_and_corrupt_rejection():
+    arrays = {"a": np.arange(5), "b": np.eye(3)}
+    data = encode_payload(arrays)
+    out = decode_payload(data)
+    assert out["a"].tolist() == [0, 1, 2, 3, 4]
+    np.testing.assert_array_equal(out["b"], np.eye(3))
+    # Bit-flipped archives raise ProtocolError, not random zipfile errors.
+    bad = bytearray(data)
+    for k in (1, len(bad) // 2, len(bad) - 2):
+        bad[k] ^= 0xFF
+    with pytest.raises(ProtocolError):
+        decode_payload(bytes(bad))
+
+
+def test_frame_assembler_incremental_and_cap():
+    fa = FrameAssembler(max_frame_bytes=1 << 20)
+    frame = encode_frame({"x": np.arange(10)})
+    # Byte-at-a-time feeding (a recv deadline can strike anywhere).
+    got = []
+    for i in range(len(frame)):
+        got += fa.feed(frame[i:i + 1])
+    (payload,) = got
+    assert decode_payload(payload)["x"].tolist() == list(range(10))
+    assert fa.pending_bytes == 0
+    # Two frames in one read.
+    assert len(fa.feed(frame + frame)) == 2
+    # An absurd length header dies cleanly instead of allocating 2**60.
+    with pytest.raises(ProtocolError, match="cap"):
+        fa.feed(struct.pack("<Q", 1 << 60))
+
+
+def test_recv_frame_rejects_oversized_header():
+    """The satellite fix: a corrupt/malicious 8-byte length prefix must
+    raise ProtocolError before any allocation is sized from it."""
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack("<Q", 1 << 60) + b"junk")
+        with pytest.raises(ProtocolError, match="cap"):
+            recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+    # Sane frames round-trip with the default cap (fresh stream — a raw
+    # blocking socket has no reassembly to resynchronize after garbage;
+    # that is TcpTransport's FrameAssembler job).
+    a, b = socket.socketpair()
+    try:
+        send_frame(a, {"v": np.asarray([7.0])})
+        assert recv_frame(b)["v"].tolist() == [7.0]
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Fault injector
+# ---------------------------------------------------------------------------
+
+def test_fault_injector_is_deterministic_per_link():
+    spec = FaultSpec(drop=0.3, delay=0.2, delay_s=(0.01, 0.02),
+                     corrupt=0.1)
+    data = b"x" * 64
+
+    def decisions(seed):
+        inj = FaultInjector(spec, seed=seed)
+        return [tuple((d, bytes(p)) for d, p in inj.apply("a", "b", data))
+                for _ in range(200)]
+
+    assert decisions(7) == decisions(7)
+    assert decisions(7) != decisions(8)
+    # Per-link independence: interleaving another link's traffic does not
+    # shift this link's stream.
+    inj1, inj2 = FaultInjector(spec, seed=7), FaultInjector(spec, seed=7)
+    out1 = [inj1.apply("a", "b", data) for _ in range(50)]
+    out2 = []
+    for _ in range(50):
+        inj2.apply("c", "d", data)
+        out2.append(inj2.apply("a", "b", data))
+    assert [[(d, bytes(p)) for d, p in o] for o in out1] == \
+        [[(d, bytes(p)) for d, p in o] for o in out2]
+
+
+def test_fault_injector_modes():
+    # Drop everything.
+    inj = FaultInjector(FaultSpec(drop=1.0), seed=0)
+    assert inj.apply("a", "b", b"data") == []
+    assert inj.stats["dropped"] == 1
+    # Partition: a<->b cut, a<->c free.
+    inj = FaultInjector(FaultSpec(partitions=(("a",),)), seed=0)
+    assert inj.apply("a", "b", b"d") == []
+    assert inj.partitioned("b", "a")
+    assert not inj.partitioned("b", "c")
+    # Reorder: first held, released behind the second (newer first).
+    inj = FaultInjector(FaultSpec(reorder=1.0), seed=0)
+    assert inj.apply("a", "b", b"one") == []
+    out = inj.apply("a", "b", b"two")
+    assert [p for _, p in out] == [b"two", b"one"]
+    # Disabled: pure passthrough regardless of spec.
+    inj = FaultInjector(FaultSpec(drop=1.0), seed=0)
+    inj.enabled = False
+    assert inj.apply("a", "b", b"d") == [(0.0, b"d")]
+
+
+# ---------------------------------------------------------------------------
+# Transports
+# ---------------------------------------------------------------------------
+
+def test_loopback_transport_deadline_and_close():
+    a, b = LoopbackTransport.pair()
+    a.send({"v": np.asarray(1)})
+    assert int(b.recv(timeout=1.0)["v"]) == 1
+    t0 = time.monotonic()
+    with pytest.raises(TransportTimeout):
+        b.recv(timeout=0.05)
+    assert time.monotonic() - t0 < 1.0
+    a.close()
+    with pytest.raises(TransportClosed):
+        b.recv(timeout=1.0)
+
+
+def test_loopback_delay_fault_delivers_late():
+    inj = FaultInjector(FaultSpec(delay=1.0, delay_s=(0.08, 0.1)), seed=0)
+    a, b = LoopbackTransport.pair(injector=inj)
+    a.send({"v": np.asarray(1)})
+    with pytest.raises(TransportTimeout):
+        b.recv(timeout=0.01)  # not there yet
+    assert int(b.recv(timeout=1.0)["v"]) == 1  # arrives once due
+
+
+def _tcp_pair(**kw):
+    a, b = socket.socketpair()
+    return TcpTransport(a, src="a", dst="b", **kw), \
+        TcpTransport(b, src="b", dst="a", **kw)
+
+
+def test_tcp_transport_roundtrip_deadline_resume_and_close():
+    ta, tb = _tcp_pair()
+    try:
+        ta.send({"v": np.arange(4)})
+        assert tb.recv(timeout=1.0)["v"].tolist() == [0, 1, 2, 3]
+        # Deadline strikes mid-frame: the partial bytes stay buffered and
+        # the next recv resumes the same frame — no stream desync.
+        frame = encode_frame({"w": np.arange(8)})
+        ta._sock.sendall(HEADER.pack(len(frame) - HEADER.size))
+        ta._sock.sendall(frame[HEADER.size:HEADER.size + 5])
+        with pytest.raises(TransportTimeout):
+            tb.recv(timeout=0.05)
+        ta._sock.sendall(frame[HEADER.size + 5:])
+        assert tb.recv(timeout=1.0)["w"].tolist() == list(range(8))
+        ta.close()
+        with pytest.raises(TransportClosed):
+            tb.recv(timeout=1.0)
+    finally:
+        ta.close()
+        tb.close()
+
+
+def test_tcp_transport_oversized_header_raises():
+    ta, tb = _tcp_pair(max_frame_bytes=1024)
+    try:
+        ta._sock.sendall(struct.pack("<Q", 1 << 40))
+        with pytest.raises(ProtocolError, match="cap"):
+            tb.recv(timeout=1.0)
+        with pytest.raises(ProtocolError, match="cap"):
+            ta.send({"big": np.zeros(4096)})  # send-side cap too
+    finally:
+        ta.close()
+        tb.close()
+
+
+# ---------------------------------------------------------------------------
+# Reliable channel
+# ---------------------------------------------------------------------------
+
+class _FlakySendTransport(Transport):
+    """Times out the first ``fails`` sends, then succeeds."""
+
+    def __init__(self, fails):
+        super().__init__("a", "b")
+        self.fails = fails
+        self.sent = []
+
+    def send(self, arrays, timeout=None):
+        if self.fails:
+            self.fails -= 1
+            raise TransportTimeout("injected")
+        self.sent.append(arrays)
+        return 1
+
+    def recv(self, timeout=None):
+        raise TransportTimeout("nothing")
+
+    def close(self):
+        pass
+
+
+def test_send_retries_with_backoff_then_succeeds():
+    ch = ReliableChannel(_FlakySendTransport(2), "flaky", FAST)
+    ch.send({"v": np.asarray(1)})
+    assert len(ch.transport.sent) == 1
+    assert ch.totals.retries == 2
+    assert ch.totals.timeouts == 2
+    assert ch.totals.messages_sent == 1
+
+
+def test_send_gives_up_after_max_attempts():
+    ch = ReliableChannel(_FlakySendTransport(99), "dead", FAST)
+    with pytest.raises(TransportTimeout):
+        ch.send({"v": np.asarray(1)})
+    assert ch.totals.retries == FAST.max_attempts - 1
+    assert ch.totals.messages_sent == 0
+
+
+def _channel_pair(injector=None, policy=FAST):
+    a, b = LoopbackTransport.pair(injector=injector)
+    return ReliableChannel(a, "a->b", policy), \
+        ReliableChannel(b, "b->a", policy)
+
+
+def test_sequence_numbers_drop_stale_and_reordered():
+    inj = FaultInjector(FaultSpec(reorder=1.0), seed=0)
+    ca, cb = _channel_pair(injector=inj)
+    ca.send({"i": np.asarray(1)})  # held by the injector
+    ca.send({"i": np.asarray(2)})  # released as [2, then 1]
+    assert int(cb.recv(timeout=1.0)["i"]) == 2
+    with pytest.raises(TransportTimeout):
+        cb.recv(timeout=0.05)  # the late 1 was dropped as stale
+    assert cb.totals.stale_dropped == 1
+    assert cb.last_recv_seq == 1  # channel seq of the frame carrying i=2
+
+
+def test_corrupt_frames_are_counted_and_skipped():
+    inj = FaultInjector(FaultSpec(corrupt=1.0), seed=0)
+    ca, cb = _channel_pair(injector=inj)
+    ca.send({"i": np.asarray(1)})
+    inj.enabled = False
+    ca.send({"i": np.asarray(2)})
+    assert int(cb.recv(timeout=1.0)["i"]) == 2
+    assert cb.totals.corrupt_dropped == 1
+
+
+def test_heartbeat_liveness():
+    ca, cb = _channel_pair()
+    assert cb.last_seen_age() is None
+    ca.start_heartbeat(0.02)
+    deadline = time.monotonic() + 2.0
+    while cb.last_seen_age() is None and time.monotonic() < deadline:
+        with pytest.raises(TransportTimeout):
+            cb.recv(timeout=0.05)
+    age = cb.last_seen_age()
+    assert age is not None and age < 1.0
+    assert cb.totals.heartbeats_received >= 1
+    ca.close()
+    cb.close()
+
+
+def test_run_summary_and_counters_with_telemetry_on(tmp_path):
+    inj = FaultInjector(FaultSpec(reorder=1.0), seed=0)
+    with obs.run_scope(str(tmp_path / "run")) as run:
+        ca, cb = _channel_pair(injector=inj)
+        ca.send({"i": np.asarray(1)})
+        ca.send({"i": np.asarray(2)})
+        cb.recv(timeout=1.0)
+        with pytest.raises(TransportTimeout):
+            cb.recv(timeout=0.05)
+        snap_counter = run.registry.counter("comms_stale_dropped").value(
+            channel="b->a")
+        ca.close()
+        cb.close()
+    evs = read_events(str(tmp_path / "run" / "events.jsonl"))
+    summaries = {e["channel"]: e for e in evs
+                 if e["event"] == "run_summary"}
+    assert set(summaries) == {"a->b", "b->a"}
+    assert summaries["a->b"]["messages_sent"] == 2
+    assert summaries["b->a"]["messages_received"] == 1
+    assert summaries["b->a"]["stale_dropped"] == 1
+    assert summaries["b->a"]["timeouts"] == 1
+    assert snap_counter == 1
+
+
+# ---------------------------------------------------------------------------
+# Round bus + graceful dropout
+# ---------------------------------------------------------------------------
+
+def _fleet(n=3, **kw):
+    kw.setdefault("policy", FAST)
+    kw.setdefault("round_timeout_s", 0.2)
+    kw.setdefault("liveness_timeout_s", 0.15)
+    return loopback_fleet(n, **kw)
+
+
+def test_round_bus_merges_and_broadcasts():
+    bus, clients = _fleet(3)
+    for rid, c in clients.items():
+        c.publish({"v": np.asarray(rid * 10)})
+    merged = bus.round()
+    assert {k for k in merged if k.endswith("|v")} == \
+        {"r0|v", "r1|v", "r2|v"}
+    for rid, c in clients.items():
+        got = c.collect(timeout=1.0)
+        peers = c.peer_frames(got)
+        assert set(peers) == {0, 1, 2} - {rid}
+        for p, pf in peers.items():
+            assert int(pf["v"]) == p * 10
+            assert int(pf["_pseq"]) >= 0
+    assert bus.lost == set()
+    bus.close()
+
+
+def test_round_bus_detects_closed_robot_and_continues():
+    bus, clients = _fleet(3)
+    for c in clients.values():
+        c.publish({"v": np.asarray(1)})
+    bus.round()
+    clients[1].close()  # robot 1 dies
+    for rid in (0, 2):
+        clients[rid].collect(timeout=1.0)
+        clients[rid].publish({"v": np.asarray(2)})
+    bus.round()
+    assert bus.lost == {1}
+    for rid in (0, 2):
+        merged = clients[rid].collect(timeout=1.0)
+        assert merged is not None
+        assert clients[rid].lost == {1}
+        assert not any(k.startswith("r1|") for k in merged)
+    bus.close()
+
+
+def test_round_bus_declares_silent_robot_lost_by_heartbeat():
+    bus, clients = _fleet(2, miss_limit=2)
+    clients[0].channel.start_heartbeat(0.02)  # robot 0 stays alive, mute-ish
+    for c in clients.values():
+        c.publish({"v": np.asarray(1)})
+    bus.round()
+    # Robot 1 goes silent WITHOUT closing: no frames, no heartbeat.  Robot 0
+    # keeps publishing.  After miss_limit rounds with a stale heartbeat the
+    # bus declares robot 1 lost; robot 0 (fresh heartbeat) is kept even when
+    # its *data* frames miss a round.
+    for _ in range(3):
+        clients[0].collect(timeout=1.0)
+        clients[0].publish({"v": np.asarray(2)})
+        bus.round()
+        if bus.lost:
+            break
+    assert bus.lost == {1}
+    clients[0].collect(timeout=1.0)
+    assert clients[0].lost == {1}
+    bus.close()
+
+
+def test_bus_serve_stops_when_everyone_is_gone():
+    bus, clients = _fleet(2, round_timeout_s=0.05)
+    for c in clients.values():
+        c.close()
+    t0 = time.monotonic()
+    bus.serve(10_000)  # must return promptly, not spin 10k timeouts
+    assert time.monotonic() - t0 < 5.0
+    assert bus.lost == {0, 1}
+    bus.close()
+
+
+def test_bus_emits_peer_lost_event_and_aggregated_summary(tmp_path):
+    with obs.run_scope(str(tmp_path / "run")):
+        bus, clients = _fleet(2)
+        for c in clients.values():
+            c.publish({"v": np.asarray(1)})
+        bus.round()
+        clients[1].close()
+        clients[0].collect(timeout=1.0)
+        clients[0].publish({"v": np.asarray(2)})
+        bus.round()
+        bus.close()
+        clients[0].close()
+    evs = read_events(str(tmp_path / "run" / "events.jsonl"))
+    (lost_ev,) = [e for e in evs if e["event"] == "peer_lost"]
+    assert lost_ev["peer"] == 1 and lost_ev["reason"] == "closed"
+    (bus_summary,) = [e for e in evs if e["event"] == "run_summary"
+                      and e["channel"] == "bus"]
+    assert bus_summary["peers_lost"] == [1]
+    assert bus_summary["rounds_served"] == 2
+    assert bus_summary["messages_received"] >= 3
+
+
+def test_report_cli_shows_network_health(tmp_path, capsys):
+    from dpgo_tpu.obs.report import main as report_main
+
+    d = str(tmp_path / "run")
+    with obs.run_scope(d):
+        bus, clients = _fleet(2)
+        for c in clients.values():
+            c.publish({"v": np.asarray(1)})
+        bus.round()
+        clients[1].close()
+        clients[0].collect(timeout=1.0)
+        clients[0].publish({"v": np.asarray(2)})
+        bus.round()
+        bus.close()
+        clients[0].close()
+    assert report_main([d]) == 0
+    out = capsys.readouterr().out
+    assert "network health (comms):" in out
+    assert "peers lost [1]" in out
+    assert "peer_lost: bus lost peer 1 (closed)" in out
+
+
+# ---------------------------------------------------------------------------
+# The zero-overhead telemetry-off contract for the comms layer
+# ---------------------------------------------------------------------------
+
+def test_comms_telemetry_off_emits_zero_obs_events(monkeypatch):
+    """Same fence-throw pattern as PR 1: with no ambient run, a faulty
+    exchange — retries, stale drops, corrupt drops, a dead peer, channel
+    close — must emit ZERO events, make ZERO registry calls, and perform
+    ZERO obs-owned transfers.  Plain-int ChannelTotals still count."""
+
+    def boom(*a, **kw):
+        raise AssertionError("telemetry path taken while disabled")
+
+    monkeypatch.setattr(EventStream, "emit", boom)
+    monkeypatch.setattr(obs_run_mod, "materialize", boom)
+    monkeypatch.setattr(obs, "materialize", boom)
+    monkeypatch.setattr(obs_metrics_mod.Counter, "inc", boom)
+    monkeypatch.setattr(obs_metrics_mod.Gauge, "set", boom)
+    monkeypatch.setattr(obs_metrics_mod.Histogram, "observe", boom)
+    monkeypatch.setattr(obs_metrics_mod.Histogram, "observe_many", boom)
+
+    assert obs.get_run() is None
+    inj = FaultInjector(FaultSpec(reorder=1.0, corrupt=0.2), seed=3)
+    bus, clients = _fleet(3, injector=inj)
+    for _ in range(4):
+        for c in clients.values():
+            c.publish({"v": np.asarray(1)})
+        bus.round()
+        for c in clients.values():
+            c.collect(timeout=0.3)
+    clients[2].close()
+    for rid in (0, 1):
+        clients[rid].publish({"v": np.asarray(2)})
+    bus.round()
+    assert bus.lost == {2}
+    bus.close()
+    for c in clients.values():
+        c.close()
+    # The always-on accounting still worked.
+    totals = bus.totals()
+    assert totals.messages_received > 0
+    # Retry path too.
+    ch = ReliableChannel(_FlakySendTransport(1), "flaky", FAST)
+    ch.send({"v": np.asarray(1)})
+    assert ch.totals.retries == 1
+    ch.close()

@@ -1,12 +1,15 @@
 """Two-process TCP deployment (examples/tcp_deployment_example.py): the
 agent message vocabulary serializes over a real socket and the two-process
-solve converges to the in-process solution on smallGrid3D."""
+solve converges to the in-process solution on smallGrid3D — plus the
+fault-injected chaos run over real sockets (drop/delay + a robot killed
+mid-solve) degrading gracefully instead of hanging."""
 
 import json
 import os
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 EXAMPLE = os.path.join(os.path.dirname(__file__), "..", "examples",
@@ -69,6 +72,39 @@ def test_four_process_robust_tcp_matches_in_process(tmp_path, data_dir):
     # a broken wt_* round-trip or ownership rule diverges by orders of
     # magnitude, not fractions.
     assert abs(res["cost"] - 2135.651039987529) < 0.5
+
+
+def test_three_process_tcp_chaos_degrades_gracefully(tmp_path):
+    """Real sockets under injected faults (seeded drop + delay) with one
+    robot killed mid-solve: the launcher must terminate (no hang), report
+    the dead robot in ``lost``, and the survivors must still converge —
+    the same acceptance scenario tests/test_chaos.py runs in-process.
+    Self-contained dataset (write_g2o) so no external data dir is needed."""
+    from dpgo_tpu.utils.g2o import write_g2o
+    from dpgo_tpu.utils.synthetic import make_measurements
+
+    meas, _ = make_measurements(np.random.default_rng(0), n=36, d=3,
+                                num_lc=18, rot_noise=0.01, trans_noise=0.01)
+    dataset = str(tmp_path / "chaos.g2o")
+    write_g2o(meas, dataset)
+    out = subprocess.run(
+        [sys.executable, EXAMPLE, dataset,
+         "--robots", "3", "--rounds", "40", "--round-timeout", "3",
+         "--fault-drop", "0.1", "--fault-delay", "0.2",
+         "--fault-delay-s", "0.02", "0.1", "--fault-seed", "7",
+         "--kill-robot", "2", "--kill-round", "25",
+         "--out-dir", str(tmp_path / "run")],
+        env=dict(os.environ, DPGO_PLATFORM="cpu"),
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert res["lost"] == [2]
+    assert res["states"][:2] == [2, 2] and res["states"][2] is None
+    # Survivors completed essentially every round despite the faults.
+    assert all(it >= 35 for it in res["iterations"][:2])
+    # Cost is evaluated over the surviving robots' edges and must be a
+    # sane optimum (chordal init starts orders of magnitude higher).
+    assert res["cost"] < 100.0
 
 
 def test_four_process_async_tcp_solve(tmp_path, data_dir):
