@@ -1,0 +1,298 @@
+"""Benchmark: the DEPLOYMENT plane's per-round cost on sphere2500/8.
+
+Where ``bench.py`` measures the batched TPU core, this measures the
+per-robot ``PGOAgent`` + ``dpgo_tpu.comms`` path the reference deploys via
+ROS: each round every robot packs its public poses onto the wire, the
+``RoundBus`` hub gathers and rebroadcasts, every robot ingests its peers
+and takes one RTR step.  Wall-clock here is dominated by data movement —
+serialization, framing, neighbor-cache updates — not FLOPs, which is
+exactly what the packed wire format (v2), the slot-indexed neighbor
+scatter, and the compute/comm overlap (bounded staleness) attack.
+
+Arms (``--arms``):
+
+* ``fast``   — v2 packed columnar frames (zero-copy decode), packed pose
+  vocabulary feeding the vectorized neighbor scatter, compute/comm
+  overlap at ``--staleness`` (default 1).
+* ``legacy`` — the pre-PR configuration: v1 npz frames (one zip member
+  per pose block), per-pose dict vocabulary, strict lockstep
+  serialize -> exchange -> deserialize -> compute.
+* ``bf16``   — the fast arm with the opt-in bf16 pose payload (half the
+  f32 wire bytes; f32-accumulated on receipt, parity-bounded by
+  ``BF16_REL_ERR``).
+
+Transports: ``loopback`` (in-process pair — the serialization/framing
+cost without socket noise) and/or ``tcp`` (real localhost sockets,
+threads in-process).
+
+Prints exactly ONE JSON line through the obs ``metric_record`` schema
+(same leading metric/value/unit keys as bench.py and the telemetry
+stream), with per-arm sub-records and the fast-vs-legacy ratios.
+
+Usage::
+
+    python bench_deployment.py [--rounds 40] [--robots 8] [--rank 5]
+        [--transport loopback|tcp|both] [--arms fast,legacy,bf16]
+        [--staleness 1] [--n 2500] [--telemetry DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+DATASET = "/root/reference/data/sphere2500.g2o"
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def load_measurements(n: int):
+    if n == 2500 and os.path.exists(DATASET):
+        from dpgo_tpu.utils.g2o import read_g2o
+        return read_g2o(DATASET), "sphere2500"
+    from dpgo_tpu.utils.synthetic import make_measurements
+    # Same edge density as sphere2500 (~2449 LCs at 2500 poses).
+    meas, _ = make_measurements(np.random.default_rng(0), n=n, d=3,
+                                num_lc=max(4, int(n * 0.98)),
+                                rot_noise=0.01, trans_noise=0.01)
+    return meas, f"synthetic{n}"
+
+
+def build_agents(meas, robots: int, rank: int):
+    from dpgo_tpu.agent import PGOAgent
+    from dpgo_tpu.config import AgentParams
+    from dpgo_tpu.utils.partition import agent_measurements, \
+        partition_contiguous
+
+    params = AgentParams(d=meas.d, r=rank, num_robots=robots)
+    part = partition_contiguous(meas, robots)
+    agents = [PGOAgent(a, params) for a in range(robots)]
+    for ag in agents[1:]:
+        ag.set_lifting_matrix(agents[0].get_lifting_matrix())
+    for ag in agents:
+        ag.set_pose_graph(*agent_measurements(part, ag.robot_id))
+    return agents
+
+
+def make_tcp_fleet(robots: int, wire_format: str):
+    """Real localhost sockets, all endpoints in-process (threads)."""
+    from dpgo_tpu.comms import (BusClient, ReliableChannel, RetryPolicy,
+                                RoundBus, TcpTransport, connect_tcp,
+                                listen_tcp)
+    from dpgo_tpu.comms.bus import accept_robots
+
+    policy = RetryPolicy(send_timeout_s=30.0, recv_timeout_s=30.0)
+    srv = listen_tcp(port=0)
+    port = srv.getsockname()[1]
+    clients: dict[int, BusClient] = {}
+
+    def dial(rid):
+        sock = connect_tcp("127.0.0.1", port)
+        t = TcpTransport(sock, src=f"robot{rid}", dst="bus",
+                         wire_format=wire_format)
+        c = BusClient(ReliableChannel(t, f"robot{rid}->bus", policy), rid)
+        clients[rid] = c
+        c.hello(timeout=30.0)
+
+    dialers = [threading.Thread(target=dial, args=(rid,))
+               for rid in range(robots)]
+    for t in dialers:
+        t.start()
+    channels = accept_robots(srv, robots, policy=policy,
+                             wire_format=wire_format)
+    for t in dialers:
+        t.join()
+    srv.close()
+    bus = RoundBus(channels, round_timeout_s=30.0)
+    return bus, clients
+
+
+def run_arm(agents, transport: str, *, wire_format: str, packed: bool,
+            wire_dtype: str, staleness: int, rounds: int,
+            warmup: int = 10) -> dict:
+    # warmup must cover the init handshake (non-anchor robots frame-align
+    # only after receiving robot 0's poses) AND every robot's first
+    # stepped iterate (the jit compile) — all robots run the SAME warmup
+    # count so the lockstep bus schedule stays aligned.
+    """Drive ``rounds`` timed exchange+iterate rounds; returns rates and
+    per-round wire bytes."""
+    from dpgo_tpu.comms import (RetryPolicy, apply_peer_frame,
+                                loopback_fleet, pack_agent_frame)
+
+    robots = len(agents)
+    if transport == "tcp":
+        bus, clients = make_tcp_fleet(robots, wire_format)
+    else:
+        bus, clients = loopback_fleet(
+            robots, policy=RetryPolicy(send_timeout_s=30.0,
+                                       recv_timeout_s=30.0),
+            round_timeout_s=30.0, wire_format=wire_format)
+
+    # The bus serves EXACTLY one round per robot exchange (fault-free,
+    # generous deadlines keep the schedule aligned), so a fixed count
+    # terminates it cleanly — no close-under-a-live-round teardown race
+    # that would read as dead robots in the telemetry.
+    total_rounds = warmup + rounds
+
+    def bus_loop():
+        for _ in range(total_rounds):
+            if len(bus.lost) == len(bus.channels):
+                return
+            bus.round()
+
+    start_barrier = threading.Barrier(robots + 1)
+    done_at = [0.0] * robots
+
+    def robot_loop(rid: int):
+        ag = agents[rid]
+        client = clients[rid]
+        if staleness > 0:
+            client.start_overlap(staleness, timeout=30.0)
+
+        def one_round():
+            frame = pack_agent_frame(ag, include_anchor=(rid == 0),
+                                     wire_dtype=wire_dtype, packed=packed)
+            merged = client.exchange(frame, timeout=30.0)
+            if merged is not None:
+                for peer, pf in client.peer_frames(merged).items():
+                    apply_peer_frame(ag, peer, pf,
+                                     accept_anchor=(rid != 0 and peer == 0))
+            ag.iterate(True)
+
+        for _ in range(warmup):
+            one_round()
+        start_barrier.wait()
+        for _ in range(rounds):
+            one_round()
+        client.drain_overlap(timeout=60.0)
+        done_at[rid] = time.perf_counter()
+
+    bus_thread = threading.Thread(target=bus_loop, daemon=True)
+    bus_thread.start()
+    threads = [threading.Thread(target=robot_loop, args=(rid,), daemon=True)
+               for rid in range(robots)]
+    for t in threads:
+        t.start()
+    start_barrier.wait()
+    up0 = sum(c.channel.totals.bytes_sent for c in clients.values())
+    down0 = sum(ch.totals.bytes_sent for ch in bus.channels.values())
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join(timeout=900)
+    wall = max(done_at) - t0
+    up = sum(c.channel.totals.bytes_sent for c in clients.values()) - up0
+    down = sum(ch.totals.bytes_sent
+               for ch in bus.channels.values()) - down0
+    bus_thread.join(timeout=60)
+    for c in clients.values():
+        c.close()
+    bus.close()
+    return {
+        "rounds_per_s": round(rounds / wall, 3),
+        "wall_s": round(wall, 3),
+        # Upstream = all robots' publishes per round; downstream = the
+        # bus's rebroadcast fan-out per round (wire bytes incl. headers).
+        "bytes_per_round_up": int(up / rounds),
+        "bytes_per_round_down": int(down / rounds),
+    }
+
+
+ARMS = {
+    # name: (wire_format, packed-vocabulary, wire_dtype, use-staleness)
+    "fast": ("packed", True, "f64", True),
+    "bf16": ("packed", True, "bf16", True),
+    "legacy": ("npz", False, "f64", False),
+}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rounds", type=int,
+                    default=int(os.environ.get("BENCH_DEPLOY_ROUNDS", "40")))
+    ap.add_argument("--robots", type=int, default=8)
+    ap.add_argument("--rank", type=int, default=5)
+    ap.add_argument("--n", type=int, default=2500,
+                    help="pose count (2500 reads sphere2500.g2o when "
+                         "present, else a same-density synthetic)")
+    ap.add_argument("--transport", choices=("loopback", "tcp", "both"),
+                    default="both")
+    ap.add_argument("--arms", default="fast,legacy,bf16",
+                    help=f"comma list from {sorted(ARMS)}")
+    ap.add_argument("--staleness", type=int, default=1,
+                    help="overlap bound for the fast arms (0 = lockstep)")
+    ap.add_argument("--telemetry", default=None, metavar="DIR",
+                    help="scope an obs run here; the final record also "
+                         "rides the event stream")
+    args = ap.parse_args()
+
+    from dpgo_tpu import obs
+    from dpgo_tpu.obs.events import metric_record
+
+    run = obs.start_run(args.telemetry) if args.telemetry else None
+
+    meas, ds_name = load_measurements(args.n)
+    log(f"[bench_deployment] {ds_name}: {len(meas)} measurements over "
+        f"{meas.num_poses} poses, {args.robots} robots, r={args.rank}")
+
+    transports = ["loopback", "tcp"] if args.transport == "both" \
+        else [args.transport]
+    arm_names = [a for a in args.arms.split(",") if a]
+    results: dict[str, dict] = {}
+    for transport in transports:
+        for arm in arm_names:
+            wire_format, packed, wire_dtype, overlap = ARMS[arm]
+            # Fresh agents per arm: identical start state, no cross-arm
+            # warm caches.
+            agents = build_agents(meas, args.robots, args.rank)
+            r = run_arm(agents, transport, wire_format=wire_format,
+                        packed=packed, wire_dtype=wire_dtype,
+                        staleness=args.staleness if overlap else 0,
+                        rounds=args.rounds)
+            results[f"{transport}/{arm}"] = r
+            log(f"  [{transport}/{arm}] {r['rounds_per_s']} rounds/s, "
+                f"{r['bytes_per_round_up']} B/round up, "
+                f"{r['bytes_per_round_down']} B/round down")
+
+    def ratio(tr, num, den, key):
+        a, b = results.get(f"{tr}/{num}"), results.get(f"{tr}/{den}")
+        if not a or not b or not b[key]:
+            return None
+        return round(a[key] / b[key], 3)
+
+    headline = results.get("loopback/fast") or \
+        next(iter(results.values()))
+    out = metric_record(
+        f"deployment_rounds_per_sec_{ds_name}_{args.robots}robots"
+        f"_r{args.rank}",
+        headline["rounds_per_s"], "rounds/s",
+        staleness=args.staleness,
+        rounds=args.rounds,
+        arms=results,
+        speedup_vs_legacy=ratio("loopback", "fast", "legacy",
+                                "rounds_per_s"),
+        tcp_bytes_ratio_legacy_over_fast=(
+            None if ratio("tcp", "legacy", "fast", "bytes_per_round_up")
+            is None else ratio("tcp", "legacy", "fast",
+                               "bytes_per_round_up")),
+        bf16_bytes_ratio_fast_over_bf16=ratio(
+            transports[0], "fast", "bf16", "bytes_per_round_up"),
+    )
+    if run is not None:
+        run.metric(out["metric"], out["value"], out.get("unit"),
+                   phase="report", **{k: v for k, v in out.items()
+                                      if k not in ("metric", "value",
+                                                   "unit")})
+        obs.end_run()
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -29,7 +29,16 @@ reference (C++)                            here
 
 The compute inside ``iterate`` is the same jitted single-agent RTR step the
 batched core vmaps (``models.rbcd._agent_update``); per-agent shapes are
-static after ``set_pose_graph`` so each agent compiles its step once.  The
+static after ``set_pose_graph`` so each agent compiles its step once.
+
+Deployment fast path (see ARCHITECTURE "Deployment fast path"): neighbor
+poses live in a preallocated slot-indexed ``[S, r, d+1]`` buffer updated
+by vectorized scatter (``update_neighbor_poses_packed`` consumes the
+packed columnar wire vocabulary directly — no per-pose dicts), the buffer
+and the lifted iterate ``X`` stay device-resident across iterates (the
+step reads back one scalar, not ``X``; ``donate_argnums`` reuses the
+buffer on accelerator backends), and publishing gathers only the public
+rows (``get_public_pose_arrays``).  The
 async optimization loop (``start_optimization_loop``) is a host thread firing
 ``iterate`` at ``Exp(rate)``-distributed intervals — the RA-L 2020
 Poisson-clock model of ``runOptimizationLoop`` (``PGOAgent.cpp:876-898``) —
@@ -125,15 +134,31 @@ class PGOAgent:
         self._nbr_slot: dict[PoseID, int] = {}      # remote PoseID -> buffer slot
         self._slot_pose: list[PoseID] = []
         self._public: list[int] = []                # local public pose indices
-        self.X: np.ndarray | None = None            # [n, r, d+1] lifted
+        self._public_np = np.zeros(0, np.int64)
+        self.X = None                               # [n, r, d+1] lifted
         self._T_local: np.ndarray | None = None     # [n, d, d+1] own frame
         self._X_init: np.ndarray | None = None
         self._weights: np.ndarray | None = None     # [E]
+        self._weights_dev = None                    # device cache of weights
         self._shared_key_to_edge: dict = {}         # ((r1,p1),(r2,p2)) -> row
         self._mu = self.params.robust.gnc_init_mu
         self._num_weight_updates = 0
-        self._neighbor_poses: dict[PoseID, np.ndarray] = {}
-        self._aux_neighbor_poses: dict[PoseID, np.ndarray] = {}
+        # Slot-indexed neighbor cache (the deployment fast path): one
+        # preallocated [S, r, d+1] buffer per pose family, updated by
+        # vectorized scatter (no per-pose dict churn), with a device-
+        # resident copy re-uploaded only when a neighbor update landed.
+        self._nbr_vals = np.zeros((0, self.r, self.d + 1))
+        self._nbr_have = np.zeros(0, bool)
+        self._aux_vals = np.zeros((0, self.r, self.d + 1))
+        self._aux_have = np.zeros(0, bool)
+        self._nbr_ver = 0                # bumped on every regular scatter
+        self._aux_ver = 0                # bumped on every aux scatter
+        self._nbr_dev = None             # device mirror of _nbr_vals
+        self._nbr_dev_ver = -1
+        self._aux_dev = None             # merged aux-over-regular mirror
+        self._aux_dev_ver = (-1, -1)
+        self._slot_enc = np.zeros(0, np.int64)    # sorted (robot<<32)|pose
+        self._slot_enc_order = np.zeros(0, np.int64)  # slot id per enc row
         # Transport bookkeeping (dpgo_tpu.comms): last accepted pose-frame
         # sequence per neighbor, and neighbors declared dead by the
         # transport (excluded from the should_terminate quorum; their
@@ -152,6 +177,43 @@ class PGOAgent:
         self._status.iteration_number = 0
         self._status.ready_to_terminate = False
         self._status.relative_change = float("inf")
+
+    # -- device-resident iterate state --------------------------------------
+    #
+    # ``X`` stays on device across iterates (the jitted step's output feeds
+    # the next step's input with no host round-trip); host code that reads
+    # ``self.X`` gets a lazily materialized numpy mirror.  Assigning either
+    # a numpy or a jax array works — the other representation is dropped
+    # and rebuilt on demand.
+
+    @property
+    def X(self):
+        if self._X_host is None and self._X_dev is not None:
+            self._X_host = np.asarray(self._X_dev)
+        return self._X_host
+
+    @X.setter
+    def X(self, value):
+        if value is None:
+            self._X_dev = None
+            self._X_host = None
+        elif isinstance(value, jax.Array):
+            self._X_dev = value
+            self._X_host = None
+        else:
+            self._X_host = np.asarray(value)
+            self._X_dev = None
+
+    def _X_device(self):
+        """The lifted iterate as a device array (uploaded once, reused)."""
+        if self._X_dev is None and self._X_host is not None:
+            self._X_dev = jnp.asarray(self._X_host)
+        return self._X_dev
+
+    def _weights_device(self):
+        if self._weights_dev is None:
+            self._weights_dev = jnp.asarray(self._weights)
+        return self._weights_dev
 
     def set_lifting_matrix(self, ylift: np.ndarray) -> None:
         """Install the shared lifting matrix (reference ``setLiftingMatrix``,
@@ -220,8 +282,21 @@ class PGOAgent:
                     hi[k] = q
                     ti[k] = n + self._slot(a, p)
             self._public = sorted(pub)
+            self._public_np = np.asarray(self._public, np.int64)
             self._is_shared = is_shared
             self._shared_other = shared_other
+            # Preallocate the slot-indexed neighbor buffers and the sorted
+            # encoded-key table the vectorized scatter searches against.
+            S = len(self._slot_pose)
+            self._nbr_vals = np.zeros((S, self.r, self.d + 1))
+            self._nbr_have = np.zeros(S, bool)
+            self._aux_vals = np.zeros((S, self.r, self.d + 1))
+            self._aux_have = np.zeros(S, bool)
+            enc = np.fromiter(((r << 32) | p for (r, p) in self._slot_pose),
+                              np.int64, S)
+            order = np.argsort(enc, kind="stable")
+            self._slot_enc = enc[order]
+            self._slot_enc_order = order.astype(np.int64)
             self._shared_key_to_edge = {
                 ((int(all_meas.r1[k]), int(all_meas.p1[k])),
                  (int(all_meas.r2[k]), int(all_meas.p2[k]))): k
@@ -280,13 +355,22 @@ class PGOAgent:
     def _build_step(self):
         params = self.params
         pallas = self._pallas_tiles()
+        n = max(self.n, 1)
 
-        @jax.jit
         def step(X_local, z, weights):
             edges = self._edges._replace(weight=weights)
-            return _agent_update(X_local, z, edges, params, pallas=pallas)
+            X_new, gn = _agent_update(X_local, z, edges, params,
+                                      pallas=pallas)
+            # Relative change in-kernel: the host needs one scalar per
+            # iterate, not the full X buffer, to update the status gossip.
+            rel = jnp.sqrt(jnp.sum((X_new - X_local) ** 2) / n)
+            return X_new, gn, rel
 
-        self._step_fn = step
+        # Donating X lets the jitted step reuse the iterate buffer in
+        # place round over round (X never round-trips to host).  CPU's
+        # runtime does not implement donation and would warn every solve.
+        donate = (0,) if jax.default_backend() != "cpu" else ()
+        self._step_fn = jax.jit(step, donate_argnums=donate)
 
     def _pallas_tiles(self):
         """Tile-major edge arrays when this robot's iterate should run the
@@ -354,23 +438,32 @@ class PGOAgent:
                   instance=self._status.instance_number,
                   iteration=self._status.iteration_number)
 
-    def _obs_comms(self, direction: str, pose_dict: PoseDict,
-                   neighbor_id: int | None = None) -> None:
+    def _obs_comms_bytes(self, direction: str, nbytes: int,
+                         neighbor_id: int | None = None) -> None:
         """Account one pose message: messages + bytes, labeled by robot and
         (for receives) the peer — the per-neighbor communication volume the
-        reference driver hand-counts (``MultiRobotExample.cpp:274-279``)."""
+        reference driver hand-counts (``MultiRobotExample.cpp:274-279``).
+        ``nbytes`` is read off the packed payload by the callers — no
+        per-block iteration on the hot path."""
         run = obs.get_run()
-        if run is None or not pose_dict:
+        if run is None or not nbytes:
             return
-        nbytes = sum(np.asarray(b).nbytes for b in pose_dict.values())
         labels = {"robot": self.robot_id}
         if neighbor_id is not None:
             labels["neighbor"] = neighbor_id
         run.counter(f"comms_messages_{direction}",
-                    f"pose-dict messages {direction}").inc(1, **labels)
+                    f"pose messages {direction}").inc(1, **labels)
         run.counter(f"comms_bytes_{direction}",
-                    f"pose-dict payload bytes {direction}",
-                    unit="bytes").inc(nbytes, **labels)
+                    f"pose payload bytes {direction}",
+                    unit="bytes").inc(int(nbytes), **labels)
+
+    def _obs_comms(self, direction: str, pose_dict: PoseDict,
+                   neighbor_id: int | None = None) -> None:
+        """Dict-vocabulary wrapper of ``_obs_comms_bytes`` (v1 callers)."""
+        if obs.get_run() is None or not pose_dict:
+            return
+        nbytes = sum(np.asarray(b).nbytes for b in pose_dict.values())
+        self._obs_comms_bytes(direction, nbytes, neighbor_id)
 
     # -- pose sharing (the message vocabulary, SURVEY.md section 2.4) -------
 
@@ -383,6 +476,24 @@ class PGOAgent:
             out = {(self.robot_id, p): self.X[p].copy() for p in self._public}
         self._obs_comms("sent", out)
         return out
+
+    def get_public_pose_arrays(self):
+        """Packed publish fast path: ``(robot_ids, pose_ids, values)`` for
+        this robot's public poses as three arrays (the columnar wire
+        vocabulary), or None while uninitialized.  When X is device-
+        resident only the public rows are gathered and transferred — the
+        full buffer never round-trips to host just to publish."""
+        with self._lock:
+            if self._X_dev is None and self._X_host is None:
+                return None
+            idx = self._public_np
+            if self._X_host is not None:
+                vals = self._X_host[idx].copy()
+            else:
+                vals = np.asarray(self._X_dev[jnp.asarray(idx)])
+        self._obs_comms_bytes("sent", vals.nbytes + 8 * len(idx))
+        return (np.full(len(idx), self.robot_id, np.int32),
+                idx.astype(np.int32), vals)
 
     def get_aux_shared_pose_dict(self) -> PoseDict:
         """Public poses of the Nesterov aux sequence Y
@@ -415,11 +526,43 @@ class PGOAgent:
                     "pose messages dropped as stale/reordered").inc(
             1, robot=self.robot_id, neighbor=neighbor_id)
 
+    def _scatter_neighbor(self, robots: np.ndarray, poses: np.ndarray,
+                          vals: np.ndarray, aux: bool = False) -> None:
+        """Vectorized slot scatter (under the lock): binary-search the
+        incoming ``(robot, pose)`` keys against the sorted encoded slot
+        table, write the matching rows of the preallocated buffer in one
+        fancy-index assignment, drop keys this agent never references."""
+        if robots.size == 0 or self._slot_enc.size == 0:
+            return
+        enc = (robots.astype(np.int64) << 32) | poses.astype(np.int64)
+        pos = np.searchsorted(self._slot_enc, enc)
+        pos = np.minimum(pos, self._slot_enc.size - 1)
+        ok = self._slot_enc[pos] == enc
+        slots = self._slot_enc_order[pos[ok]]
+        if slots.size == 0:
+            return
+        if aux:
+            self._aux_vals[slots] = vals[ok]
+            self._aux_have[slots] = True
+            self._aux_ver += 1
+        else:
+            self._nbr_vals[slots] = vals[ok]
+            self._nbr_have[slots] = True
+            self._nbr_ver += 1
+
+    @staticmethod
+    def _pose_dict_arrays(pose_dict: PoseDict):
+        keys = list(pose_dict)
+        robots = np.fromiter((k[0] for k in keys), np.int64, len(keys))
+        poses = np.fromiter((k[1] for k in keys), np.int64, len(keys))
+        vals = np.stack([np.asarray(pose_dict[k], np.float64) for k in keys])
+        return robots, poses, vals
+
     def update_neighbor_poses(self, neighbor_id: int, pose_dict: PoseDict,
                               sequence: int | None = None) -> None:
         """Receive a neighbor's public poses (``updateNeighborPoses``,
-        ``PGOAgent.cpp:434-458``).  The first message from an INITIALIZED
-        neighbor triggers robust frame alignment (``PGOAgent.cpp:369-432``).
+        ``PGOAgent.cpp:434-458``) in the v1 dict vocabulary.  The packed
+        wire path lands in ``update_neighbor_poses_packed`` instead.
 
         ``sequence`` is the transport's monotonic frame number for this
         neighbor (``dpgo_tpu.comms`` stamps it): a stale or reordered frame
@@ -427,6 +570,22 @@ class PGOAgent:
         cached poses.  A fresh frame from a neighbor previously declared
         lost revives it (it is talking again).
         """
+        if pose_dict:
+            robots, poses, vals = self._pose_dict_arrays(pose_dict)
+        else:
+            robots = poses = np.zeros(0, np.int64)
+            vals = np.zeros((0, self.r, self.d + 1))
+        self.update_neighbor_poses_packed(neighbor_id, robots, poses, vals,
+                                          sequence=sequence)
+
+    def update_neighbor_poses_packed(self, neighbor_id: int,
+                                     robots: np.ndarray, poses: np.ndarray,
+                                     vals: np.ndarray,
+                                     sequence: int | None = None) -> None:
+        """The columnar receive fast path: index vectors + one contiguous
+        value payload feed the vectorized buffer scatter directly.  The
+        first message from an INITIALIZED neighbor triggers robust frame
+        alignment (``PGOAgent.cpp:369-432``)."""
         with self._lock:
             if not self._check_pose_seq(self._nbr_pose_seq, neighbor_id,
                                         sequence):
@@ -437,11 +596,12 @@ class PGOAgent:
         if stale:
             self._obs_stale_dropped(neighbor_id)
             return
-        self._obs_comms("received", pose_dict, neighbor_id)
+        robots, poses = np.asarray(robots), np.asarray(poses)
+        vals = np.asarray(vals, np.float64)
+        self._obs_comms_bytes("received", vals.nbytes + 8 * robots.size,
+                              neighbor_id)
         with self._lock:
-            for key, block in pose_dict.items():
-                if key in self._nbr_slot:
-                    self._neighbor_poses[key] = np.asarray(block, np.float64)
+            self._scatter_neighbor(robots, poses, vals)
             if (self._status.state == AgentState.WAIT_FOR_INITIALIZATION
                     and self._neighbor_is_initialized(neighbor_id)):
                 self._try_initialize_in_global_frame(neighbor_id)
@@ -449,17 +609,55 @@ class PGOAgent:
     def update_aux_neighbor_poses(self, neighbor_id: int, pose_dict: PoseDict,
                                   sequence: int | None = None) -> None:
         """(``updateAuxNeighborPoses``, ``PGOAgent.cpp:460-479``)."""
+        if pose_dict:
+            robots, poses, vals = self._pose_dict_arrays(pose_dict)
+        else:
+            robots = poses = np.zeros(0, np.int64)
+            vals = np.zeros((0, self.r, self.d + 1))
+        self.update_aux_neighbor_poses_packed(neighbor_id, robots, poses,
+                                              vals, sequence=sequence)
+
+    def update_aux_neighbor_poses_packed(self, neighbor_id: int,
+                                         robots: np.ndarray,
+                                         poses: np.ndarray,
+                                         vals: np.ndarray,
+                                         sequence: int | None = None) -> None:
         with self._lock:
             stale = not self._check_pose_seq(self._nbr_aux_seq, neighbor_id,
                                              sequence)
         if stale:
             self._obs_stale_dropped(neighbor_id)
             return
-        self._obs_comms("received", pose_dict, neighbor_id)
+        robots, poses = np.asarray(robots), np.asarray(poses)
+        vals = np.asarray(vals, np.float64)
+        self._obs_comms_bytes("received", vals.nbytes + 8 * robots.size,
+                              neighbor_id)
         with self._lock:
-            for key, block in pose_dict.items():
-                if key in self._nbr_slot:
-                    self._aux_neighbor_poses[key] = np.asarray(block, np.float64)
+            self._scatter_neighbor(robots, poses, vals, aux=True)
+
+    # -- dict-compat views of the slot-indexed neighbor cache ---------------
+
+    def _nbr_lookup(self, key: PoseID, aux: bool = False) -> np.ndarray | None:
+        """One cached neighbor block by ``(robot, pose)`` key (under the
+        lock), or None when it has not been received."""
+        slot = self._nbr_slot.get(key)
+        if slot is None:
+            return None
+        if aux:
+            if not self._aux_have[slot]:
+                return None
+            return self._aux_vals[slot]
+        if not self._nbr_have[slot]:
+            return None
+        return self._nbr_vals[slot]
+
+    @property
+    def _neighbor_poses(self) -> dict:
+        """Received regular neighbor poses as a dict (diagnostics/tests —
+        the hot path reads the slot buffer directly)."""
+        return {key: self._nbr_vals[slot]
+                for key, slot in self._nbr_slot.items()
+                if self._nbr_have[slot]}
 
     def _neighbor_is_initialized(self, neighbor_id: int) -> bool:
         st = self._neighbor_status.get(neighbor_id)
@@ -494,21 +692,21 @@ class PGOAgent:
             b, q = int(m.r2[k]), int(m.p2[k])
             dT = _se(np.asarray(m.R[k]), np.asarray(m.t[k]), d)
             if a == me:  # outgoing me -> neighbor; frame1 = my p
-                key = (b, q)
-                if key not in self._neighbor_poses:
+                blk = self._nbr_lookup((b, q))
+                if blk is None:
                     continue
                 T_f1_f2 = dT
                 p_mine = p
             else:        # incoming neighbor -> me; frame1 = my q
-                key = (a, p)
-                if key not in self._neighbor_poses:
+                blk = self._nbr_lookup((a, p))
+                if blk is None:
                     continue
                 T_f1_f2 = _se_inv(dT, d)
                 p_mine = q
             # Round the neighbor's lifted public pose to SE(d) via YLift^T
             # (computeNeighborTransform, PGOAgent.cpp:250-288).
             Tn = np.asarray(round_solution(
-                jnp.asarray(self._neighbor_poses[key])[None],
+                jnp.asarray(blk)[None],
                 jnp.asarray(self._ylift)))[0]
             T_w2_f2 = _se(Tn[:, :d], Tn[:, d], d)
             T_w1_f1 = _se(self._T_local[p_mine, :, :d],
@@ -690,10 +888,10 @@ class PGOAgent:
         with self._lock:
             if self._status.state != AgentState.INITIALIZED:
                 return None
-            Xi = self._neighbor_poses.get((neighbor_id, pose_id))
+            Xi = self._nbr_lookup((neighbor_id, pose_id))
             if Xi is None:
                 return None
-            return self._to_global_frame(Xi)
+            return self._to_global_frame(Xi.copy())
 
     # -- GNC weights --------------------------------------------------------
 
@@ -713,13 +911,14 @@ class PGOAgent:
         z = self._neighbor_buffer()
         if z is None:
             return False
-        edges = self._edges._replace(weight=jnp.asarray(self._weights))
-        res = np.asarray(_edge_residuals(jnp.asarray(self.X), z, edges))
+        edges = self._edges._replace(weight=self._weights_device())
+        res = np.asarray(_edge_residuals(self._X_device(), z, edges))
         w_new = np.asarray(robust_mod.weight(
             jnp.asarray(res), self.params.robust, self._mu))
         own = (~self._is_shared) | (self._shared_other > self.robot_id)
         upd = self._lc_upd & own
         self._weights = np.where(upd, w_new, self._weights)
+        self._weights_dev = None  # device copy re-uploads next step
         self._mu = float(robust_mod.gnc_update_mu(
             jnp.asarray(self._mu), self.params.robust))
         run = obs.get_run()
@@ -770,30 +969,41 @@ class PGOAgent:
         """Receive weights for shared edges owned by a lower-id robot."""
         with self._lock:
             m = self._meas
+            changed = False
             for key, w in weight_dict.items():
                 k = self._shared_key_to_edge.get(key)
                 if k is not None and not bool(m.is_known_inlier[k]):
                     self._weights[k] = float(w)
+                    changed = True
+            if changed:
+                self._weights_dev = None
 
     # -- the RBCD step ------------------------------------------------------
 
     def _neighbor_buffer(self, aux: bool = False) -> jax.Array | None:
-        """Stack cached neighbor poses into the buffer tail; None when any
-        needed pose is missing (constructGMatrix failure -> skip update,
-        ``PGOAgent.cpp:1122-1128``)."""
-        cache = self._aux_neighbor_poses if aux else self._neighbor_poses
+        """The slot-indexed neighbor buffer as a device array; None when
+        any needed pose is missing (constructGMatrix failure -> skip
+        update, ``PGOAgent.cpp:1122-1128``).  The device copy is uploaded
+        only when a scatter landed since the last call — an iterate round
+        with no fresh neighbor frames reuses the resident buffer."""
         if aux:
-            # Aux poses fall back to regular ones for neighbors that have not
-            # published Y yet (first accelerated round).
-            cache = {**self._neighbor_poses, **cache}
-        s = len(self._slot_pose)
-        z = np.zeros((s, self.r, self.d + 1))
-        for slot, key in enumerate(self._slot_pose):
-            blk = cache.get(key)
-            if blk is None:
+            # Aux poses fall back to regular ones for neighbors that have
+            # not published Y yet (first accelerated round).
+            if not (self._aux_have | self._nbr_have).all():
                 return None
-            z[slot] = blk
-        return jnp.asarray(z)
+            ver = (self._aux_ver, self._nbr_ver)
+            if self._aux_dev is None or self._aux_dev_ver != ver:
+                z = np.where(self._aux_have[:, None, None],
+                             self._aux_vals, self._nbr_vals)
+                self._aux_dev = jnp.asarray(z)
+                self._aux_dev_ver = ver
+            return self._aux_dev
+        if not self._nbr_have.all():
+            return None
+        if self._nbr_dev is None or self._nbr_dev_ver != self._nbr_ver:
+            self._nbr_dev = jnp.asarray(self._nbr_vals)
+            self._nbr_dev_ver = self._nbr_ver
+        return self._nbr_dev
 
     def iterate(self, do_optimization: bool = True) -> bool:
         """One RBCD iteration (reference ``iterate``, ``PGOAgent.cpp:642-718``).
@@ -825,7 +1035,6 @@ class PGOAgent:
             accel = params.acceleration
             restart = accel and params.restart_interval > 0 and \
                 self._status.iteration_number % params.restart_interval == 0
-            X_prev = self.X.copy()
 
             if accel and restart:
                 # restartNesterovAcceleration (PGOAgent.cpp:1040-1052)
@@ -835,7 +1044,12 @@ class PGOAgent:
                 self._alpha = 0.0
                 accel = False
 
+            stepped = False
             if accel:
+                # Accelerated path: the momentum bookkeeping is host math,
+                # so X materializes on host here (the deployment hot path
+                # is the non-accelerated branch below).
+                X_prev = self.X.copy()
                 N = self.num_robots
                 self._gamma = (1.0 + np.sqrt(1.0 + 4.0 * (N * self._gamma) ** 2)) \
                     / (2.0 * N)
@@ -843,26 +1057,34 @@ class PGOAgent:
                 Y = np.asarray(manifold.project(jnp.asarray(
                     (1.0 - self._alpha) * self.X + self._alpha * self._V)))
                 self._Y = Y
-                start = Y
                 z = self._neighbor_buffer(aux=True)
-            else:
-                start = self.X
-                z = self._neighbor_buffer()
-
-            stepped = False
-            if do_optimization and z is not None and self._step_fn is not None:
-                X_new, _gn = self._step_fn(jnp.asarray(start), z,
-                                           jnp.asarray(self._weights))
-                self.X = np.asarray(X_new)
-                stepped = True
-            elif accel:
-                self.X = self._Y.copy()  # updateX(false, true)
-
-            if accel:
+                if do_optimization and z is not None \
+                        and self._step_fn is not None:
+                    X_new, _gn, _rel = self._step_fn(
+                        jnp.asarray(Y), z, self._weights_device())
+                    self.X = np.asarray(X_new)
+                    stepped = True
+                else:
+                    self.X = self._Y.copy()  # updateX(false, true)
                 self._V = np.asarray(manifold.project(jnp.asarray(
                     self._V + self._gamma * (self.X - self._Y))))
-
-            rel = float(np.sqrt(np.sum((self.X - X_prev) ** 2) / max(self.n, 1)))
+                rel = float(np.sqrt(
+                    np.sum((self.X - X_prev) ** 2) / max(self.n, 1)))
+            else:
+                # Deployment fast path: X stays device-resident (the step
+                # consumes last round's output in place — with donation on
+                # accelerator backends the buffer is reused), the neighbor
+                # buffer re-uploads only after a scatter, and the host
+                # reads back ONE scalar (the relative change), not X.
+                z = self._neighbor_buffer()
+                rel = 0.0
+                if do_optimization and z is not None \
+                        and self._step_fn is not None:
+                    X_new, _gn, rel_dev = self._step_fn(
+                        self._X_device(), z, self._weights_device())
+                    self.X = X_new
+                    rel = float(rel_dev)
+                    stepped = True
             self._status.relative_change = rel
             ready = stepped and rel <= params.rel_change_tol
             if robust_on and params.robust.cost_type == RobustCostType.GNC_TLS:
@@ -874,9 +1096,9 @@ class PGOAgent:
                         params.robust_opt_min_convergence_ratio
             self._status.ready_to_terminate = bool(ready)
             if run is not None:
-                # self.X is a host array by here (``np.asarray(X_new)``
-                # materialized the step) — the latency below includes the
-                # device work, with no telemetry-added sync.
+                # The scalar rel-change readback above materialized the
+                # step — the latency below includes the device work, with
+                # no telemetry-added sync.
                 dt = time.perf_counter() - t0
                 run.histogram(
                     "agent_iterate_seconds",
@@ -1015,8 +1237,8 @@ class PGOAgent:
             z = self._neighbor_buffer()
             if z is None or self.X is None:
                 return None
-            buf = jnp.concatenate([jnp.asarray(self.X), z], axis=0)
-            edges = self._edges._replace(weight=jnp.asarray(self._weights))
+            buf = jnp.concatenate([self._X_device(), z], axis=0)
+            edges = self._edges._replace(weight=self._weights_device())
             return float(quadratic.cost(buf, edges))
 
 

@@ -9,11 +9,15 @@ asynchronous DPGO convergence result holds precisely *because* messages may
 be delayed, stale, or lost; this package makes the deployment path live up
 to that claim:
 
-* ``protocol`` — the wire format: length-prefixed ``npz`` frames (arrays
-  only, no pickle), with a validated frame-size cap (a corrupt or malicious
-  length header raises ``ProtocolError`` instead of attempting an OOM-sized
-  allocation) and an incremental ``FrameAssembler`` so a read deadline can
-  interrupt and later resume a partially received frame.
+* ``protocol`` — the wire format: length-prefixed frames (arrays only, no
+  pickle) in the packed columnar v2 codec (CRC32-protected, zero-copy
+  ``frombuffer`` decode, columnar pose sets with an opt-in bf16 payload)
+  with the v1 ``npz`` archive as a versioned fallback (receivers sniff
+  the magic, so mixed-version fleets interoperate), a validated
+  frame-size cap (a corrupt or malicious length header raises
+  ``ProtocolError`` instead of attempting an OOM-sized allocation) and an
+  incremental ``FrameAssembler`` so a read deadline can interrupt and
+  later resume a partially received frame.
 * ``transport`` — the ``Transport`` abstraction plus the two shipped
   implementations: ``LoopbackTransport`` (in-process pair, delay-aware
   inboxes) and ``TcpTransport`` (localhost/TCP, lifted out of
@@ -36,8 +40,11 @@ to that claim:
   is detected (closed transport, or consecutive misses with a stale
   heartbeat), excluded, and announced to the survivors, so the solve
   degrades gracefully instead of hanging.  ``BusClient`` is the robot-side
-  counterpart; ``pack_agent_frame`` / ``apply_peer_frame`` serialize the
-  ``PGOAgent`` message vocabulary onto the wire.
+  counterpart, with an overlapped mode (``start_overlap``) that
+  double-buffers the publish/collect round against the caller's compute
+  under a bounded-staleness knob; ``pack_agent_frame`` /
+  ``apply_peer_frame`` serialize the ``PGOAgent`` message vocabulary onto
+  the wire.
 
 Failure semantics on peer death: in async mode the dead robot's cached
 poses stay frozen in every survivor (the RA-L delay-tolerance argument —
@@ -51,15 +58,24 @@ from __future__ import annotations
 
 from .faults import FaultInjector, FaultSpec
 from .protocol import (
+    BF16_REL_ERR,
     DEFAULT_MAX_FRAME_BYTES,
+    PACKED_MAGIC,
     FrameAssembler,
     ProtocolError,
+    bf16_decode,
+    bf16_encode,
     decode_payload,
     encode_payload,
+    pack_pose_arrays,
     pack_pose_dict,
+    pack_pose_set,
+    pose_payload_nbytes,
     recv_frame,
     send_frame,
+    unpack_pose_arrays,
     unpack_pose_dict,
+    unpack_pose_set,
 )
 from .reliable import ChannelTotals, ReliableChannel, RetryPolicy
 from .transport import (
@@ -76,6 +92,7 @@ from .bus import (BusClient, RoundBus, apply_peer_frame,
                   loopback_fleet, pack_agent_frame)
 
 __all__ = [
+    "BF16_REL_ERR",
     "BusClient",
     "ChannelTotals",
     "DEFAULT_MAX_FRAME_BYTES",
@@ -83,6 +100,7 @@ __all__ = [
     "FaultSpec",
     "FrameAssembler",
     "LoopbackTransport",
+    "PACKED_MAGIC",
     "ProtocolError",
     "ReliableChannel",
     "RetryPolicy",
@@ -93,14 +111,21 @@ __all__ = [
     "TransportError",
     "TransportTimeout",
     "apply_peer_frame",
+    "bf16_decode",
+    "bf16_encode",
     "connect_tcp",
     "decode_payload",
     "encode_payload",
     "listen_tcp",
     "loopback_fleet",
     "pack_agent_frame",
+    "pack_pose_arrays",
     "pack_pose_dict",
+    "pack_pose_set",
+    "pose_payload_nbytes",
     "recv_frame",
     "send_frame",
+    "unpack_pose_arrays",
     "unpack_pose_dict",
+    "unpack_pose_set",
 ]

@@ -29,10 +29,14 @@ example, and the chaos tests so every path speaks the same protocol.
 
 from __future__ import annotations
 
+import threading
+import time
+
 import numpy as np
 
 from .. import obs
-from .protocol import pack_pose_dict, unpack_pose_dict
+from .protocol import (pack_pose_arrays, pack_pose_dict, unpack_pose_arrays,
+                       unpack_pose_set)
 from .reliable import ChannelTotals, ReliableChannel, RetryPolicy
 from .transport import TcpTransport, TransportClosed, TransportTimeout
 
@@ -44,7 +48,8 @@ from .transport import TcpTransport, TransportClosed, TransportTimeout
 def accept_robots(srv, num_robots: int, injector=None,
                   policy: RetryPolicy | None = None,
                   hello_timeout_s: float = 30.0,
-                  max_frame_bytes: int | None = None
+                  max_frame_bytes: int | None = None,
+                  wire_format: str = "packed"
                   ) -> dict[int, ReliableChannel]:
     """Accept one TCP connection per robot; each must introduce itself with
     a ``{"hello": robot_id}`` frame within the deadline."""
@@ -61,7 +66,8 @@ def accept_robots(srv, num_robots: int, injector=None,
                 f"within {hello_timeout_s}s") from None
         kw = {} if max_frame_bytes is None else \
             {"max_frame_bytes": max_frame_bytes}
-        t = TcpTransport(conn, src="bus", dst="?", injector=injector, **kw)
+        t = TcpTransport(conn, src="bus", dst="?", injector=injector,
+                         wire_format=wire_format, **kw)
         ch = ReliableChannel(t, policy=policy)
         hello = ch.recv(timeout=hello_timeout_s)
         rid = int(hello["hello"])
@@ -180,12 +186,35 @@ class RoundBus:
 # ---------------------------------------------------------------------------
 
 class BusClient:
-    """A robot's view of the bus: publish, collect, track lost peers."""
+    """A robot's view of the bus: publish, collect, track lost peers.
+
+    **Overlap mode** (``start_overlap``): a background exchange thread
+    double-buffers the publish/collect round so the caller's compute (the
+    RTR step) runs concurrently with the wire round.  ``exchange`` then
+    submits round k's frame and returns the freshest broadcast already
+    collected — typically round k-1's — blocking only when the number of
+    in-flight exchanges would exceed the ``staleness`` bound.  RBCD's
+    convergence is unchanged under bounded staleness (the RA-L 2020 async
+    DPGO model), so ``staleness=1`` overlaps compute and comms for free;
+    ``staleness=0`` (the default, no thread) is today's lockstep.  The
+    overlap composes with the sequence-number/dropout machinery unchanged:
+    publishes still ride the ``ReliableChannel`` (stamped ``_seq``), and
+    the worker's ``collect`` keeps ``lost`` current.
+    """
 
     def __init__(self, channel: ReliableChannel, robot_id: int):
         self.channel = channel
         self.robot_id = int(robot_id)
         self.lost: set[int] = set()
+        self.staleness = 0
+        self._ov_cond = threading.Condition()
+        self._ov_thread: threading.Thread | None = None
+        self._ov_queue: list[dict] = []
+        self._ov_merged: dict | None = None
+        self._ov_submitted = 0
+        self._ov_done = 0
+        self._ov_stop = False
+        self._ov_error: Exception | None = None
 
     def hello(self, timeout: float | None = None) -> None:
         self.channel.send({"hello": np.asarray(self.robot_id, np.int64)},
@@ -208,8 +237,95 @@ class BusClient:
 
     def exchange(self, frame: dict,
                  timeout: float | None = None) -> dict | None:
-        self.publish(frame, timeout=timeout)
-        return self.collect(timeout=timeout)
+        """One round's publish + broadcast.  Lockstep when no overlap
+        worker is running; with ``start_overlap`` the call returns the
+        freshest collected broadcast within the staleness bound (possibly
+        None before the first broadcast lands)."""
+        if self._ov_thread is None:
+            self.publish(frame, timeout=timeout)
+            return self.collect(timeout=timeout)
+        with self._ov_cond:
+            if self._ov_error is not None:
+                raise self._ov_error
+            self._ov_queue.append(frame)
+            self._ov_submitted += 1
+            self._ov_cond.notify_all()
+            while (self._ov_submitted - self._ov_done > self.staleness
+                   and self._ov_error is None):
+                self._ov_cond.wait(timeout=1.0)
+            if self._ov_error is not None:
+                raise self._ov_error
+            return self._ov_merged
+
+    # -- overlap worker -----------------------------------------------------
+
+    def start_overlap(self, staleness: int = 1,
+                      timeout: float | None = None) -> None:
+        """Enable double-buffered exchange with the given staleness bound
+        (max broadcast rounds the caller may run ahead of the wire;
+        ``staleness=0`` keeps lockstep and starts no thread)."""
+        if staleness <= 0 or self._ov_thread is not None:
+            self.staleness = max(0, int(staleness))
+            return
+        self.staleness = int(staleness)
+        self._ov_stop = False
+
+        def run():
+            while True:
+                with self._ov_cond:
+                    while not self._ov_queue and not self._ov_stop:
+                        self._ov_cond.wait()
+                    if self._ov_stop and not self._ov_queue:
+                        return
+                    frame = self._ov_queue.pop(0)
+                merged = None
+                err = None
+                try:
+                    self.publish(frame, timeout=timeout)
+                    merged = self.collect(timeout=timeout)
+                except TransportClosed as e:
+                    err = e
+                except Exception as e:  # surfaced to the next exchange()
+                    err = e
+                with self._ov_cond:
+                    self._ov_done += 1
+                    if merged is not None:
+                        self._ov_merged = merged
+                    if err is not None:
+                        self._ov_error = err
+                    self._ov_cond.notify_all()
+                    if err is not None:
+                        return
+
+        self._ov_thread = threading.Thread(
+            target=run, name=f"bus-overlap-{self.robot_id}", daemon=True)
+        self._ov_thread.start()
+
+    def drain_overlap(self, timeout: float = 30.0) -> dict | None:
+        """Block until every submitted exchange completed (the lockstep
+        barrier at the end of an overlapped run); returns the last
+        broadcast.  Raises the worker's pending error, if any."""
+        if self._ov_thread is None:
+            return self._ov_merged
+        end = time.monotonic() + timeout
+        with self._ov_cond:
+            while self._ov_submitted > self._ov_done:
+                if self._ov_error is not None:
+                    raise self._ov_error
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._ov_cond.wait(timeout=remaining)
+            return self._ov_merged
+
+    def stop_overlap(self) -> None:
+        if self._ov_thread is None:
+            return
+        with self._ov_cond:
+            self._ov_stop = True
+            self._ov_cond.notify_all()
+        self._ov_thread.join(timeout=10.0)
+        self._ov_thread = None
 
     def peer_frames(self, merged: dict) -> dict[int, dict]:
         """Split a broadcast into per-peer sub-frames (self excluded)."""
@@ -225,13 +341,15 @@ class BusClient:
         return out
 
     def close(self) -> None:
+        self.stop_overlap()
         self.channel.close()
 
 
 def loopback_fleet(num_robots: int, injector=None,
                    policy: RetryPolicy | None = None,
                    round_timeout_s: float = 2.0, miss_limit: int = 3,
-                   liveness_timeout_s: float = 2.0
+                   liveness_timeout_s: float = 2.0,
+                   wire_format: str = "packed"
                    ) -> tuple[RoundBus, dict[int, BusClient]]:
     """An in-process fleet: one ``LoopbackTransport`` pair per robot, the
     hub ends assembled into a ``RoundBus``, the robot ends into
@@ -244,7 +362,8 @@ def loopback_fleet(num_robots: int, injector=None,
     clients: dict[int, BusClient] = {}
     for rid in range(num_robots):
         t_bus, t_robot = LoopbackTransport.pair(
-            "bus", f"robot{rid}", injector=injector)
+            "bus", f"robot{rid}", injector=injector,
+            wire_format=wire_format)
         channels[rid] = ReliableChannel(t_bus, f"bus->robot{rid}", policy)
         clients[rid] = BusClient(
             ReliableChannel(t_robot, f"robot{rid}->bus", policy), rid)
@@ -259,15 +378,28 @@ def loopback_fleet(num_robots: int, injector=None,
 # ---------------------------------------------------------------------------
 
 def pack_agent_frame(agent, robust: bool = False,
-                     include_anchor: bool = False) -> dict:
+                     include_anchor: bool = False,
+                     wire_dtype: str = "f64",
+                     packed: bool = True) -> dict:
     """One round's outgoing frame for a ``PGOAgent``: status gossip, public
-    poses, owned GNC weights, and (robot 0) the global anchor."""
+    poses, owned GNC weights, and (robot 0) the global anchor.
+
+    ``packed=True`` (default) ships the public poses as one columnar
+    ``pose:r/pose:p/pose:x`` set (``wire_dtype`` selects f64/f32/bf16 on
+    the wire); ``packed=False`` keeps the per-pose v1 keys for old peers.
+    ``apply_peer_frame`` ingests either."""
     st = agent.get_status()
     frame = {"status": np.asarray(
         [st.robot_id, st.state.value, st.instance_number,
          st.iteration_number, int(st.ready_to_terminate)], np.int64),
         "relchange": np.asarray(st.relative_change, np.float64)}
-    frame.update(pack_pose_dict("pose", agent.get_shared_pose_dict()))
+    if packed:
+        pub = agent.get_public_pose_arrays()
+        if pub is not None:
+            frame.update(pack_pose_arrays("pose", *pub,
+                                          wire_dtype=wire_dtype))
+    else:
+        frame.update(pack_pose_dict("pose", agent.get_shared_pose_dict()))
     if robust:
         frame.update({
             f"wt_{r1}_{p1}_{r2}_{p2}": np.asarray(w, np.float64)
@@ -294,8 +426,14 @@ def apply_peer_frame(agent, peer_id: int, pf: dict, robust: bool = False,
             ready_to_terminate=bool(ps[4]),
             relative_change=float(pf.get("relchange", np.inf))))
     seq = int(pf["_pseq"]) if "_pseq" in pf else None
-    agent.update_neighbor_poses(peer_id, unpack_pose_dict(pf, "pose"),
-                                sequence=seq)
+    packed = unpack_pose_arrays(pf, "pose")
+    if packed is not None:
+        # Fast path: the columnar set feeds the agent's vectorized
+        # neighbor-buffer scatter with no per-pose dict materialization.
+        agent.update_neighbor_poses_packed(peer_id, *packed, sequence=seq)
+    else:
+        agent.update_neighbor_poses(peer_id, unpack_pose_set(pf, "pose"),
+                                    sequence=seq)
     if robust:
         wd = {}
         for k, v in pf.items():

@@ -1,18 +1,40 @@
-"""Wire format: length-prefixed ``npz`` frames (arrays only — no pickle).
+"""Wire format: length-prefixed frames (arrays only — no pickle).
 
 A frame on the wire is an 8-byte little-endian unsigned length followed by
-an ``np.savez`` archive.  The length header is *untrusted input*: it is
-validated against a configurable cap (default 64 MiB) before any buffer is
-sized from it, so a corrupt or malicious header raises a clean
-``ProtocolError`` instead of attempting an OOM-sized allocation.  Payload
-decoding likewise wraps ``np.load`` failures (bit-flipped archives) in
-``ProtocolError`` so the fault-tolerance layer can count and drop corrupt
-frames rather than crash the robot.
+a payload in one of two self-describing formats:
+
+* **packed (v2, the default)** — a raw little-endian columnar encoding:
+  magic ``DPW2``, a CRC32 of the body, then per entry a UTF-8 key, the
+  numpy dtype string, the shape, and the array bytes verbatim
+  (``tobytes``).  Decoding is zero-copy: each array is a ``frombuffer``
+  view into the received byte buffer, so a pose frame costs one
+  allocation for the socket read and nothing per array.
+* **npz (v1, the versioned fallback)** — an ``np.savez`` archive (one zip
+  member per array).  Old peers send this; ``decode_payload`` sniffs the
+  leading magic, so a fleet can mix v1 and v2 senders during a rolling
+  upgrade (``Transport(wire_format="npz")`` keeps a new robot speaking v1
+  to an old bus).
+
+The length header is *untrusted input*: it is validated against a
+configurable cap (default 64 MiB) before any buffer is sized from it, so a
+corrupt or malicious header raises a clean ``ProtocolError`` instead of
+attempting an OOM-sized allocation.  Payload decoding likewise wraps
+failures (bit-flipped archives, CRC mismatches, truncated packed bodies)
+in ``ProtocolError`` so the fault-tolerance layer can count and drop
+corrupt frames rather than crash the robot.
 
 ``FrameAssembler`` is the incremental decoder used by the deadline-aware
 TCP transport: bytes are fed in as they arrive, complete payloads come out,
 and a recv deadline can interrupt mid-frame and resume later without
 desynchronizing the stream.
+
+Pose-set packing (the deployment hot path): ``pack_pose_set`` lays a
+``{(robot, pose): block}`` dict out as ONE contiguous ``[k, r, d+1]``
+payload plus int32 robot/pose index vectors — three arrays total instead
+of one zip member per pose — with an opt-in bf16 wire dtype (values are
+rounded to bfloat16 on send and accumulated in f32/f64 on receipt; see
+``bf16_encode``).  ``pack_pose_dict`` remains the per-pose v1 vocabulary;
+``unpack_pose_set`` reads either.
 """
 
 from __future__ import annotations
@@ -20,28 +42,118 @@ from __future__ import annotations
 import io
 import socket
 import struct
+import zlib
 
 import numpy as np
 
 HEADER = struct.Struct("<Q")
 DEFAULT_MAX_FRAME_BYTES = 64 * 2 ** 20  # 64 MiB
 
+#: Packed-payload (v2) leading magic.  An npz body starts with zip's
+#: ``PK\x03\x04``, so the first bytes unambiguously select the decoder.
+PACKED_MAGIC = b"DPW2"
+_PACKED_HEAD = struct.Struct("<4sII")     # magic, crc32(body), n_entries
+_ENTRY_HEAD = struct.Struct("<HBB")       # key_len, dtype_len, ndim
+
 
 class ProtocolError(Exception):
     """The byte stream violates the frame protocol (oversized length
-    header, truncated/corrupt npz payload).  Distinct from transport errors:
-    the connection may still be usable — the *frame* is bad."""
+    header, truncated/corrupt payload, CRC mismatch).  Distinct from
+    transport errors: the connection may still be usable — the *frame* is
+    bad."""
 
 
-def encode_payload(arrays: dict) -> bytes:
-    """Serialize an array dict to npz bytes (the frame body, no header)."""
+def encode_payload_npz(arrays: dict) -> bytes:
+    """Serialize an array dict to npz bytes (the v1 frame body)."""
     buf = io.BytesIO()
     np.savez(buf, **arrays)
     return buf.getvalue()
 
 
+def encode_payload_packed(arrays: dict) -> bytes:
+    """Serialize an array dict to the packed v2 frame body: raw
+    little-endian header + ``tobytes`` per array, CRC32-protected."""
+    parts = []
+    for key, arr in arrays.items():
+        a = np.asarray(arr)
+        kb = key.encode("utf-8")
+        dt = np.dtype(a.dtype).str.encode("ascii")
+        if len(kb) > 0xFFFF or len(dt) > 0xFF or a.ndim > 0xFF:
+            raise ProtocolError(f"unencodable entry {key!r}: "
+                                f"key/dtype/ndim out of range")
+        parts.append(_ENTRY_HEAD.pack(len(kb), len(dt), a.ndim))
+        parts.append(kb)
+        parts.append(dt)
+        parts.append(struct.pack(f"<{a.ndim}I", *a.shape))
+        parts.append(struct.pack("<Q", a.nbytes))
+        parts.append(np.ascontiguousarray(a).tobytes())
+    body = b"".join(parts)
+    return _PACKED_HEAD.pack(PACKED_MAGIC, zlib.crc32(body),
+                             len(arrays)) + body
+
+
+def decode_payload_packed(data: bytes) -> dict:
+    """Decode a packed v2 body into ``frombuffer`` views (zero-copy: the
+    returned arrays alias ``data`` and are read-only)."""
+    try:
+        magic, crc, n_entries = _PACKED_HEAD.unpack_from(data, 0)
+        if magic != PACKED_MAGIC:
+            raise ProtocolError("bad packed-frame magic")
+        body = memoryview(data)[_PACKED_HEAD.size:]
+        if zlib.crc32(body) != crc:
+            raise ProtocolError("packed-frame CRC mismatch")
+        out = {}
+        pos = 0
+        for _ in range(n_entries):
+            key_len, dt_len, ndim = _ENTRY_HEAD.unpack_from(body, pos)
+            pos += _ENTRY_HEAD.size
+            key = bytes(body[pos:pos + key_len]).decode("utf-8")
+            pos += key_len
+            dt = np.dtype(bytes(body[pos:pos + dt_len]).decode("ascii"))
+            pos += dt_len
+            shape = struct.unpack_from(f"<{ndim}I", body, pos)
+            pos += 4 * ndim
+            (nbytes,) = struct.unpack_from("<Q", body, pos)
+            pos += 8
+            count = int(np.prod(shape, dtype=np.int64)) if ndim else 1
+            if nbytes != count * dt.itemsize or pos + nbytes > len(body):
+                raise ProtocolError(
+                    f"packed entry {key!r} inconsistent with body")
+            # 0-d entries reshape to () like their npz counterparts.
+            arr = np.frombuffer(body, dt, count,
+                                offset=pos).reshape(shape)
+            pos += nbytes
+            out[key] = arr
+        if pos != len(body):
+            raise ProtocolError(f"{len(body) - pos} trailing bytes after "
+                                "the last packed entry")
+        return out
+    except ProtocolError:
+        raise
+    except Exception as e:  # struct/unicode/dtype errors on mangled bytes
+        raise ProtocolError(f"corrupt packed frame ({len(data)} bytes): "
+                            f"{e}") from e
+
+
+def encode_payload(arrays: dict, wire_format: str = "packed") -> bytes:
+    """Serialize an array dict to a frame body (no length header).
+
+    ``wire_format="packed"`` (default) emits the v2 columnar layout;
+    ``"npz"`` keeps the v1 archive for old peers.  ``decode_payload``
+    accepts either regardless of what this endpoint sends.
+    """
+    if wire_format == "npz":
+        return encode_payload_npz(arrays)
+    if wire_format != "packed":
+        raise ValueError(f"unknown wire_format {wire_format!r}")
+    return encode_payload_packed(arrays)
+
+
 def decode_payload(data: bytes) -> dict:
-    """Decode npz bytes; a mangled archive raises ``ProtocolError``."""
+    """Decode a frame body, sniffing the format off the leading magic; a
+    mangled body of either format raises ``ProtocolError``."""
+    if data[:4] == PACKED_MAGIC:
+        return decode_payload_packed(data)
     try:
         with np.load(io.BytesIO(data)) as npz:
             return {k: npz[k] for k in npz.files}
@@ -50,8 +162,8 @@ def decode_payload(data: bytes) -> dict:
                             f"{e}") from e
 
 
-def encode_frame(arrays: dict) -> bytes:
-    data = encode_payload(arrays)
+def encode_frame(arrays: dict, wire_format: str = "packed") -> bytes:
+    data = encode_payload(arrays, wire_format)
     return HEADER.pack(len(data)) + data
 
 
@@ -130,11 +242,38 @@ def recv_frame(sock: socket.socket,
 
 
 # ---------------------------------------------------------------------------
+# bf16 wire dtype (opt-in): round-to-nearest-even truncation to the high
+# 16 bits of f32, shipped as uint16 — dependency-free (no ml_dtypes on the
+# wire) and codec-agnostic (rides packed v2 and npz alike).
+# ---------------------------------------------------------------------------
+
+#: Documented bf16 wire parity bound: round-to-nearest bfloat16 keeps 7
+#: explicit mantissa bits, so per-element relative error is at most
+#: 2^-8 (half an ULP).  Tests assert round-trip error against this.
+BF16_REL_ERR = 2.0 ** -8
+
+
+def bf16_encode(arr: np.ndarray) -> np.ndarray:
+    """f32/f64 -> uint16 holding the round-to-nearest-even bfloat16 bits."""
+    f = np.ascontiguousarray(arr, np.float32)
+    u = f.view(np.uint32)
+    u = u + 0x7FFF + ((u >> 16) & 1)  # RNE: break ties toward even
+    return (u >> 16).astype(np.uint16)
+
+
+def bf16_decode(u16: np.ndarray) -> np.ndarray:
+    """uint16 bfloat16 bits -> f32 (exact: bf16 embeds in f32)."""
+    u = np.asarray(u16, np.uint32) << np.uint32(16)
+    return u.view(np.float32)
+
+
+# ---------------------------------------------------------------------------
 # Pose-dictionary packing (the agent message vocabulary on the wire)
 # ---------------------------------------------------------------------------
 
 def pack_pose_dict(prefix: str, pose_dict: dict) -> dict:
-    """Flatten {(robot, pose): block} to npz-safe ``{prefix}_{r}_{p}`` keys."""
+    """Flatten {(robot, pose): block} to npz-safe ``{prefix}_{r}_{p}`` keys
+    (the v1 per-pose vocabulary — one frame entry per pose block)."""
     return {f"{prefix}_{r}_{p}": np.asarray(block)
             for (r, p), block in pose_dict.items()}
 
@@ -146,3 +285,79 @@ def unpack_pose_dict(frame: dict, prefix: str) -> dict:
             _, r, p = key.rsplit("_", 2)
             out[(int(r), int(p))] = arr
     return out
+
+
+# -- packed pose sets (v2 vocabulary: 3 frame entries for ANY pose count) ---
+
+def pack_pose_arrays(prefix: str, robots: np.ndarray, poses: np.ndarray,
+                     vals: np.ndarray, wire_dtype: str = "f64") -> dict:
+    """Columnar pose payload: ``{prefix}:r`` / ``{prefix}:p`` int32 index
+    vectors plus one contiguous ``[k, r, d+1]`` value payload
+    (``{prefix}:x``, or ``{prefix}:xb`` uint16 when ``wire_dtype="bf16"``).
+    """
+    out = {f"{prefix}:r": np.asarray(robots, np.int32),
+           f"{prefix}:p": np.asarray(poses, np.int32)}
+    if wire_dtype == "bf16":
+        out[f"{prefix}:xb"] = bf16_encode(vals)
+    elif wire_dtype == "f32":
+        out[f"{prefix}:x"] = np.asarray(vals, np.float32)
+    elif wire_dtype == "f64":
+        out[f"{prefix}:x"] = np.asarray(vals, np.float64)
+    else:
+        raise ValueError(f"unknown wire_dtype {wire_dtype!r}")
+    return out
+
+
+def pack_pose_set(prefix: str, pose_dict: dict,
+                  wire_dtype: str = "f64") -> dict:
+    """``pack_pose_arrays`` from a ``{(robot, pose): block}`` dict."""
+    if not pose_dict:
+        return {}
+    keys = list(pose_dict)
+    robots = np.fromiter((k[0] for k in keys), np.int32, len(keys))
+    poses = np.fromiter((k[1] for k in keys), np.int32, len(keys))
+    vals = np.stack([np.asarray(pose_dict[k]) for k in keys])
+    return pack_pose_arrays(prefix, robots, poses, vals, wire_dtype)
+
+
+def unpack_pose_arrays(frame: dict, prefix: str):
+    """The packed-pose fast path: ``(robots, poses, vals_f64)`` with no
+    per-pose Python, or None when the frame carries no packed set under
+    ``prefix``.  bf16 payloads are widened through f32 on receipt (f32
+    accumulate) before the f64 cast."""
+    ri = frame.get(f"{prefix}:r")
+    if ri is None:
+        return None
+    pi = frame[f"{prefix}:p"]
+    xb = frame.get(f"{prefix}:xb")
+    if xb is not None:
+        vals = np.asarray(bf16_decode(np.asarray(xb)), np.float64)
+    else:
+        vals = np.asarray(frame[f"{prefix}:x"], np.float64)
+    return (np.asarray(ri, np.int64).ravel(),
+            np.asarray(pi, np.int64).ravel(), vals)
+
+
+def unpack_pose_set(frame: dict, prefix: str) -> dict:
+    """Pose dict from a frame in EITHER vocabulary: the packed ``:r/:p/:x``
+    triplet when present, else the per-pose v1 keys."""
+    packed = unpack_pose_arrays(frame, prefix)
+    if packed is None:
+        return unpack_pose_dict(frame, prefix)
+    robots, poses, vals = packed
+    return {(int(r), int(p)): vals[i]
+            for i, (r, p) in enumerate(zip(robots, poses))}
+
+
+def pose_payload_nbytes(frame: dict, prefix: str) -> int:
+    """Wire bytes of the pose set under ``prefix`` — read off the packed
+    entries directly (no per-block iteration) when present."""
+    n = 0
+    for suffix in (":r", ":p", ":x", ":xb"):
+        arr = frame.get(prefix + suffix)
+        if arr is not None:
+            n += np.asarray(arr).nbytes
+    if n:
+        return n
+    return sum(np.asarray(v).nbytes for k, v in frame.items()
+               if k.startswith(prefix + "_"))

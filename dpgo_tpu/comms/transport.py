@@ -51,15 +51,24 @@ class TransportTimeout(TimeoutError):
 
 
 class Transport:
-    """One endpoint of a bidirectional frame link."""
+    """One endpoint of a bidirectional frame link.
+
+    ``wire_format`` selects the OUTGOING payload encoding: ``"packed"``
+    (default, the v2 zero-copy columnar codec) or ``"npz"`` (the v1
+    archive, kept so a new robot can keep speaking v1 to an old bus).
+    Receives always auto-detect the format off the payload magic, so
+    mixed-version fleets interoperate.
+    """
 
     def __init__(self, src="", dst="",
                  injector: FaultInjector | None = None,
-                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                 wire_format: str = "packed"):
         self.src = src
         self.dst = dst
         self.injector = injector
         self.max_frame_bytes = int(max_frame_bytes)
+        self.wire_format = wire_format
 
     def send(self, arrays: dict, timeout: float | None = None) -> int:
         """Send one frame; returns wire bytes of the *intended* frame (what
@@ -78,7 +87,7 @@ class Transport:
     # -- shared helpers -----------------------------------------------------
 
     def _encode_checked(self, arrays: dict) -> bytes:
-        data = encode_payload(arrays)
+        data = encode_payload(arrays, self.wire_format)
         if len(data) > self.max_frame_bytes:
             raise ProtocolError(
                 f"outgoing frame ({len(data)} bytes) exceeds the "
@@ -140,21 +149,23 @@ class LoopbackTransport(Transport):
 
     def __init__(self, src, dst, inbox: _Inbox, peer_inbox: _Inbox,
                  injector: FaultInjector | None = None,
-                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
-        super().__init__(src, dst, injector, max_frame_bytes)
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                 wire_format: str = "packed"):
+        super().__init__(src, dst, injector, max_frame_bytes, wire_format)
         self._inbox = inbox
         self._peer_inbox = peer_inbox
         self._closed = False
 
     @classmethod
     def pair(cls, a="a", b="b", injector: FaultInjector | None = None,
-             max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES
+             max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+             wire_format: str = "packed"
              ) -> tuple["LoopbackTransport", "LoopbackTransport"]:
         """Two connected endpoints; ``a``/``b`` name the ends for the
         injector's per-link RNG streams and partition groups."""
         ia, ib = _Inbox(), _Inbox()
-        return (cls(a, b, ia, ib, injector, max_frame_bytes),
-                cls(b, a, ib, ia, injector, max_frame_bytes))
+        return (cls(a, b, ia, ib, injector, max_frame_bytes, wire_format),
+                cls(b, a, ib, ia, injector, max_frame_bytes, wire_format))
 
     def send(self, arrays: dict, timeout: float | None = None) -> int:
         if self._closed:
@@ -192,8 +203,9 @@ class TcpTransport(Transport):
 
     def __init__(self, sock: socket.socket, src="", dst="",
                  injector: FaultInjector | None = None,
-                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
-        super().__init__(src, dst, injector, max_frame_bytes)
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                 wire_format: str = "packed"):
+        super().__init__(src, dst, injector, max_frame_bytes, wire_format)
         self._sock = sock
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)

@@ -517,8 +517,16 @@ def lambda_min_f64_shift_invert(X64, edges: EdgeSet, tol_cert: float,
             return rq_l, v_l.reshape(n, dh), 0.0
         if defl_ok and lam_l_full - r_l >= -tol_cert:
             return lam_l_full, v_l.reshape(n, dh), r_l
-    except Exception:
-        pass  # fall through to shift-invert
+    except (np.linalg.LinAlgError, ValueError) as e:
+        # The EXPECTED numerical failures of deflated LOBPCG (singular
+        # Gram/basis breakdown -> LinAlgError; degenerate block shapes ->
+        # ValueError) fall through to shift-invert.  Anything else (a
+        # programming error, keyboard interrupt, OOM) propagates — the
+        # old blanket ``except Exception: pass`` hid those too.
+        import warnings
+        warnings.warn(
+            f"gauge-deflated LOBPCG pass failed with {type(e).__name__}: "
+            f"{e}; falling through to shift-invert", RuntimeWarning)
 
     # Pass 3 — shift-invert at the threshold: the sparse LU of
     # S + tol I separates the near-zero clusters (gauge + graph bands)

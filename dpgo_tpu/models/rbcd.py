@@ -493,9 +493,37 @@ def _edge_tile_shape(n_max: int, s_max: int, e_max: int,
         T = TILE
     else:
         T = TILE if (n_max + s_max) <= 1024 else TILE // 2
-    import os
-    T = int(os.environ.get("PALLAS_TILE", T))  # A/B override (round 5)
+    T = _ab_tile_override(T)
     return T, max(1, -(-e_max // T))
+
+
+def _ab_tile_override(T: int) -> int:
+    """The round-5 ``PALLAS_TILE`` A/B override, scoped OUT of the
+    production path: it only applies when ``DPGO_AB=1`` is also set, the
+    value must be a positive lane multiple (128), and an active override
+    is logged — a PALLAS_TILE leaked into a normal shell previously
+    retiled every solve silently and could reproduce the Mosaic VMEM
+    abort the adaptive tile exists to avoid."""
+    import os
+    import sys
+
+    raw = os.environ.get("PALLAS_TILE")
+    if raw is None:
+        return T
+    if os.environ.get("DPGO_AB") != "1":
+        return T  # experiments only opt in explicitly
+    try:
+        t = int(raw)
+    except ValueError:
+        raise ValueError(f"PALLAS_TILE={raw!r} is not an integer") from None
+    if t <= 0 or t % 128 != 0:
+        raise ValueError(
+            f"PALLAS_TILE={t} invalid: must be a positive multiple of the "
+            "128-lane tile width")
+    if t != T:
+        print(f"[dpgo_tpu] DPGO_AB: PALLAS_TILE override {T} -> {t}",
+              file=sys.stderr)
+    return t
 
 
 def pallas_vmem_ok(n_max: int, s_max: int, rank: int, d: int, T: int,

@@ -30,6 +30,15 @@ def _fmt(v) -> str:
     return str(v)
 
 
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
 def _trajectory_lines(events: list[dict], metric: str) -> list[str]:
     pts = [(ev.get("iteration", ev["seq"]), ev["value"]) for ev in events
            if ev.get("event") == "metric" and ev.get("metric") == metric
@@ -116,6 +125,10 @@ def render_report(run_dir: str) -> str:
             for ev in summaries:
                 parts = [f"{ev.get('messages_received', 0)} in / "
                          f"{ev.get('messages_sent', 0)} out"]
+                if ev.get("bytes_sent") or ev.get("bytes_received"):
+                    parts.append(
+                        f"{_fmt_bytes(ev.get('bytes_received', 0))} in / "
+                        f"{_fmt_bytes(ev.get('bytes_sent', 0))} out wire")
                 for key, label in (("retries", "retries"),
                                    ("timeouts", "timeouts"),
                                    ("stale_dropped", "stale"),
@@ -126,6 +139,20 @@ def render_report(run_dir: str) -> str:
                     parts.append(f"peers lost {ev['peers_lost']}")
                 lines.append(f"  {ev.get('channel', '?')}: "
                              + ", ".join(parts))
+        # Deployment fast-path numbers (bench_deployment.py metric events).
+        deploy = [ev for ev in events if ev.get("event") == "metric"
+                  and str(ev.get("metric", "")).startswith(
+                      "deployment_rounds_per_sec")]
+        for ev in deploy:
+            extras = []
+            if ev.get("speedup_vs_legacy") is not None:
+                extras.append(f"{ev['speedup_vs_legacy']}x vs legacy wire")
+            if ev.get("staleness") is not None:
+                extras.append(f"staleness {ev['staleness']}")
+            lines.append(
+                f"deployment bench: {_fmt(ev.get('value'))} "
+                f"{ev.get('unit', '')}".rstrip()
+                + (f" ({', '.join(extras)})" if extras else ""))
         losses = [ev for ev in events if ev.get("event") == "peer_lost"]
         if losses:
             for ev in losses:

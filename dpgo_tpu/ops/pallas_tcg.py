@@ -70,16 +70,31 @@ HI = jax.lax.Precision.HIGHEST
 #: Edge-tile lane width: tiles are [n, T] one-hots and [*, T] payload rows.
 TILE = 256
 
-#: Experiment gates (read once at import; experiments/kernel_breakdown.py
-#: A/Bs these at the 100k shape — see BASELINE.md round-5 VPU entry).
-_UNROLL_TILES = os.environ.get("PALLAS_UNROLL_TILES", "0") == "1"
-_NS_SWEEPS = int(os.environ.get("PALLAS_NS_SWEEPS", "24"))
-#: Packed selection is the production DEFAULT (round-5 A/B at 100k/64:
-#: bf16x3 33.8 -> 50.1 rounds/s from this alone — the kernel is
-#: dot-ISSUE-bound there, and packing the split passes into one
-#: row-stacked dot cuts issues 3x at identical MACs).  f32 mode is
-#: unaffected (no split passes).  "0" restores per-pass dots.
-_SEL_PACKED = os.environ.get("PALLAS_SEL_PACKED", "1") == "1"
+def _ab_gates() -> SimpleNamespace:
+    """Experiment gates, read at KERNEL-BUILD time (inside ``_build_math``)
+    rather than import time, so they are toggleable per-process and
+    testable (a test can set the env var, rebuild a kernel, and unset it —
+    no interpreter restart).  experiments/kernel_breakdown.py A/Bs these
+    at the 100k shape — see BASELINE.md round-5 VPU entry.
+
+    * ``PALLAS_UNROLL_TILES`` — static-unroll the edge-tile loop
+      (measured dead end at 100k: VMEM overflow; default off).
+    * ``PALLAS_NS_SWEEPS`` — Newton-Schulz sweeps in the retraction.
+    * ``PALLAS_SEL_PACKED`` — packed selection is the production DEFAULT
+      (round-5 A/B at 100k/64: bf16x3 33.8 -> 50.1 rounds/s from this
+      alone — the kernel is dot-ISSUE-bound there, and packing the split
+      passes into one row-stacked dot cuts issues 3x at identical MACs).
+      f32 mode is unaffected (no split passes).  "0" restores per-pass
+      dots.
+
+    NOTE: jit/pallas caches key on shapes and function identity, not on
+    these env vars — toggling a gate affects kernels built AFTER the
+    toggle, not already-compiled ones.
+    """
+    return SimpleNamespace(
+        unroll_tiles=os.environ.get("PALLAS_UNROLL_TILES", "0") == "1",
+        ns_sweeps=int(os.environ.get("PALLAS_NS_SWEEPS", "24")),
+        sel_packed=os.environ.get("PALLAS_SEL_PACKED", "1") == "1")
 
 
 def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
@@ -116,6 +131,7 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
     T = idx_i_ref.shape[-1]
     f32 = jnp.float32
     eps = jnp.asarray(1e-30, f32)
+    gates = _ab_gates()  # read per kernel build, not per import
 
     def q(a, c):  # component row of pose-block entry (a, c)
         return a * k + c
@@ -153,7 +169,7 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         # operands and no precision, Mosaic resolves contract precision to
         # fp32 and rejects the matmul ("Bad lhs type").
         parts = _split(V, sel_passes)
-        if _SEL_PACKED:
+        if gates.sel_packed:
             # PACKED: one dot on the row-stacked splits instead of
             # ``sel_passes`` separate dots.  At the 100k shape the kernel
             # is dot-ISSUE-bound, not MAC-bound (round-5 breakdown) —
@@ -213,7 +229,7 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         local_sel2 = lambda ti: onehot2(idx_i_ref[ti], idx_j_ref[ti], n, 0)
 
     def tile_loop(tile_fn, init):
-        if _UNROLL_TILES:
+        if gates.unroll_tiles:
             # Static unroll: nt is compile-time, so the Python loop frees
             # Mosaic to software-pipeline each tile's MXU dots against the
             # previous tile's VPU edge math (the fori_loop body is a
@@ -570,7 +586,7 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
             T_ = 0.5 * (3.0 * eye - matmul3(Z, Y))
             return matmul3(Y, T_), matmul3(T_, Z)
 
-        _, Zc = jax.lax.fori_loop(0, _NS_SWEEPS, sweep, (An, eye))
+        _, Zc = jax.lax.fori_loop(0, gates.ns_sweeps, sweep, (An, eye))
         inv_sqrt_s = jax.lax.rsqrt(s)
         out = [None] * rk
         for a in range(r):

@@ -465,14 +465,23 @@ def certify_sharded(X, graph: MultiAgentGraph, mesh=None,
         pmask = np.asarray(graph.pose_mask) > 0
         warm = np.zeros((Xg64.shape[0], Xg64.shape[2]))
         warm[gi[pmask]] = np.asarray(direction, np.float64)[pmask]
-        lam64, _, resid = lambda_min_f64(np.asarray(Xg64, np.float64),
-                                         edges_g, warm=warm, tol=t,
-                                         tol_cert=tol)
-        return lam64, None, resid
+        lam64, v64, resid = lambda_min_f64(np.asarray(Xg64, np.float64),
+                                           edges_g, warm=warm, tol=t,
+                                           tol_cert=tol)
+        # Scatter the polished f64 eigenvector back to the per-agent
+        # layout via global_index so a failing certificate hands the
+        # staircase the f64 descent direction, not the stale f32 one.
+        vec_pa = None
+        if v64 is not None:
+            vec_pa = np.zeros(np.asarray(direction).shape, np.float64)
+            vec_pa[pmask] = np.asarray(v64, np.float64)[gi[pmask]]
+        return lam64, vec_pa, resid
 
-    certified, decidable, _, lam_f64, _ = decide_certificate(
+    certified, decidable, _, lam_f64, vec64 = decide_certificate(
         lam_min_f, sigma_f, tol, float(jnp.finfo(jnp.asarray(X).dtype).eps),
         f64_solve if global_ctx is not None else None)
+    if vec64 is not None:
+        direction = jnp.asarray(vec64, jnp.asarray(direction).dtype)
     return CertificateResult(
         certified=certified,
         lambda_min=lam_min_f,

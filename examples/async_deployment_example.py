@@ -49,6 +49,16 @@ def main() -> None:
     ap.add_argument("--timeout", type=float, default=30.0,
                     help="wall-clock budget in seconds")
     ap.add_argument("--log-dir", default=None)
+    ap.add_argument("--staleness", type=int, default=1,
+                    help="network-loop overlap bound: >=1 double-buffers "
+                         "each robot's publish/collect against its "
+                         "optimizer (default 1 — async mode has no "
+                         "lockstep to preserve); 0 reverts to "
+                         "publish-then-wait per tick")
+    ap.add_argument("--wire-dtype", choices=("f64", "f32", "bf16"),
+                    default="f64",
+                    help="pose payload dtype on the wire (bf16 halves "
+                         "pose bytes vs f32, f32-accumulated on receipt)")
     ap.add_argument("--fault-drop", type=float, default=0.0)
     ap.add_argument("--fault-delay", type=float, default=0.0)
     ap.add_argument("--fault-delay-s", type=float, nargs=2,
@@ -112,17 +122,22 @@ def main() -> None:
         # One last broadcast flushes pending `_lost` knowledge.
 
     def robot_loop(ag: PGOAgent):
-        """One network tick per iteration: publish status + public poses,
-        collect the broadcast, ingest peers (sequence-checked), track lost
-        robots.  A missed broadcast skips one update — never a hang."""
+        """One network tick per iteration: publish status + public poses
+        (packed columnar wire), collect the broadcast, ingest peers
+        (sequence-checked), track lost robots.  A missed broadcast skips
+        one update — never a hang.  With --staleness >= 1 the
+        publish/collect round runs on the client's overlap thread so this
+        loop never blocks the tick cadence on the wire."""
         rid = ag.robot_id
         client = clients[rid]
         client.channel.start_heartbeat(tick / 2)
+        if args.staleness > 0:
+            client.start_overlap(args.staleness, timeout=2 * tick)
         while not stop.is_set():
-            frame = pack_agent_frame(ag, include_anchor=(rid == 0))
+            frame = pack_agent_frame(ag, include_anchor=(rid == 0),
+                                     wire_dtype=args.wire_dtype)
             try:
-                client.publish(frame, timeout=tick)
-                merged = client.collect(timeout=2 * tick)
+                merged = client.exchange(frame, timeout=2 * tick)
             except TransportClosed:
                 return  # killed, or the run is over
             if merged is not None:

@@ -2,11 +2,15 @@
 """N-process TCP deployment demo on the fault-tolerant comms subsystem.
 
 Each robot is its own OS process holding one ``PGOAgent``; the deployment
-message set — ``get_shared_pose_dict`` / ``update_neighbor_poses``, status
-gossip, GNC weight publication, lifting-matrix and global-anchor broadcast
-— travels over localhost TCP as length-prefixed ``npz`` frames.  The
-launcher doubles as the message bus (the pub/sub role dpgo_ros plays in
-the reference's deployments).
+message set — packed public-pose sets / ``update_neighbor_poses_packed``,
+status gossip, GNC weight publication, lifting-matrix and global-anchor
+broadcast — travels over localhost TCP as length-prefixed packed v2
+frames (``--wire v1`` keeps the npz fallback for old peers;
+``--wire-dtype bf16`` halves the pose payload).  The launcher doubles as
+the message bus (the pub/sub role dpgo_ros plays in the reference's
+deployments).  ``--staleness 1`` overlaps each robot's RTR step with its
+round's exchange (bounded staleness, the RA-L 2020 async model); the
+default 0 keeps the deterministic lockstep schedule.
 
 Unlike the original ad-hoc wire code, everything here rides
 ``dpgo_tpu.comms``: per-message deadlines, bounded retry with backoff,
@@ -112,8 +116,10 @@ def run_robot(args) -> None:
 
     injector = make_injector(args, seed_offset=rid)
     sock = connect_tcp("127.0.0.1", args.port)
+    wire_v2 = args.wire == "v2"
     transport = TcpTransport(sock, src=f"robot{rid}", dst="bus",
-                             injector=injector)
+                             injector=injector,
+                             wire_format="packed" if wire_v2 else "npz")
     policy = RetryPolicy(send_timeout_s=args.round_timeout,
                          recv_timeout_s=args.round_timeout)
     client = BusClient(ReliableChannel(transport, f"robot{rid}->bus",
@@ -141,6 +147,12 @@ def run_robot(args) -> None:
 
     if injector is not None:
         injector.enabled = True
+    # Compute/comm overlap: with --staleness >= 1 a background thread
+    # publishes round k's poses and prefetches the broadcast while round
+    # k's RTR step runs (bounded staleness, the RA-L 2020 async model);
+    # --staleness 0 keeps the deterministic lockstep schedule.
+    if args.staleness > 0:
+        client.start_overlap(args.staleness, timeout=args.round_timeout)
     bus_gone = False
     for it in range(rounds):
         if args.die_at_round is not None and it == args.die_at_round:
@@ -152,10 +164,11 @@ def run_robot(args) -> None:
             client.close()
             return
         frame = pack_agent_frame(agent, robust=robust,
-                                 include_anchor=(rid == 0))
+                                 include_anchor=(rid == 0),
+                                 wire_dtype=args.wire_dtype,
+                                 packed=wire_v2)
         try:
-            client.publish(frame, timeout=args.round_timeout)
-            merged = client.collect(timeout=args.round_timeout)
+            merged = client.exchange(frame, timeout=args.round_timeout)
         except TransportClosed:
             bus_gone = True  # keep the local result; stop exchanging
             break
@@ -169,6 +182,11 @@ def run_robot(args) -> None:
             agent.iterate(do_optimization=True)
         else:
             time.sleep(1.0 / args.async_rate)
+    try:
+        client.drain_overlap(timeout=60.0)
+    except TransportClosed:
+        bus_gone = True
+    client.stop_overlap()
     if injector is not None:
         injector.enabled = False
 
@@ -241,6 +259,8 @@ def launch(args) -> int:
                "--async-rate", str(args.async_rate), "--out-dir", out_dir,
                "--round-timeout", str(args.round_timeout),
                "--heartbeat-s", str(args.heartbeat_s),
+               "--staleness", str(args.staleness),
+               "--wire", args.wire, "--wire-dtype", args.wire_dtype,
                "--fault-drop", str(args.fault_drop),
                "--fault-delay", str(args.fault_delay),
                "--fault-delay-s", str(args.fault_delay_s[0]),
@@ -260,7 +280,8 @@ def launch(args) -> int:
     channels = accept_robots(
         srv, args.robots, injector=injector,
         policy=RetryPolicy(send_timeout_s=args.round_timeout,
-                           recv_timeout_s=args.round_timeout))
+                           recv_timeout_s=args.round_timeout),
+        wire_format="packed" if args.wire == "v2" else "npz")
     bus = RoundBus(channels, round_timeout_s=args.round_timeout,
                    miss_limit=3,
                    liveness_timeout_s=max(1.0, 8 * args.heartbeat_s))
@@ -371,6 +392,20 @@ def main() -> None:
                          "seconds); chaos runs should drop it to ~2s")
     ap.add_argument("--heartbeat-s", type=float, default=0.25,
                     help="robot->bus heartbeat interval (liveness)")
+    ap.add_argument("--staleness", type=int, default=0,
+                    help="compute/comm overlap bound: >=1 double-buffers "
+                         "the exchange (round k's step runs while round "
+                         "k's poses are on the wire); 0 keeps the "
+                         "deterministic lockstep schedule")
+    ap.add_argument("--wire", choices=("v2", "v1"), default="v2",
+                    help="wire format: v2 = packed columnar frames "
+                         "(zero-copy decode), v1 = per-pose npz (old-peer "
+                         "interop)")
+    ap.add_argument("--wire-dtype", choices=("f64", "f32", "bf16"),
+                    default="f64",
+                    help="pose payload dtype on the wire (v2); bf16 "
+                         "halves pose bytes vs f32 and accumulates in "
+                         "f32 on receipt")
     ap.add_argument("--fault-drop", type=float, default=0.0)
     ap.add_argument("--fault-delay", type=float, default=0.0)
     ap.add_argument("--fault-delay-s", type=float, nargs=2,

@@ -121,8 +121,10 @@ def main():
         for name, env in VARIANTS.items():
             # PYTHONPATH must APPEND: /root/.axon_site hosts the
             # axon-tunnel sitecustomize (see verify SKILL.md).
+            # DPGO_AB=1 opts into the A/B env gates (PALLAS_TILE et al.
+            # are ignored in production shells without it).
             e = dict(os.environ, KB_MODE="worker", KB_ROUNDS=rounds,
-                     KB_SEL=sel,
+                     KB_SEL=sel, DPGO_AB="1",
                      PYTHONPATH="/root/.axon_site:/root/repo", **env)
             t0 = time.perf_counter()
             out = subprocess.run([sys.executable, os.path.abspath(__file__)],

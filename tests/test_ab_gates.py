@@ -1,0 +1,48 @@
+"""The A/B experiment gates (ADVICE r5): ``PALLAS_TILE`` is scoped out of
+the production path behind ``DPGO_AB=1`` with validation, and the
+``pallas_tcg`` selection/sweep/unroll gates are read at kernel-build time
+so they are toggleable per-process."""
+
+import pytest
+
+
+def test_pallas_tile_ignored_without_ab_optin(monkeypatch):
+    from dpgo_tpu.models.rbcd import _edge_tile_shape
+
+    monkeypatch.delenv("DPGO_AB", raising=False)
+    monkeypatch.setenv("PALLAS_TILE", "512")  # leaked env var
+    T, nt = _edge_tile_shape(500, 100, 2000)
+    assert T == 256  # adaptive tile, override NOT applied
+    assert nt == -(-2000 // T) or nt >= 1
+
+
+def test_pallas_tile_applies_and_validates_with_ab(monkeypatch):
+    from dpgo_tpu.models.rbcd import _edge_tile_shape
+
+    monkeypatch.setenv("DPGO_AB", "1")
+    monkeypatch.setenv("PALLAS_TILE", "512")
+    T, _ = _edge_tile_shape(500, 100, 2000)
+    assert T == 512
+    for bad in ("abc", "0", "-128", "100"):  # 100: not a lane multiple
+        monkeypatch.setenv("PALLAS_TILE", bad)
+        with pytest.raises(ValueError):
+            _edge_tile_shape(500, 100, 2000)
+
+
+def test_pallas_tcg_gates_read_per_call(monkeypatch):
+    from dpgo_tpu.ops.pallas_tcg import _ab_gates
+
+    monkeypatch.delenv("PALLAS_SEL_PACKED", raising=False)
+    monkeypatch.delenv("PALLAS_NS_SWEEPS", raising=False)
+    monkeypatch.delenv("PALLAS_UNROLL_TILES", raising=False)
+    g = _ab_gates()
+    assert g.sel_packed is True and g.ns_sweeps == 24 \
+        and g.unroll_tiles is False
+    # Toggling mid-process takes effect on the NEXT kernel build — no
+    # interpreter restart (the old import-time read froze these forever).
+    monkeypatch.setenv("PALLAS_SEL_PACKED", "0")
+    monkeypatch.setenv("PALLAS_NS_SWEEPS", "8")
+    monkeypatch.setenv("PALLAS_UNROLL_TILES", "1")
+    g = _ab_gates()
+    assert g.sel_packed is False and g.ns_sweeps == 8 \
+        and g.unroll_tiles is True

@@ -53,9 +53,16 @@ def _make_problem(seed=0, n=24, num_lc=12):
     return meas, partition_contiguous(meas, NUM_ROBOTS)
 
 
-def _run_fleet(part, injector=None, kill=None, rounds=ROUNDS):
+def _run_fleet(part, injector=None, kill=None, rounds=ROUNDS,
+               staleness=0):
     """Drive a full sync solve over the loopback fleet (the in-process
-    twin of examples/tcp_deployment_example.py's robot loop)."""
+    twin of examples/tcp_deployment_example.py's robot loop).
+
+    ``staleness=0`` is the PR-2 lockstep schedule, unchanged;
+    ``staleness>=1`` runs each robot's exchange through the overlapped
+    bus client (publish + prefetch on a background thread while the RTR
+    step runs) with per-robot driver threads, the deployment examples'
+    overlap mode."""
     params = AgentParams(d=3, r=5, num_robots=NUM_ROBOTS)
     agents = {rid: PGOAgent(rid, params) for rid in range(NUM_ROBOTS)}
     for rid in range(1, NUM_ROBOTS):
@@ -63,6 +70,18 @@ def _run_fleet(part, injector=None, kill=None, rounds=ROUNDS):
     for rid, ag in agents.items():
         ag.set_pose_graph(*agent_measurements(part, rid))
 
+    if staleness > 0:
+        # Overlap mode free-runs the bus while robots compile their first
+        # step (seconds of GIL-held XLA work that can starve heartbeat
+        # threads) — use the deployment examples' tolerant liveness
+        # thresholds; dropout detection is lockstep-tested above.
+        bus, clients = loopback_fleet(
+            NUM_ROBOTS, injector=injector, policy=POLICY,
+            round_timeout_s=0.15, miss_limit=100, liveness_timeout_s=10.0)
+        for c in clients.values():
+            c.channel.start_heartbeat(0.05)
+        return _run_fleet_overlapped(part, agents, bus, clients, kill,
+                                     rounds, staleness)
     bus, clients = loopback_fleet(
         NUM_ROBOTS, injector=injector, policy=POLICY,
         round_timeout_s=0.15, miss_limit=5, liveness_timeout_s=0.5)
@@ -96,6 +115,67 @@ def _run_fleet(part, injector=None, kill=None, rounds=ROUNDS):
     bus.close()
     for rid, c in clients.items():
         if rid not in dead:
+            c.close()
+    return agents, bus, clients
+
+
+def _run_fleet_overlapped(part, agents, bus, clients, kill, rounds,
+                          staleness):
+    """Overlap-mode fleet driver: the bus relays continuously; each robot
+    thread submits its frame to the overlapped client and computes against
+    the freshest broadcast (bounded staleness)."""
+    import threading
+
+    from dpgo_tpu.comms import TransportClosed
+
+    stop = threading.Event()
+
+    def bus_loop():
+        while not stop.is_set():
+            if len(bus.lost) == len(bus.channels):
+                break
+            bus.round()
+
+    def robot_loop(rid):
+        ag, client = agents[rid], clients[rid]
+        client.start_overlap(staleness, timeout=0.5)
+        for it in range(rounds):
+            if kill is not None and rid == kill[0] and it == kill[1]:
+                client.close()
+                return
+            frame = pack_agent_frame(ag, include_anchor=(rid == 0))
+            try:
+                merged = client.exchange(frame, timeout=0.5)
+            except TransportClosed:
+                return
+            if merged is not None:
+                for peer, pf in client.peer_frames(merged).items():
+                    apply_peer_frame(ag, peer, pf,
+                                     accept_anchor=(rid != 0 and peer == 0))
+                for lost in client.lost:
+                    ag.mark_neighbor_lost(lost)
+            ag.iterate(True)
+            time.sleep(PACE_S)
+        try:
+            client.drain_overlap(timeout=10.0)
+        except TransportClosed:
+            pass
+
+    bus_thread = threading.Thread(target=bus_loop, daemon=True)
+    bus_thread.start()
+    threads = [threading.Thread(target=robot_loop, args=(rid,), daemon=True)
+               for rid in agents]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    # Stop the relay BEFORE closing anything: closing a transport under a
+    # live bus.round() reads as a dead robot ("closed") on the hub.
+    stop.set()
+    bus_thread.join(timeout=10)
+    bus.close()
+    for rid, c in clients.items():
+        if kill is None or rid != kill[0]:
             c.close()
     return agents, bus, clients
 
@@ -218,6 +298,33 @@ def test_chaos_partition_heals_and_solve_finishes():
     assert injector.stats["partitioned"] > 0
     cost = _team_cost(agents, part, meas, all_robots)
     assert cost == pytest.approx(cost_clean, rel=0.01)
+
+
+def test_chaos_overlap_staleness_converges_with_drops():
+    """The overlap-mode staleness chaos test: compute/comm overlap at
+    staleness=1 PLUS 10% frame drop must land within 1% of the lockstep
+    fault-free cost — bounded staleness is exactly the regime the RA-L
+    2020 asynchronous convergence result covers, so overlapping round k's
+    RTR step with round k's exchange loses nothing."""
+    meas, part = _make_problem()
+    all_robots = [0, 1, 2]
+
+    clean_agents, clean_bus, _ = _run_fleet(part)
+    assert clean_bus.lost == set()
+    cost_clean = _team_cost(clean_agents, part, meas, all_robots)
+
+    injector = FaultInjector(FaultSpec(drop=0.10), seed=13)
+    agents, bus, clients = _run_fleet(part, injector=injector,
+                                      staleness=1, rounds=ROUNDS + 15)
+    assert injector.stats["dropped"] > 0
+    assert bus.lost == set()
+    for rid in all_robots:
+        assert agents[rid].get_status().state == AgentState.INITIALIZED
+        # Overlap: the exchange thread never blocked the iterate loop for
+        # a full round — every robot completed essentially every round.
+        assert agents[rid].get_status().iteration_number >= ROUNDS
+    cost_overlap = _team_cost(agents, part, meas, all_robots)
+    assert cost_overlap == pytest.approx(cost_clean, rel=0.01)
 
 
 def test_chaos_comms_layer_zero_obs_events_when_telemetry_off(monkeypatch):

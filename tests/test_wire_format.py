@@ -1,0 +1,384 @@
+"""The deployment fast path: packed wire format v2 (round-trip, corruption,
+bf16 parity, npz interop), the agent's slot-indexed neighbor buffer
+(vectorized scatter vs. the per-pose dict vocabulary on a golden graph),
+the packed publish/ingest fast path, and the overlapped bus client."""
+
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dpgo_tpu import obs
+from dpgo_tpu.agent import AgentState, PGOAgent
+from dpgo_tpu.comms import (BF16_REL_ERR, PACKED_MAGIC, LoopbackTransport,
+                            ProtocolError, ReliableChannel, RetryPolicy,
+                            bf16_decode, bf16_encode, loopback_fleet,
+                            pack_agent_frame, apply_peer_frame)
+from dpgo_tpu.comms.protocol import (HEADER, decode_payload,
+                                     decode_payload_packed, encode_payload,
+                                     pack_pose_arrays, pack_pose_dict,
+                                     pack_pose_set, pose_payload_nbytes,
+                                     unpack_pose_arrays, unpack_pose_dict,
+                                     unpack_pose_set)
+from dpgo_tpu.config import AgentParams
+from dpgo_tpu.utils.partition import agent_measurements, partition_contiguous
+from dpgo_tpu.utils.synthetic import make_measurements
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_ambient_run():
+    obs.end_run()
+    yield
+    obs.end_run()
+
+
+def _vocab_frame():
+    """A frame exercising every dtype the agent vocabulary ships."""
+    rng = np.random.default_rng(0)
+    return {
+        "_seq": np.asarray(7, np.int64),
+        "_kind": np.asarray("data"),
+        "status": np.arange(5, dtype=np.int64),
+        "relchange": np.asarray(0.25),
+        "pose:r": np.zeros(3, np.int32),
+        "pose:p": np.arange(3, dtype=np.int32),
+        "pose:x": rng.standard_normal((3, 5, 4)),
+        "anchor": rng.standard_normal((5, 4)).astype(np.float32),
+        "_lost": np.zeros(0, np.int64),
+        "flag": np.asarray(True),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Packed codec
+# ---------------------------------------------------------------------------
+
+def test_packed_roundtrip_matches_npz():
+    frame = _vocab_frame()
+    packed = decode_payload(encode_payload(frame, "packed"))
+    npz = decode_payload(encode_payload(frame, "npz"))
+    assert set(packed) == set(npz) == set(frame)
+    for k in frame:
+        np.testing.assert_array_equal(np.asarray(packed[k]),
+                                      np.asarray(npz[k]))
+        assert np.asarray(packed[k]).dtype == np.asarray(frame[k]).dtype
+        assert np.asarray(packed[k]).shape == np.asarray(frame[k]).shape
+
+
+def test_packed_is_smaller_than_npz_on_pose_frames():
+    rng = np.random.default_rng(1)
+    pose_dict = {(0, p): rng.standard_normal((5, 4)) for p in range(40)}
+    v2 = encode_payload(pack_pose_set("pose", pose_dict), "packed")
+    v1 = encode_payload(pack_pose_dict("pose", pose_dict), "npz")
+    # The acceptance bar is >= 2x fewer wire bytes per round in f32; the
+    # f64 payload alone already clears 2x (npz zip members cost ~hundreds
+    # of bytes per pose block).
+    assert len(v1) / len(v2) >= 2.0
+
+
+def test_packed_corruption_and_truncation_raise_protocol_error():
+    data = encode_payload(_vocab_frame(), "packed")
+    assert data[:4] == PACKED_MAGIC
+    # Bit flips anywhere in the body fail the CRC.
+    for pos in (5, len(data) // 2, len(data) - 3):
+        bad = bytearray(data)
+        bad[pos] ^= 0xFF
+        with pytest.raises(ProtocolError):
+            decode_payload(bytes(bad))
+    # Truncation at every region boundary dies cleanly.
+    for cut in (2, 6, 11, len(data) // 2, len(data) - 1):
+        with pytest.raises(ProtocolError):
+            decode_payload_packed(data[:cut])
+    # An entry header lying about its size is caught before allocation.
+    with pytest.raises(ProtocolError):
+        decode_payload_packed(PACKED_MAGIC + struct.pack("<II", 0, 5))
+
+
+def test_decode_sniffs_format_both_ways():
+    """Old/new peer interop: one receiver decodes both encodings."""
+    frame = {"v": np.arange(4.0)}
+    for fmt in ("packed", "npz"):
+        out = decode_payload(encode_payload(frame, fmt))
+        np.testing.assert_array_equal(out["v"], frame["v"])
+    with pytest.raises(ValueError):
+        encode_payload(frame, "protobuf")
+
+
+def test_mixed_wire_transport_pair_interoperates():
+    """A packed sender and an npz sender share one link: each end decodes
+    whatever arrives (the rolling-upgrade scenario)."""
+    a, b = LoopbackTransport.pair(wire_format="packed")
+    b.wire_format = "npz"  # old peer: still sends v1
+    a.send({"v": np.asarray(1)})
+    assert int(b.recv(timeout=1.0)["v"]) == 1
+    b.send({"v": np.asarray(2)})
+    assert int(a.recv(timeout=1.0)["v"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# bf16 wire dtype
+# ---------------------------------------------------------------------------
+
+def test_bf16_roundtrip_parity_bound():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal(4096) * np.exp(rng.uniform(-8, 8, 4096))
+    rt = bf16_decode(bf16_encode(x))
+    rel = np.abs(rt - x) / np.abs(x)
+    assert rel.max() <= BF16_REL_ERR
+    # Exact values representable in bf16 survive unchanged.
+    exact = np.asarray([0.0, 1.0, -2.0, 0.5, 384.0])
+    np.testing.assert_array_equal(bf16_decode(bf16_encode(exact)), exact)
+
+
+def test_bf16_pose_set_halves_f32_bytes_and_accumulates_f64():
+    rng = np.random.default_rng(3)
+    pose_dict = {(1, p): rng.standard_normal((5, 4)) for p in range(8)}
+    f32 = pack_pose_set("pose", pose_dict, wire_dtype="f32")
+    b16 = pack_pose_set("pose", pose_dict, wire_dtype="bf16")
+    assert pose_payload_nbytes(b16, "pose") < pose_payload_nbytes(f32, "pose")
+    assert b16["pose:xb"].dtype == np.uint16
+    robots, poses, vals = unpack_pose_arrays(b16, "pose")
+    assert vals.dtype == np.float64  # f32-widened, f64-accumulated
+    for i, (r, p) in enumerate(zip(robots, poses)):
+        ref = pose_dict[(int(r), int(p))]
+        rel = np.abs(vals[i] - ref) / np.maximum(np.abs(ref), 1e-12)
+        assert rel.max() <= BF16_REL_ERR + 1e-7
+
+
+# ---------------------------------------------------------------------------
+# Pose vocabulary equivalence
+# ---------------------------------------------------------------------------
+
+def test_pose_set_roundtrip_matches_v1_dict():
+    rng = np.random.default_rng(4)
+    pose_dict = {(2, 11): rng.standard_normal((5, 4)),
+                 (0, 3): rng.standard_normal((5, 4))}
+    via_v2 = unpack_pose_set(
+        decode_payload(encode_payload(pack_pose_set("pose", pose_dict))),
+        "pose")
+    via_v1 = unpack_pose_set(
+        decode_payload(encode_payload(pack_pose_dict("pose", pose_dict),
+                                      "npz")), "pose")
+    assert set(via_v2) == set(via_v1) == set(pose_dict)
+    for k in pose_dict:
+        np.testing.assert_allclose(via_v2[k], pose_dict[k])
+        np.testing.assert_allclose(via_v1[k], pose_dict[k])
+    assert pack_pose_set("pose", {}) == {}
+    assert unpack_pose_arrays({"other": np.zeros(1)}, "pose") is None
+
+
+# ---------------------------------------------------------------------------
+# Agent neighbor buffer: vectorized scatter vs the dict path (golden graph)
+# ---------------------------------------------------------------------------
+
+def _golden_agents(num_robots=3, n=18, num_lc=12, seed=0):
+    rng = np.random.default_rng(seed)
+    meas, _ = make_measurements(rng, n=n, d=3, num_lc=num_lc,
+                                rot_noise=0.005, trans_noise=0.005)
+    part = partition_contiguous(meas, num_robots)
+    params = AgentParams(d=3, r=5, num_robots=num_robots)
+    agents = [PGOAgent(a, params) for a in range(num_robots)]
+    for ag in agents[1:]:
+        ag.set_lifting_matrix(agents[0].get_lifting_matrix())
+    for ag in agents:
+        ag.set_pose_graph(*agent_measurements(part, ag.robot_id))
+    return agents
+
+
+def test_packed_scatter_matches_dict_path_on_golden_graph():
+    """The same neighbor poses delivered (a) as per-pose dicts and (b) as
+    packed index/value arrays must produce identical neighbor buffers,
+    identical initialization, and identical iterates."""
+    agents_a = _golden_agents()
+    agents_b = _golden_agents()
+    for _ in range(3):
+        dicts = [ag.get_shared_pose_dict() for ag in agents_a]
+        for src in range(len(agents_a)):
+            for dst in range(len(agents_a)):
+                if src == dst:
+                    continue
+                # Arm A: v1 dict vocabulary.
+                agents_a[dst].update_neighbor_poses(src, dicts[src])
+                # Arm B: packed arrays of the SAME payload (an
+                # uninitialized sender publishes an empty set).
+                keys = list(dicts[src])
+                robots = np.asarray([k[0] for k in keys], np.int64)
+                poses = np.asarray([k[1] for k in keys], np.int64)
+                vals = np.stack([dicts[src][k] for k in keys]) if keys \
+                    else np.zeros((0, 5, 4))
+                agents_b[dst].update_neighbor_poses_packed(
+                    src, robots, poses, vals)
+            st = agents_a[src].get_status()
+            for dst in range(len(agents_a)):
+                if src != dst:
+                    agents_a[dst].set_neighbor_status(st)
+                    agents_b[dst].set_neighbor_status(
+                        agents_b[src].get_status())
+        for ag_a, ag_b in zip(agents_a, agents_b):
+            ag_a.iterate(True)
+            ag_b.iterate(True)
+    for ag_a, ag_b in zip(agents_a, agents_b):
+        assert ag_a.get_status().state == AgentState.INITIALIZED
+        assert ag_b.get_status().state == AgentState.INITIALIZED
+        za = ag_a._neighbor_buffer()
+        zb = ag_b._neighbor_buffer()
+        assert za is not None and zb is not None
+        np.testing.assert_array_equal(np.asarray(za), np.asarray(zb))
+        np.testing.assert_allclose(ag_a.X, ag_b.X, atol=1e-12)
+        # The dict-compat view agrees with the buffer.
+        for key, blk in ag_a._neighbor_poses.items():
+            np.testing.assert_array_equal(ag_b._nbr_lookup(key), blk)
+
+
+def test_scatter_ignores_unknown_keys_and_partial_frames():
+    agents = _golden_agents()
+    ag = agents[0]
+    s_before = ag._nbr_have.copy()
+    # Keys this agent never references scatter to nothing.
+    ag.update_neighbor_poses_packed(
+        1, np.asarray([1, 9]), np.asarray([997, 998]),
+        np.zeros((2, 5, 4)))
+    np.testing.assert_array_equal(ag._nbr_have, s_before)
+    # A partial frame fills only its slots; the buffer is still incomplete.
+    (key, slot) = next(iter(ag._nbr_slot.items()))
+    ag.update_neighbor_poses_packed(
+        key[0], np.asarray([key[0]]), np.asarray([key[1]]),
+        np.full((1, 5, 4), 3.25))
+    assert ag._nbr_have[slot]
+    if not ag._nbr_have.all():
+        assert ag._neighbor_buffer() is None
+    np.testing.assert_array_equal(ag._nbr_lookup(key),
+                                  np.full((5, 4), 3.25))
+
+
+def test_public_pose_arrays_match_shared_pose_dict():
+    agents = _golden_agents()
+    for ag in agents:
+        if ag.get_status().state != AgentState.INITIALIZED:
+            continue
+        pub = ag.get_public_pose_arrays()
+        d = ag.get_shared_pose_dict()
+        assert pub is not None
+        robots, poses, vals = pub
+        assert robots.dtype == np.int32 and poses.dtype == np.int32
+        assert len(robots) == len(d)
+        for i, (r, p) in enumerate(zip(robots, poses)):
+            np.testing.assert_array_equal(vals[i], d[(int(r), int(p))])
+    # Uninitialized agents return None (nothing to publish).
+    fresh = PGOAgent(1, AgentParams(d=3, r=5, num_robots=2))
+    assert fresh.get_public_pose_arrays() is None
+
+
+def test_packed_agent_frame_roundtrip_equivalent_to_v1():
+    """pack_agent_frame(packed) -> wire -> apply_peer_frame lands the same
+    state as the v1 frame, including sequence-stamped stale drops."""
+    agents_a = _golden_agents(seed=5)
+    agents_b = _golden_agents(seed=5)
+    src_a, dst_a = agents_a[0], agents_a[1]
+    src_b, dst_b = agents_b[0], agents_b[1]
+    for packed, (src, dst) in ((False, (src_a, dst_a)),
+                               (True, (src_b, dst_b))):
+        frame = pack_agent_frame(src, include_anchor=True, packed=packed)
+        wire = decode_payload(encode_payload(frame))
+        wire["_pseq"] = np.asarray(4, np.int64)
+        dst.set_neighbor_status(src.get_status())
+        apply_peer_frame(dst, 0, wire, accept_anchor=True)
+    assert dst_a.get_status().state == dst_b.get_status().state
+    za, zb = dst_a._neighbor_poses, dst_b._neighbor_poses
+    assert set(za) == set(zb) and len(za) > 0
+    for k in za:
+        np.testing.assert_array_equal(za[k], zb[k])
+    # Stale packed frame (same sequence) must not roll the cache back.
+    frame = pack_agent_frame(src_b, packed=True)
+    wire = decode_payload(encode_payload(frame))
+    wire["pose:x"] = np.zeros_like(wire["pose:x"])
+    wire["_pseq"] = np.asarray(4, np.int64)
+    apply_peer_frame(dst_b, 0, wire)
+    for k in zb:
+        np.testing.assert_array_equal(dst_b._neighbor_poses[k], zb[k])
+
+
+# ---------------------------------------------------------------------------
+# Overlapped bus client
+# ---------------------------------------------------------------------------
+
+FAST = RetryPolicy(max_attempts=3, base_delay_s=0.005, max_delay_s=0.02,
+                   send_timeout_s=1.0, recv_timeout_s=1.0)
+
+
+def test_overlap_client_bounded_staleness_and_drain():
+    bus, clients = loopback_fleet(2, policy=FAST, round_timeout_s=1.0)
+    stop = threading.Event()
+
+    def bus_loop():
+        while not stop.is_set():
+            bus.round()
+
+    t = threading.Thread(target=bus_loop, daemon=True)
+    t.start()
+    try:
+        for c in clients.values():
+            c.start_overlap(staleness=1, timeout=1.0)
+
+        def robot(rid, log):
+            c = clients[rid]
+            for it in range(6):
+                merged = c.exchange({"v": np.asarray(it)}, timeout=1.0)
+                lag = c._ov_submitted - c._ov_done
+                assert lag <= 1 + 1  # bound: staleness + the one in flight
+                log.append(merged)
+            c.drain_overlap(timeout=10.0)
+
+        logs = [[], []]
+        rts = [threading.Thread(target=robot, args=(r, logs[r]))
+               for r in range(2)]
+        for rt in rts:
+            rt.start()
+        for rt in rts:
+            rt.join(timeout=30)
+        for rid in (0, 1):
+            # After draining, every submitted exchange completed.
+            assert clients[rid]._ov_submitted == clients[rid]._ov_done
+            # The final broadcast carries the peer's late-round value.
+            final = clients[rid].drain_overlap()
+            peer = 1 - rid
+            assert final is not None
+            assert int(final[f"r{peer}|v"]) >= 3
+    finally:
+        stop.set()
+        for c in clients.values():
+            c.close()
+        bus.close()
+        t.join(timeout=5)
+
+
+def test_overlap_staleness_zero_is_lockstep():
+    bus, clients = loopback_fleet(2, policy=FAST, round_timeout_s=1.0)
+    for c in clients.values():
+        c.start_overlap(staleness=0)  # no thread: exchange == lockstep
+        assert c._ov_thread is None
+    for c in clients.values():
+        c.publish({"v": np.asarray(1)})
+    bus.round()
+    for c in clients.values():
+        got = c.collect(timeout=1.0)
+        assert got is not None
+    bus.close()
+    for c in clients.values():
+        c.close()
+
+
+def test_overlap_surfaces_transport_closed():
+    from dpgo_tpu.comms import TransportClosed
+
+    bus, clients = loopback_fleet(1, policy=FAST, round_timeout_s=0.3)
+    c = clients[0]
+    c.start_overlap(staleness=1, timeout=0.3)
+    bus.close()  # the hub dies
+    with pytest.raises(TransportClosed):
+        for _ in range(50):
+            c.exchange({"v": np.asarray(0)}, timeout=0.3)
+            time.sleep(0.01)
+    c.close()
