@@ -291,6 +291,13 @@ def main() -> None:
                                       if k not in ("metric", "value",
                                                    "unit")})
         obs.end_run()
+        # The bench rounds ran traced (spans ride the same run): export
+        # the Perfetto timeline so a slow arm can be eyeballed directly.
+        from dpgo_tpu.obs import timeline
+        trace_path = timeline.write_chrome_trace(
+            os.path.join(args.telemetry, "trace.json"),
+            timeline.merge([args.telemetry]))
+        log(f"[bench_deployment] Perfetto timeline: {trace_path}")
     print(json.dumps(out))
 
 

@@ -61,6 +61,7 @@ import numpy as np
 
 from .config import AgentParams, RobustCostType
 from . import obs
+from .obs import trace
 from . import robust as robust_mod
 from .types import EdgeSet, Measurements
 from .utils import logger as logger_mod
@@ -1014,7 +1015,10 @@ class PGOAgent:
         (``PGOAgent.cpp:1094-1098``).
         """
         run = obs.get_run()
-        t0 = time.perf_counter() if run is not None else 0.0
+        # monotonic (not perf_counter) so the iterate span shares the
+        # event stream's clock and lands on the merged fleet timeline.
+        t0 = time.monotonic() if run is not None else 0.0
+        t0_wall = time.time() if run is not None else 0.0
         with self._lock:
             if self._status.state != AgentState.INITIALIZED:
                 return False
@@ -1099,7 +1103,7 @@ class PGOAgent:
                 # The scalar rel-change readback above materialized the
                 # step — the latency below includes the device work, with
                 # no telemetry-added sync.
-                dt = time.perf_counter() - t0
+                dt = time.monotonic() - t0
                 run.histogram(
                     "agent_iterate_seconds",
                     "PGOAgent.iterate wall-clock (lock + step + readback)",
@@ -1115,6 +1119,12 @@ class PGOAgent:
                           iteration=self._status.iteration_number,
                           stepped=stepped, rel_change=rel,
                           ready=bool(ready), latency_s=dt)
+                # The compute half of the fleet timeline: one span per
+                # iterate, reusing the timestamps measured above.
+                trace.emit_span(run, "iterate", t0, t0_wall, dt,
+                                phase="compute", robot=self.robot_id,
+                                iteration=self._status.iteration_number,
+                                stepped=stepped, rel_change=rel)
             return stepped
 
     # -- async runtime ------------------------------------------------------

@@ -59,8 +59,11 @@ from __future__ import annotations
 from .faults import FaultInjector, FaultSpec
 from .protocol import (
     BF16_REL_ERR,
+    CLOCK_KEY,
     DEFAULT_MAX_FRAME_BYTES,
     PACKED_MAGIC,
+    TRACE_IDS_KEY,
+    TRACE_T_KEY,
     FrameAssembler,
     ProtocolError,
     bf16_decode,
@@ -70,12 +73,14 @@ from .protocol import (
     pack_pose_arrays,
     pack_pose_dict,
     pack_pose_set,
+    pack_trace_entries,
     pose_payload_nbytes,
     recv_frame,
     send_frame,
     unpack_pose_arrays,
     unpack_pose_dict,
     unpack_pose_set,
+    unpack_trace_entries,
 )
 from .reliable import ChannelTotals, ReliableChannel, RetryPolicy
 from .transport import (
@@ -94,6 +99,7 @@ from .bus import (BusClient, RoundBus, apply_peer_frame,
 __all__ = [
     "BF16_REL_ERR",
     "BusClient",
+    "CLOCK_KEY",
     "ChannelTotals",
     "DEFAULT_MAX_FRAME_BYTES",
     "FaultInjector",
@@ -105,6 +111,8 @@ __all__ = [
     "ReliableChannel",
     "RetryPolicy",
     "RoundBus",
+    "TRACE_IDS_KEY",
+    "TRACE_T_KEY",
     "TcpTransport",
     "Transport",
     "TransportClosed",
@@ -122,10 +130,12 @@ __all__ = [
     "pack_pose_arrays",
     "pack_pose_dict",
     "pack_pose_set",
+    "pack_trace_entries",
     "pose_payload_nbytes",
     "recv_frame",
     "send_frame",
     "unpack_pose_arrays",
     "unpack_pose_dict",
     "unpack_pose_set",
+    "unpack_trace_entries",
 ]

@@ -35,8 +35,10 @@ import time
 import numpy as np
 
 from .. import obs
-from .protocol import (pack_pose_arrays, pack_pose_dict, unpack_pose_arrays,
-                       unpack_pose_set)
+from ..obs import trace
+from .protocol import (pack_pose_arrays, pack_pose_dict,
+                       pack_trace_entries, unpack_pose_arrays,
+                       unpack_pose_set, unpack_trace_entries)
 from .reliable import ChannelTotals, ReliableChannel, RetryPolicy
 from .transport import TcpTransport, TransportClosed, TransportTimeout
 
@@ -68,7 +70,7 @@ def accept_robots(srv, num_robots: int, injector=None,
             {"max_frame_bytes": max_frame_bytes}
         t = TcpTransport(conn, src="bus", dst="?", injector=injector,
                          wire_format=wire_format, **kw)
-        ch = ReliableChannel(t, policy=policy)
+        ch = ReliableChannel(t, policy=policy, origin=-1)
         hello = ch.recv(timeout=hello_timeout_s)
         rid = int(hello["hello"])
         t.dst = f"robot{rid}"
@@ -134,25 +136,31 @@ class RoundBus:
 
     def round(self) -> dict:
         """One relay round; returns the merged broadcast frame."""
-        for rid in sorted(self.channels):
-            if rid not in self.lost:
-                self._gather_one(rid)
-        merged: dict = {}
-        for rid, frame in sorted(self._last_frames.items()):
-            if rid in self.lost:
-                continue
-            merged.update({f"r{rid}|{k}": v for k, v in frame.items()})
-            merged[f"r{rid}|_pseq"] = np.asarray(
-                self._last_seqs.get(rid, -1), np.int64)
-        merged["_lost"] = np.asarray(sorted(self.lost), np.int64)
-        for rid, ch in sorted(self.channels.items()):
-            if rid in self.lost:
-                continue
-            try:
-                ch.send(merged, timeout=self.round_timeout_s)
-            except (TransportClosed, TransportTimeout):
-                self._mark_lost(rid, "broadcast_failed")
-        self.rounds_served += 1
+        # The hub's span (robot = -1): gather + rebroadcast wall-clock,
+        # the wire half of every round's critical path.
+        sp = trace.span("bus_round", phase="comms", robot=-1,
+                        round=self.rounds_served)
+        with sp:
+            for rid in sorted(self.channels):
+                if rid not in self.lost:
+                    self._gather_one(rid)
+            merged: dict = {}
+            for rid, frame in sorted(self._last_frames.items()):
+                if rid in self.lost:
+                    continue
+                merged.update({f"r{rid}|{k}": v for k, v in frame.items()})
+                merged[f"r{rid}|_pseq"] = np.asarray(
+                    self._last_seqs.get(rid, -1), np.int64)
+            merged["_lost"] = np.asarray(sorted(self.lost), np.int64)
+            for rid, ch in sorted(self.channels.items()):
+                if rid in self.lost:
+                    continue
+                try:
+                    ch.send(merged, timeout=self.round_timeout_s)
+                except (TransportClosed, TransportTimeout):
+                    self._mark_lost(rid, "broadcast_failed")
+            self.rounds_served += 1
+            sp.add(lost=len(self.lost))
         return merged
 
     def serve(self, total_rounds: int) -> None:
@@ -205,6 +213,8 @@ class BusClient:
     def __init__(self, channel: ReliableChannel, robot_id: int):
         self.channel = channel
         self.robot_id = int(robot_id)
+        if channel.origin is None:
+            channel.origin = self.robot_id  # clock-domain identity
         self.lost: set[int] = set()
         self.staleness = 0
         self._ov_cond = threading.Condition()
@@ -221,16 +231,37 @@ class BusClient:
                           timeout=timeout)
 
     def publish(self, frame: dict, timeout: float | None = None) -> int:
-        return self.channel.send(frame, timeout=timeout)
+        sp = trace.start_span("publish", phase="comms",
+                              robot=self.robot_id)
+        if sp is None:
+            return self.channel.send(frame, timeout=timeout)
+        # The publish span's context rides the frame (both wire codecs,
+        # ignored by untraced peers): receivers link their scatter spans
+        # to it, which is what joins a round's publish -> exchange ->
+        # scatter chain into one causal trace across robots.
+        frame = dict(frame)
+        frame.update(pack_trace_entries(sp.trace_id, sp.span_id,
+                                        self.robot_id))
+        try:
+            n = self.channel.send(frame, timeout=timeout)
+        except Exception:
+            sp.end(ok=False)
+            raise
+        sp.end(bytes=n)
+        return n
 
     def collect(self, timeout: float | None = None) -> dict | None:
         """The next broadcast, or None when the deadline passed (skip this
         round's updates and carry on — the bus caches our last frame).
         Raises ``TransportClosed`` when the bus itself is gone."""
-        try:
-            merged = self.channel.recv(timeout=timeout)
-        except TransportTimeout:
-            return None
+        with trace.span("collect", phase="comms",
+                        robot=self.robot_id) as sp:
+            try:
+                merged = self.channel.recv(timeout=timeout)
+            except TransportTimeout:
+                sp.add(got=False)
+                return None
+            sp.add(got=True)
         if "_lost" in merged:
             self.lost = {int(x) for x in np.asarray(merged["_lost"]).ravel()}
         return merged
@@ -244,18 +275,25 @@ class BusClient:
         if self._ov_thread is None:
             self.publish(frame, timeout=timeout)
             return self.collect(timeout=timeout)
-        with self._ov_cond:
-            if self._ov_error is not None:
-                raise self._ov_error
-            self._ov_queue.append(frame)
-            self._ov_submitted += 1
-            self._ov_cond.notify_all()
-            while (self._ov_submitted - self._ov_done > self.staleness
-                   and self._ov_error is None):
-                self._ov_cond.wait(timeout=1.0)
-            if self._ov_error is not None:
-                raise self._ov_error
-            return self._ov_merged
+        # The ONLY time the caller's compute thread blocks on the wire in
+        # overlap mode is this staleness gate — its span duration is the
+        # un-hidden remainder of the exchange, the number the overlap
+        # efficiency report divides by the worker's wire_round time.
+        with trace.span("exchange_wait", phase="comms",
+                        robot=self.robot_id) as sp:
+            with self._ov_cond:
+                if self._ov_error is not None:
+                    raise self._ov_error
+                self._ov_queue.append(frame)
+                self._ov_submitted += 1
+                sp.add(in_flight=self._ov_submitted - self._ov_done)
+                self._ov_cond.notify_all()
+                while (self._ov_submitted - self._ov_done > self.staleness
+                       and self._ov_error is None):
+                    self._ov_cond.wait(timeout=1.0)
+                if self._ov_error is not None:
+                    raise self._ov_error
+                return self._ov_merged
 
     # -- overlap worker -----------------------------------------------------
 
@@ -281,8 +319,13 @@ class BusClient:
                 merged = None
                 err = None
                 try:
-                    self.publish(frame, timeout=timeout)
-                    merged = self.collect(timeout=timeout)
+                    # wire_round parents the publish/collect spans it
+                    # drives (same thread) — the worker's whole round is
+                    # one span, the hidden half of the overlap.
+                    with trace.span("wire_round", phase="comms",
+                                    robot=self.robot_id):
+                        self.publish(frame, timeout=timeout)
+                        merged = self.collect(timeout=timeout)
                 except TransportClosed as e:
                     err = e
                 except Exception as e:  # surfaced to the next exchange()
@@ -308,15 +351,16 @@ class BusClient:
         if self._ov_thread is None:
             return self._ov_merged
         end = time.monotonic() + timeout
-        with self._ov_cond:
-            while self._ov_submitted > self._ov_done:
-                if self._ov_error is not None:
-                    raise self._ov_error
-                remaining = end - time.monotonic()
-                if remaining <= 0:
-                    break
-                self._ov_cond.wait(timeout=remaining)
-            return self._ov_merged
+        with trace.span("drain", phase="comms", robot=self.robot_id):
+            with self._ov_cond:
+                while self._ov_submitted > self._ov_done:
+                    if self._ov_error is not None:
+                        raise self._ov_error
+                    remaining = end - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._ov_cond.wait(timeout=remaining)
+                return self._ov_merged
 
     def stop_overlap(self) -> None:
         if self._ov_thread is None:
@@ -364,7 +408,8 @@ def loopback_fleet(num_robots: int, injector=None,
         t_bus, t_robot = LoopbackTransport.pair(
             "bus", f"robot{rid}", injector=injector,
             wire_format=wire_format)
-        channels[rid] = ReliableChannel(t_bus, f"bus->robot{rid}", policy)
+        channels[rid] = ReliableChannel(t_bus, f"bus->robot{rid}", policy,
+                                        origin=-1)
         clients[rid] = BusClient(
             ReliableChannel(t_robot, f"robot{rid}->bus", policy), rid)
     bus = RoundBus(channels, round_timeout_s=round_timeout_s,
@@ -415,7 +460,25 @@ def pack_agent_frame(agent, robust: bool = False,
 def apply_peer_frame(agent, peer_id: int, pf: dict, robust: bool = False,
                      accept_anchor: bool = False) -> None:
     """Ingest one peer's sub-frame into a ``PGOAgent``: status, poses
-    (sequence-checked via the bus's ``_pseq`` tag), weights, anchor."""
+    (sequence-checked via the bus's ``_pseq`` tag), weights, anchor.
+
+    A trace context riding the sub-frame (the sender's publish span,
+    rebroadcast under its ``r{id}|`` namespace) is popped uncondition-
+    ally and, when telemetry is on, lands on this ingest's ``scatter``
+    span as the ``link_*`` fields the timeline renders as a cross-robot
+    flow arrow."""
+    ctx = unpack_trace_entries(pf)  # popped even with telemetry off
+    sp = trace.start_span("scatter", phase="comms", robot=agent.robot_id,
+                          link=ctx)
+    try:
+        _apply_peer_frame(agent, peer_id, pf, robust, accept_anchor)
+    finally:
+        if sp is not None:
+            sp.end(peer=peer_id)
+
+
+def _apply_peer_frame(agent, peer_id: int, pf: dict, robust: bool,
+                      accept_anchor: bool) -> None:
     from ..agent import AgentState, PGOAgentStatus
 
     if "status" in pf:

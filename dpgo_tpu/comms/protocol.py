@@ -42,6 +42,7 @@ from __future__ import annotations
 import io
 import socket
 import struct
+import time
 import zlib
 
 import numpy as np
@@ -347,6 +348,57 @@ def unpack_pose_set(frame: dict, prefix: str) -> dict:
     robots, poses, vals = packed
     return {(int(r), int(p)): vals[i]
             for i, (r, p) in enumerate(zip(robots, poses))}
+
+
+# ---------------------------------------------------------------------------
+# Trace context + clock stamps (the distributed-tracing wire vocabulary)
+# ---------------------------------------------------------------------------
+
+#: Optional trace-context entries a sender MAY attach to any frame: ids as
+#: one int64 triplet, send timestamps as one float64 pair.  They ride both
+#: codecs unchanged (just two more dict entries) and old peers ignore the
+#: keys — ``unpack_pose_*`` matches on the pose prefix, ``apply_peer_frame``
+#: pops them before parsing — so mixed traced/untraced fleets interoperate.
+TRACE_IDS_KEY = "_trace"    # int64 [trace_id, span_id, sender_robot]
+TRACE_T_KEY = "_trace_t"    # float64 [t_send_mono, t_send_wall]
+
+#: Channel-level clock stamp (``ReliableChannel`` attaches one per outgoing
+#: frame — heartbeats included — when telemetry is on): float64
+#: [origin, t_send_mono, t_send_wall].  ``origin`` is the sender's robot id,
+#: -1 for the bus hub, -2 when unknown.  The receiver pops it and records a
+#: ``clock_sample`` event; ``obs.timeline`` estimates pairwise clock
+#: offsets from the send/receive timestamp pairs.
+CLOCK_KEY = "_ts"
+
+
+def pack_trace_entries(trace_id: int, span_id: int, robot: int) -> dict:
+    """The optional trace-context frame entries for one outgoing message,
+    stamped with the send time."""
+    return {
+        TRACE_IDS_KEY: np.asarray([trace_id, span_id, robot], np.int64),
+        TRACE_T_KEY: np.asarray([time.monotonic(), time.time()],
+                                np.float64),
+    }
+
+
+def unpack_trace_entries(frame: dict, pop: bool = True):
+    """``(trace_id, span_id, robot, t_send_mono, t_send_wall)`` from a
+    frame carrying trace context, else None.  ``pop=True`` (default)
+    removes the entries so downstream parsers never see them.  A mangled
+    context is dropped (None), never fatal — tracing must not break the
+    data path."""
+    get = frame.pop if pop else frame.get
+    ids = get(TRACE_IDS_KEY, None)
+    ts = get(TRACE_T_KEY, None)
+    if ids is None or ts is None:
+        return None
+    try:
+        ids = np.asarray(ids, np.int64).ravel()
+        ts = np.asarray(ts, np.float64).ravel()
+        return (int(ids[0]), int(ids[1]), int(ids[2]),
+                float(ts[0]), float(ts[1]))
+    except (ValueError, IndexError, TypeError):
+        return None
 
 
 def pose_payload_nbytes(frame: dict, prefix: str) -> int:

@@ -37,10 +37,11 @@ import zlib
 import numpy as np
 
 from .. import obs
-from .protocol import ProtocolError
+from ..obs import trace
+from .protocol import CLOCK_KEY, ProtocolError
 from .transport import Transport, TransportClosed, TransportTimeout
 
-_RESERVED = ("_seq", "_kind")
+_RESERVED = ("_seq", "_kind", CLOCK_KEY)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -87,10 +88,15 @@ class ReliableChannel:
     """One fault-tolerant endpoint over a ``Transport``."""
 
     def __init__(self, transport: Transport, name: str = "",
-                 policy: RetryPolicy | None = None):
+                 policy: RetryPolicy | None = None,
+                 origin: int | None = None):
         self.transport = transport
         self.name = name or f"{transport.src}->{transport.dst}"
         self.policy = policy or RetryPolicy()
+        # Clock-domain identity stamped on outgoing frames when telemetry
+        # is on: the sending robot's id, -1 for the bus hub, None =
+        # unknown (stamped as -2; timeline skips such samples).
+        self.origin = origin
         self.totals = ChannelTotals()
         self._send_lock = threading.Lock()
         self._seq = 0
@@ -126,8 +132,19 @@ class ReliableChannel:
         frame = dict(arrays)
         frame["_seq"] = np.asarray(seq, np.int64)
         frame["_kind"] = np.asarray(kind)
+        run = obs.get_run()
+        t0_mono = t0_wall = 0.0
+        if run is not None:
+            t0_mono, t0_wall = time.monotonic(), time.time()
         attempts = self.policy.max_attempts if retry else 1
         for attempt in range(attempts):
+            if run is not None:
+                # Clock stamp, refreshed per attempt so the receiver's
+                # clock_sample pairs the bytes that actually arrived.
+                origin = -2 if self.origin is None else int(self.origin)
+                frame[CLOCK_KEY] = np.asarray(
+                    [float(origin), time.monotonic(), time.time()],
+                    np.float64)
             try:
                 n = self.transport.send(frame, timeout=timeout)
             except TransportTimeout:
@@ -135,6 +152,12 @@ class ReliableChannel:
                 self._obs_inc("comms_timeouts",
                               "send/recv deadline expirations")
                 if attempt + 1 >= attempts:
+                    if run is not None and kind != "hb":
+                        trace.emit_span(
+                            run, "send_failed", t0_mono, t0_wall,
+                            time.monotonic() - t0_mono, phase="comms",
+                            robot=self.origin, channel=self.name,
+                            attempts=attempt + 1)
                     raise
                 self.totals.retries += 1
                 self._obs_inc("comms_retries", "frame send retries")
@@ -145,6 +168,14 @@ class ReliableChannel:
             else:
                 self.totals.messages_sent += 1
                 self.totals.bytes_sent += n
+            if run is not None and attempt > 0 and kind != "hb":
+                # Only retried sends earn a span: the wire round itself is
+                # already covered by the bus client's publish span, and a
+                # clean send would double the event volume for nothing.
+                trace.emit_span(run, "send_retry", t0_mono, t0_wall,
+                                time.monotonic() - t0_mono, phase="comms",
+                                robot=self.origin, channel=self.name,
+                                attempts=attempt + 1, bytes=n)
             return n
         raise AssertionError("unreachable")
 
@@ -189,6 +220,25 @@ class ReliableChannel:
             self._last_seen = time.monotonic()
             kind = str(frame.pop("_kind")) if "_kind" in frame else "data"
             seq = int(frame.pop("_seq")) if "_seq" in frame else None
+            # The sender's clock stamp is popped unconditionally (a traced
+            # peer may be talking to an untraced one) but only becomes a
+            # clock_sample event when telemetry is on locally.
+            ts = frame.pop(CLOCK_KEY, None)
+            if ts is not None:
+                run = obs.get_run()
+                if run is not None:
+                    try:
+                        src = int(np.asarray(ts).ravel()[0])
+                        if src != -2:
+                            run.event(
+                                "clock_sample", phase="comms", src=src,
+                                dst=(-2 if self.origin is None
+                                     else int(self.origin)),
+                                channel=self.name, kind=kind,
+                                t_send_mono=float(np.asarray(ts)[1]),
+                                t_send_wall=float(np.asarray(ts)[2]))
+                    except (ValueError, IndexError, TypeError):
+                        pass  # mangled stamp: tracing never breaks data
             if kind == "hb":
                 self.totals.heartbeats_received += 1
                 continue

@@ -47,6 +47,7 @@ import numpy as np
 from ..config import (AgentParams, ROptAlg, RobustCostParams,
                       RobustCostType, Schedule)
 from .. import obs
+from ..obs import trace
 from .. import robust
 from ..types import EdgeSet, Measurements, edge_set_from_measurements
 from ..utils.graph_plan import plan_topology
@@ -1457,7 +1458,16 @@ def run_rbcd(
         if it < max_iters:
             uw, rs, end = _bounds(it, num_weight_updates)
             spec = (segment(state, end - it, uw, rs), end, uw)
+        if telemetry:
+            t_rb_m, t_rb_w = time.monotonic(), time.time()
         vec = np.asarray(fut)
+        if telemetry:
+            # The eval readback span: the device->host fetch the pipelined
+            # driver hides behind the speculative segment — its duration on
+            # the timeline shows how much of the round-trip stayed hidden.
+            trace.emit_span(obs_run, "eval_readback", t_rb_m, t_rb_w,
+                            time.monotonic() - t_rb_m, phase="eval",
+                            iteration=it)
         f, gn, consensus = vec[:3]
         cost_hist.append(float(f))
         gn_hist.append(float(gn))

@@ -27,7 +27,15 @@ robustness change reports through:
 * Exporters (``exporters.py``) — Prometheus text exposition, optional
   TensorBoard scalars (gated on an available writer), and the JSON metrics
   snapshot.  ``python -m dpgo_tpu.obs.report <run_dir>`` renders a
-  human-readable report from the persisted artifacts.
+  human-readable report from the persisted artifacts (``--json`` for
+  machine-readable output).
+* Distributed tracing (``trace.py`` / ``timeline.py``) — lightweight
+  spans emitted through the event stream behind the same telemetry-off
+  fence; trace context propagates across processes as optional wire
+  entries, and ``python -m dpgo_tpu.obs.timeline <run_dir>...`` merges
+  per-robot streams (pairwise clock-offset estimation from the
+  send/receive stamps riding heartbeats and traced frames) into a
+  Perfetto-loadable Chrome trace with cross-robot flow arrows.
 
 Instrumentation discipline on accelerator hot paths: never add a host sync
 inside jitted code.  The solvers extend their *existing* phase-boundary
@@ -39,7 +47,7 @@ a telemetry-off run is byte-identical to the uninstrumented driver.
 
 from __future__ import annotations
 
-from .events import EventStream, metric_record, read_events
+from .events import EventStream, metric_record, read_events, read_events_meta
 from .exporters import to_prometheus_text, write_tensorboard_scalars
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 from .run import (
@@ -50,6 +58,7 @@ from .run import (
     run_scope,
     start_run,
 )
+from . import trace  # noqa: E402  (span API: trace.span / trace.start_span)
 
 __all__ = [
     "Counter",
@@ -63,8 +72,10 @@ __all__ = [
     "materialize",
     "metric_record",
     "read_events",
+    "read_events_meta",
     "run_scope",
     "start_run",
     "to_prometheus_text",
+    "trace",
     "write_tensorboard_scalars",
 ]

@@ -19,6 +19,7 @@ import json
 import math
 import threading
 import time
+import warnings
 
 
 def _jsonable(v):
@@ -107,15 +108,37 @@ class EventStream:
 
 
 def read_events(path: str) -> list[dict]:
-    """Load a JSONL event file; skips blank lines, raises on corrupt ones."""
+    """Load a JSONL event file; skips blank lines.
+
+    A corrupt line in the MIDDLE of the file raises ``ValueError`` (the
+    stream is damaged, not merely cut short).  An unparseable FINAL line
+    is tolerated with a ``RuntimeWarning`` — a robot killed mid-write
+    (exactly the ``tests/test_chaos.py`` scenarios) truncates its last
+    line, and the events before it are intact and wanted.  Use
+    ``read_events_meta`` to get the truncation flag programmatically."""
+    events, _truncated = read_events_meta(path)
+    return events
+
+
+def read_events_meta(path: str) -> tuple[list[dict], bool]:
+    """``(events, truncated)``: like ``read_events`` but returns whether
+    the file ended in a truncated (unparseable) final line."""
     out = []
     with open(path, encoding="utf-8") as fh:
-        for ln, line in enumerate(fh, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                out.append(json.loads(line))
-            except json.JSONDecodeError as e:
-                raise ValueError(f"{path}:{ln}: corrupt event line") from e
-    return out
+        lines = fh.readlines()
+    last = max((i for i, ln in enumerate(lines) if ln.strip()), default=-1)
+    for ln, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError as e:
+            if ln == last:
+                warnings.warn(
+                    f"{path}:{ln + 1}: truncated final event line "
+                    "(writer killed mid-write?) — dropped",
+                    RuntimeWarning, stacklevel=2)
+                return out, True
+            raise ValueError(f"{path}:{ln + 1}: corrupt event line") from e
+    return out, False

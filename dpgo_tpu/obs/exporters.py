@@ -19,7 +19,11 @@ def _fmt_labels(labels: dict, extra: dict | None = None) -> str:
         return ""
     parts = []
     for k, v in sorted(merged.items()):
-        v = str(v).replace("\\", "\\\\").replace('"', '\\"')
+        # Text exposition format escapes: backslash first, then newline
+        # and quote — a raw newline in a label value splits the sample
+        # line and corrupts the whole scrape.
+        v = (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+             .replace('"', '\\"'))
         parts.append(f'{k}="{v}"')
     return "{" + ",".join(parts) + "}"
 

@@ -2,13 +2,17 @@
 
 Usage::
 
-    python -m dpgo_tpu.obs.report <run_dir> [<run_dir>...]
+    python -m dpgo_tpu.obs.report <run_dir> [<run_dir>...] [--json]
 
 Reads the artifacts a ``TelemetryRun`` persisted (``events.jsonl``,
 ``metrics.json``) and prints the run's story: event volume, per-iteration
 cost/gradient-norm trajectory, GNC mu annealing, round latency, per-phase
-wall-clock, and communication volume.  Pure host-side formatting — no
-devices are touched, so it runs anywhere the run directory is visible.
+wall-clock, communication volume, and — when the run carries ``span``
+events — the fleet timeline: per-robot busy/wait breakdown, per-round
+critical path, straggler ranking, and overlap efficiency.  ``--json``
+emits the same content machine-readably (one JSON document per run dir).
+Pure host-side formatting — no devices are touched, so it runs anywhere
+the run directory is visible.
 """
 
 from __future__ import annotations
@@ -18,10 +22,10 @@ import json
 import os
 import sys
 from collections import Counter as _TallyCounter
-from collections import defaultdict
 
-from .events import read_events
+from .events import read_events_meta
 from .run import EVENTS_FILE, META_FILE, METRICS_FILE
+from .timeline import fleet_timeline_stats
 
 
 def _fmt(v) -> str:
@@ -78,6 +82,44 @@ def _histogram_summary(name: str, fam: dict) -> list[str]:
     return out
 
 
+def _fleet_lines(stats: dict | None) -> list[str]:
+    """Render the fleet-timeline section (tracing spans present)."""
+    if not stats:
+        return []
+    lines = [f"fleet timeline: {stats['num_spans']} spans over "
+             f"{stats['window_s']:.2f}s, "
+             f"{stats['num_flow_links']} cross-robot frame links"]
+    for r, row in sorted(stats["robots"].items()):
+        who = "bus" if int(r) < 0 else f"robot {r}"
+        parts = [f"busy {row['busy_s']:.3f}s"]
+        if row["wait_s"]:
+            parts.append(f"wait {row['wait_s']:.3f}s")
+        if row["wire_s"]:
+            parts.append(f"wire {row['wire_s']:.3f}s")
+        if row["iterations"]:
+            parts.append(f"{row['iterations']} iterates @ "
+                         f"{(row['mean_iterate_s'] or 0) * 1e3:.2f}ms")
+        if row["overlap_efficiency"] is not None:
+            parts.append(
+                f"overlap eff {row['overlap_efficiency'] * 100:.0f}%")
+        lines.append(f"  {who}: " + ", ".join(parts))
+    rc = stats.get("round_critical_path")
+    if rc:
+        crit = ", ".join(f"robot {r} x{n}"
+                         for r, n in rc["critical_path_counts"].items())
+        lines.append(
+            f"  critical path over {rc['rounds']} rounds: makespan "
+            f"mean {rc['mean_makespan_s'] * 1e3:.2f}ms / p95 "
+            f"{rc['p95_makespan_s'] * 1e3:.2f}ms; ends on {crit}")
+    strag = stats.get("straggler_ranking")
+    if strag:
+        lines.append("  stragglers (mean iterate, slowest first): "
+                     + ", ".join(f"robot {s['robot']} "
+                                 f"{s['mean_iterate_s'] * 1e3:.2f}ms"
+                                 for s in strag[:5]))
+    return lines
+
+
 def render_report(run_dir: str) -> str:
     lines = [f"== telemetry report: {run_dir} =="]
     meta_path = os.path.join(run_dir, META_FILE)
@@ -87,7 +129,11 @@ def render_report(run_dir: str) -> str:
         lines.append(f"run id: {meta.get('run')}")
 
     ev_path = os.path.join(run_dir, EVENTS_FILE)
-    events = read_events(ev_path) if os.path.exists(ev_path) else []
+    events, truncated = read_events_meta(ev_path) \
+        if os.path.exists(ev_path) else ([], False)
+    if truncated:
+        lines.append("WARNING: event stream ends mid-line (writer killed "
+                     "mid-write?) — final event dropped")
     if events:
         dur = events[-1]["t_mono"] - events[0]["t_mono"]
         lines.append(f"events: {len(events)} over {dur:.2f}s")
@@ -171,6 +217,8 @@ def render_report(run_dir: str) -> str:
                     f"  {phase}: {row.get('total_s', 0.0):.4f}s "
                     f"/ {row.get('count', 0)} "
                     f"({row.get('avg_ms', 0.0):.2f} ms avg)")
+
+        lines.extend(_fleet_lines(fleet_timeline_stats(events)))
     else:
         lines.append("events: none")
 
@@ -195,20 +243,65 @@ def render_report(run_dir: str) -> str:
     return "\n".join(lines)
 
 
+def report_data(run_dir: str) -> dict:
+    """Machine-readable report for one run dir (the ``--json`` payload)."""
+    out: dict = {"run_dir": run_dir}
+    meta_path = os.path.join(run_dir, META_FILE)
+    if os.path.exists(meta_path):
+        with open(meta_path) as fh:
+            out["run"] = json.load(fh).get("run")
+    ev_path = os.path.join(run_dir, EVENTS_FILE)
+    events, truncated = read_events_meta(ev_path) \
+        if os.path.exists(ev_path) else ([], False)
+    out["truncated"] = truncated
+    out["num_events"] = len(events)
+    if events:
+        out["duration_s"] = events[-1]["t_mono"] - events[0]["t_mono"]
+        out["event_kinds"] = dict(_TallyCounter(
+            ev.get("event", "?") for ev in events))
+        out["metric_events"] = [
+            ev for ev in events if ev.get("event") == "metric"]
+        out["network"] = [ev for ev in events
+                          if ev.get("event") == "run_summary"]
+        out["fleet_timeline"] = fleet_timeline_stats(events)
+    m_path = os.path.join(run_dir, METRICS_FILE)
+    if os.path.exists(m_path):
+        with open(m_path) as fh:
+            out["metrics"] = json.load(fh).get("metrics", {})
+    return out
+
+
+def _run_dir_error(rd: str) -> str | None:
+    """Reject a missing or empty run dir with a clean message."""
+    if not os.path.isdir(rd):
+        return f"not a run directory: {rd}"
+    if not any(os.path.exists(os.path.join(rd, f))
+               for f in (EVENTS_FILE, METRICS_FILE, META_FILE)):
+        return f"empty run directory (no telemetry artifacts): {rd}"
+    return None
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m dpgo_tpu.obs.report", description=__doc__)
     ap.add_argument("run_dir", nargs="+",
                     help="telemetry run directory (holds events.jsonl)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output (one JSON document per "
+                         "run dir) instead of the text report")
     args = ap.parse_args(argv)
     rc = 0
     try:
         for rd in args.run_dir:
-            if not os.path.isdir(rd):
-                print(f"not a run directory: {rd}", file=sys.stderr)
+            err = _run_dir_error(rd)
+            if err is not None:
+                print(err, file=sys.stderr)
                 rc = 2
                 continue
-            print(render_report(rd))
+            if args.json:
+                print(json.dumps(report_data(rd)))
+            else:
+                print(render_report(rd))
     except BrokenPipeError:
         # Downstream pager/head closed the pipe — normal CLI etiquette.
         try:

@@ -49,6 +49,11 @@ def main() -> None:
     ap.add_argument("--timeout", type=float, default=30.0,
                     help="wall-clock budget in seconds")
     ap.add_argument("--log-dir", default=None)
+    ap.add_argument("--telemetry", default=None, metavar="DIR",
+                    help="scope a dpgo_tpu.obs run here: metrics, events, "
+                         "and distributed-tracing spans; a Perfetto-"
+                         "loadable DIR/trace.json and the fleet report "
+                         "are emitted after the run")
     ap.add_argument("--staleness", type=int, default=1,
                     help="network-loop overlap bound: >=1 double-buffers "
                          "each robot's publish/collect against its "
@@ -78,6 +83,7 @@ def main() -> None:
 
     setup_jax()
 
+    from dpgo_tpu import obs
     from dpgo_tpu.agent import PGOAgent
     from dpgo_tpu.comms import (FaultInjector, FaultSpec, RetryPolicy,
                                 TransportClosed, apply_peer_frame,
@@ -86,6 +92,8 @@ def main() -> None:
     from dpgo_tpu.utils.g2o import read_g2o
     from dpgo_tpu.utils.partition import agent_measurements, \
         partition_contiguous
+
+    run = obs.start_run(args.telemetry) if args.telemetry else None
 
     meas = read_g2o(args.dataset)
     print(f"Loaded {len(meas)} measurements over {meas.num_poses} poses "
@@ -207,6 +215,16 @@ def main() -> None:
             if ag.robot_id not in killed:
                 ag.log_trajectory()
         print(f"Per-robot dumps under {args.log_dir}/robot*/")
+    if run is not None:
+        obs.end_run()
+        from dpgo_tpu.obs import timeline
+        from dpgo_tpu.obs.report import render_report
+        trace_path = os.path.join(args.telemetry, "trace.json")
+        timeline.write_chrome_trace(trace_path,
+                                    timeline.merge([args.telemetry]))
+        print(render_report(args.telemetry), file=sys.stderr)
+        print(f"Perfetto timeline: {trace_path} "
+              "(open in https://ui.perfetto.dev)")
 
 
 if __name__ == "__main__":

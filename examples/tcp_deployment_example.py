@@ -357,15 +357,41 @@ def launch(args) -> int:
     }
     print(json.dumps(result))
     if args.telemetry:
+        from dpgo_tpu.obs import timeline
         from dpgo_tpu.obs.report import render_report
         tdir = os.path.join(out_dir, "telemetry")
+        run_dirs = []
         for sub in ["bus"] + [f"robot{r}" for r in range(args.robots)]:
             rd = os.path.join(tdir, sub)
             if os.path.isdir(rd):
+                run_dirs.append(rd)
                 print(file=sys.stderr)
                 print(render_report(rd), file=sys.stderr)
+        # The fleet timeline: every process wrote its own event stream on
+        # its own clock; merge estimates the per-process clock offsets
+        # (from the stamps riding heartbeats and traced frames, relayed
+        # through the bus) and renders one Perfetto-loadable trace with
+        # cross-robot flow arrows.
+        try:
+            tl = timeline.merge(run_dirs)
+            trace_path = timeline.write_chrome_trace(
+                os.path.join(out_dir, "trace.json"), tl)
+            counts = timeline.validate_chrome_trace(trace_path)
+            print(f"\nFleet timeline: {trace_path} "
+                  f"({counts['spans']} spans, {counts['flows']} flow "
+                  f"edges) — open in https://ui.perfetto.dev",
+                  file=sys.stderr)
+            for s in tl.offsets["streams"]:
+                unc = ("?" if s["uncertainty_s"] is None
+                       else f"±{s['uncertainty_s'] * 1e3:.2f}ms")
+                print(f"  clock {os.path.basename(s['path'])}: "
+                      f"offset {s['offset_s'] * 1e3:+.2f}ms {unc}",
+                      file=sys.stderr)
+        except ValueError as e:
+            print(f"\nFleet timeline export failed: {e}", file=sys.stderr)
         print(f"\nPer-robot telemetry under {tdir} — re-render with: "
-              f"python -m dpgo_tpu.obs.report {tdir}/robot<id>",
+              f"python -m dpgo_tpu.obs.report {tdir}/robot<id>; re-merge "
+              f"with: python -m dpgo_tpu.obs.timeline {tdir}/*",
               file=sys.stderr)
     return 0
 

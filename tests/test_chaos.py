@@ -333,6 +333,7 @@ def test_chaos_comms_layer_zero_obs_events_when_telemetry_off(monkeypatch):
     peer-lost bookkeeping — adds ZERO obs events and registry calls."""
     from dpgo_tpu.obs import run as obs_run_mod
     from dpgo_tpu.obs import metrics as obs_metrics_mod
+    from dpgo_tpu.obs import trace as obs_trace_mod
     from dpgo_tpu.obs.events import EventStream
 
     def boom(*a, **kw):
@@ -345,6 +346,8 @@ def test_chaos_comms_layer_zero_obs_events_when_telemetry_off(monkeypatch):
     monkeypatch.setattr(obs_metrics_mod.Gauge, "set", boom)
     monkeypatch.setattr(obs_metrics_mod.Histogram, "observe", boom)
     monkeypatch.setattr(obs_metrics_mod.Histogram, "observe_many", boom)
+    monkeypatch.setattr(obs_trace_mod.Span, "__init__", boom)
+    monkeypatch.setattr(obs_trace_mod, "emit_span", boom)
     assert obs.get_run() is None
 
     injector = FaultInjector(FaultSpec(drop=0.3, reorder=0.5), seed=11)

@@ -102,6 +102,12 @@ def test_prometheus_text_exposition():
     assert "# TYPE dpgo_msgs counter" in text
     assert '# HELP dpgo_msgs messages sent' in text
     assert 'dpgo_msgs{robot="1"} 3.0' in text
+    # Label-value escaping per the text exposition format: backslash,
+    # newline (previously unescaped — it split the sample line), quote.
+    reg.counter("dpgo_esc").inc(1, path='a\\b\n"c"')
+    esc = to_prometheus_text(reg)
+    assert 'dpgo_esc{path="a\\\\b\\n\\"c\\""} 1.0' in esc
+    assert "\na" not in esc.split("dpgo_esc", 1)[1].split("\n")[0]
     assert "# TYPE dpgo_lat histogram" in text
     # Cumulative buckets and the +Inf tail.
     assert 'dpgo_lat_bucket{le="0.1"} 1' in text
@@ -392,24 +398,27 @@ def test_sharded_solve_telemetry(tmp_path):
 
 def test_telemetry_off_is_zero_overhead(monkeypatch):
     """With no ambient run, an instrumented solve emits ZERO events, makes
-    ZERO registry calls, and performs ZERO obs-owned device->host
-    transfers in the RBCD round loop — the instrumentation's only cost is
-    the ``get_run() is None`` guard."""
+    ZERO registry calls, performs ZERO obs-owned device->host transfers in
+    the RBCD round loop, and constructs ZERO tracing spans — the
+    instrumentation's only cost is the ``get_run() is None`` guard."""
     from dpgo_tpu.config import AgentParams
     from dpgo_tpu.models import rbcd
     from dpgo_tpu.obs import metrics as metrics_mod
+    from dpgo_tpu.obs import trace as trace_mod
 
     def boom(*a, **kw):
         raise AssertionError("telemetry path taken while disabled")
 
-    # Any event emission, any registry mutation, any obs-owned transfer
-    # trips the failure.
+    # Any event emission, any registry mutation, any obs-owned transfer,
+    # any span construction trips the failure.
     monkeypatch.setattr(EventStream, "emit", boom)
     monkeypatch.setattr(run_mod, "materialize", boom)
     monkeypatch.setattr(obs, "materialize", boom)
     monkeypatch.setattr(metrics_mod.Counter, "inc", boom)
     monkeypatch.setattr(metrics_mod.Gauge, "set", boom)
     monkeypatch.setattr(metrics_mod.Histogram, "observe_many", boom)
+    monkeypatch.setattr(trace_mod.Span, "__init__", boom)
+    monkeypatch.setattr(trace_mod, "emit_span", boom)
 
     assert obs.get_run() is None
     meas = _tiny_problem()
@@ -425,12 +434,15 @@ def test_telemetry_off_is_zero_overhead(monkeypatch):
 
 def test_telemetry_off_agent_paths(monkeypatch):
     from test_agent import exchange, make_agents
+    from dpgo_tpu.obs import trace as trace_mod
 
     def boom(*a, **kw):
         raise AssertionError("telemetry path taken while disabled")
 
     monkeypatch.setattr(EventStream, "emit", boom)
     monkeypatch.setattr(run_mod, "materialize", boom)
+    monkeypatch.setattr(trace_mod.Span, "__init__", boom)
+    monkeypatch.setattr(trace_mod, "emit_span", boom)
 
     agents, _part, _ = make_agents(2, n=10, num_lc=4)
     for _ in range(2):

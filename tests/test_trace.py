@@ -1,0 +1,488 @@
+"""Distributed tracing for the deployment plane: spans, cross-process
+trace context, clock-offset estimation, the merged Perfetto timeline, and
+the fleet report — including the acceptance chaos scenario (4-robot
+loopback fleet, 10% drop, one robot killed mid-solve) and the telemetry-
+off zero-overhead fence extended to tracing."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dpgo_tpu import obs
+from dpgo_tpu.obs import timeline, trace
+from dpgo_tpu.obs.events import read_events, read_events_meta
+from dpgo_tpu.obs.report import main as report_main
+
+NUM_ROBOTS = 4
+ROUNDS = 40
+KILL = (3, 25)      # robot 3 dies at round 25
+PACE_S = 0.003
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_ambient_run():
+    obs.end_run()
+    yield
+    obs.end_run()
+
+
+# ---------------------------------------------------------------------------
+# Span primitives
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_event_schema(tmp_path):
+    d = str(tmp_path / "run")
+    with obs.run_scope(d):
+        with trace.span("outer", phase="compute", robot=2) as outer:
+            outer.add(items=3)
+            with trace.span("inner", phase="comms", robot=2) as inner:
+                pass
+        lone = trace.start_span("lone", phase="eval")
+        lone.end(ok=True)
+    evs = [e for e in read_events(os.path.join(d, "events.jsonl"))
+           if e["event"] == "span"]
+    by_name = {e["name"]: e for e in evs}
+    # inner closed first (context exit order), parented under outer,
+    # sharing its trace id.
+    assert [e["name"] for e in evs] == ["inner", "outer", "lone"]
+    assert by_name["inner"]["parent"] == by_name["outer"]["span"]
+    assert by_name["inner"]["trace"] == by_name["outer"]["trace"]
+    assert by_name["outer"]["items"] == 3
+    assert by_name["outer"]["robot"] == 2
+    assert by_name["lone"]["ok"] is True
+    assert "parent" not in by_name["lone"]
+    for e in evs:
+        assert len(e["span"]) == 16 and len(e["trace"]) == 16
+        assert e["dur_s"] >= 0.0
+        assert e["t0_mono"] <= e["t_mono"]
+
+
+def test_span_is_noop_without_run():
+    assert trace.span("x") is trace.NULL_SPAN
+    assert trace.start_span("x") is None
+    with trace.span("x") as sp:
+        sp.add(a=1).end()  # all no-ops
+    assert trace.current_span() is None
+
+
+# ---------------------------------------------------------------------------
+# Wire trace context (optional frame entries, both codecs, old-peer safe)
+# ---------------------------------------------------------------------------
+
+def test_trace_wire_entries_ride_both_codecs():
+    from dpgo_tpu.comms import (decode_payload, encode_payload,
+                                pack_pose_set, pack_trace_entries,
+                                unpack_pose_set, unpack_trace_entries)
+
+    poses = {(0, 1): np.eye(5, 4), (1, 2): np.ones((5, 4))}
+    frame = pack_pose_set("pose", poses)
+    frame.update(pack_trace_entries(0x1234, 0x5678, 1))
+    for wire_format in ("packed", "npz"):
+        decoded = decode_payload(encode_payload(frame, wire_format))
+        # An old peer's pose parsing is undisturbed by the extra entries.
+        got = unpack_pose_set(dict(decoded), "pose")
+        assert set(got) == set(poses)
+        ctx = unpack_trace_entries(decoded)
+        assert ctx is not None
+        trace_id, span_id, robot, t_mono, t_wall = ctx
+        assert (trace_id, span_id, robot) == (0x1234, 0x5678, 1)
+        assert t_mono > 0 and t_wall > 0
+        # pop=True removed the entries from the frame.
+        assert unpack_trace_entries(decoded) is None
+
+
+def test_trace_wire_entries_mangled_is_dropped():
+    from dpgo_tpu.comms import (TRACE_IDS_KEY, TRACE_T_KEY,
+                                unpack_trace_entries)
+
+    assert unpack_trace_entries({}) is None
+    bad = {TRACE_IDS_KEY: np.asarray([1], np.int64),       # too short
+           TRACE_T_KEY: np.asarray([1.0, 2.0])}
+    assert unpack_trace_entries(bad) is None
+
+
+def test_telemetry_off_wire_carries_no_trace_or_clock_entries():
+    """With telemetry off the wire is byte-identical to the untraced
+    protocol: no clock stamp, no trace context, and no Span is ever
+    constructed (the zero-overhead acceptance fence for tracing)."""
+    from dpgo_tpu.comms import BusClient, ReliableChannel
+    from dpgo_tpu.comms.protocol import (CLOCK_KEY, TRACE_IDS_KEY,
+                                         TRACE_T_KEY)
+    from dpgo_tpu.comms.transport import LoopbackTransport
+
+    assert obs.get_run() is None
+    t_robot, t_bus = LoopbackTransport.pair("robot0", "bus")
+    client = BusClient(ReliableChannel(t_robot, "robot0->bus"), 0)
+    client.publish({"x": np.arange(3)})
+    frame = t_bus.recv(timeout=1.0)
+    assert CLOCK_KEY not in frame
+    assert TRACE_IDS_KEY not in frame and TRACE_T_KEY not in frame
+    assert set(frame) == {"x", "_seq", "_kind"}
+
+
+def test_telemetry_on_wire_carries_trace_and_clock_entries(tmp_path):
+    from dpgo_tpu.comms import BusClient, ReliableChannel
+    from dpgo_tpu.comms.protocol import (CLOCK_KEY, TRACE_IDS_KEY,
+                                         TRACE_T_KEY)
+    from dpgo_tpu.comms.transport import LoopbackTransport
+
+    with obs.run_scope(str(tmp_path / "run")):
+        t_robot, t_bus = LoopbackTransport.pair("robot0", "bus")
+        client = BusClient(ReliableChannel(t_robot, "robot0->bus"), 0)
+        client.publish({"x": np.arange(3)})
+        frame = t_bus.recv(timeout=1.0)
+        assert CLOCK_KEY in frame
+        assert np.asarray(frame[CLOCK_KEY])[0] == 0.0  # origin robot 0
+        ids = np.asarray(frame[TRACE_IDS_KEY])
+        assert ids[2] == 0 and ids[0] > 0 and ids[1] > 0
+        assert TRACE_T_KEY in frame
+
+
+# ---------------------------------------------------------------------------
+# Clock-offset estimation + span merge (synthetic, known injected offset)
+# ---------------------------------------------------------------------------
+
+OFFSET_S = 1.7                  # robot 1's clock runs 1.7s ahead
+LATENCY_S = 0.005
+JITTER_S = 0.001
+
+
+def _write_stream(path, robot, events):
+    with open(path, "w") as fh:
+        for i, e in enumerate(events):
+            fh.write(json.dumps({"run": f"r{robot}", "seq": i, **e}) + "\n")
+
+
+def _synthetic_pair(tmp_path, n_samples=60, seed=0):
+    """Two event files: robot 0 on the true clock, robot 1 shifted by
+    OFFSET_S, exchanging stamped frames with ~LATENCY_S +- JITTER_S."""
+    rng = np.random.default_rng(seed)
+    t_wall0 = 1_700_000_000.0
+    a_events, b_events = [], []
+    for k in range(n_samples):
+        t = 10.0 + 0.05 * k
+        lat_ab = LATENCY_S + float(rng.normal(0, JITTER_S))
+        lat_ba = LATENCY_S + float(rng.normal(0, JITTER_S))
+        # 0 -> 1: sent on A's clock, received on B's (shifted) clock.
+        b_events.append({
+            "event": "clock_sample", "phase": "comms", "src": 0, "dst": 1,
+            "t_mono": t + abs(lat_ab) + OFFSET_S, "t_wall": t_wall0 + t,
+            "t_send_mono": t, "t_send_wall": t_wall0 + t})
+        # 1 -> 0.
+        a_events.append({
+            "event": "clock_sample", "phase": "comms", "src": 1, "dst": 0,
+            "t_mono": t + abs(lat_ba), "t_wall": t_wall0 + t,
+            "t_send_mono": t + OFFSET_S, "t_send_wall": t_wall0 + t})
+        # One iterate span per robot per round, same TRUE start time.
+        a_events.append({
+            "event": "span", "phase": "compute", "name": "iterate",
+            "robot": 0, "trace": f"{k:016x}", "span": f"{k:016x}",
+            "t_mono": t + 0.01, "t_wall": t_wall0 + t,
+            "t0_mono": t, "t0_wall": t_wall0 + t, "dur_s": 0.01,
+            "iteration": k})
+        b_events.append({
+            "event": "span", "phase": "compute", "name": "iterate",
+            "robot": 1, "trace": f"{k:016x}", "span": f"{k + 1:016x}",
+            "t_mono": t + 0.01 + OFFSET_S, "t_wall": t_wall0 + t,
+            "t0_mono": t + OFFSET_S, "t0_wall": t_wall0 + t,
+            "dur_s": 0.01, "iteration": k})
+    pa, pb = str(tmp_path / "robot0.jsonl"), str(tmp_path / "robot1.jsonl")
+    _write_stream(pa, 0, a_events)
+    _write_stream(pb, 1, b_events)
+    return pa, pb
+
+
+def test_clock_offset_estimated_within_tolerance(tmp_path):
+    pa, pb = _synthetic_pair(tmp_path)
+    tl = timeline.merge([pa, pb])
+    s0, s1 = tl.streams
+    assert s0.aligned and s1.aligned
+    assert s0.offset == 0.0                      # reference stream
+    # Symmetric latency cancels: the estimate lands within a few jitter
+    # standard deviations of the injected 1.7s.
+    assert s1.offset == pytest.approx(OFFSET_S, abs=0.003)
+    assert s1.uncertainty is not None
+    # Uncertainty is honest: about half the RTT plus spread.
+    assert 0.0 < s1.uncertainty < 0.05
+    (pair,) = tl.offsets["pairs"]
+    assert pair["bidirectional"] is True
+    assert pair["samples"] == 120
+
+
+def test_span_merge_rebases_onto_common_timeline(tmp_path):
+    pa, pb = _synthetic_pair(tmp_path)
+    tl = timeline.merge([pa, pb])
+    spans = [e for e in tl.events if e.get("event") == "span"]
+    by_round = {}
+    for e in spans:
+        by_round.setdefault(e["iteration"], {})[e["robot"]] = e
+    # Per round the two robots started simultaneously in TRUE time; after
+    # rebasing their t0 must agree within the estimation tolerance
+    # (before rebasing they disagreed by 1.7s).
+    for k, pair in by_round.items():
+        assert abs(pair[0]["t0_mono"] - pair[1]["t0_mono"]) < 0.01
+    # The merged order interleaves the two robots round by round.
+    order = [e["robot"] for e in sorted(spans,
+                                        key=lambda e: e["t0_mono"])]
+    assert order[:4].count(0) == 2 and order[:4].count(1) == 2
+
+
+def test_one_way_samples_flagged_latency_biased(tmp_path):
+    pa, pb = _synthetic_pair(tmp_path)
+    # Strip B's samples of A -> only one direction remains.
+    evs, _ = read_events_meta(pb)
+    one_way = [e for e in evs if e.get("event") != "clock_sample"]
+    _write_stream(pb, 1, one_way)
+    tl = timeline.merge([pa, pb])
+    (pair,) = tl.offsets["pairs"]
+    assert pair["bidirectional"] is False
+    # Offset still recovered to within the (unremovable) one-way latency.
+    assert tl.streams[1].offset == pytest.approx(OFFSET_S,
+                                                 abs=2 * LATENCY_S + 0.01)
+
+
+def test_unaligned_stream_is_flagged(tmp_path):
+    pa, pb = _synthetic_pair(tmp_path)
+    # Remove ALL clock samples: no path between the two clock domains.
+    for p, rid in ((pa, 0), (pb, 1)):
+        evs, _ = read_events_meta(p)
+        _write_stream(p, rid,
+                      [e for e in evs if e.get("event") != "clock_sample"])
+    tl = timeline.merge([pa, pb])
+    flags = {s.path: s.aligned for s in tl.streams}
+    assert sum(flags.values()) == 1  # only the reference is aligned
+
+
+# ---------------------------------------------------------------------------
+# Traced loopback fleet (the deployment plane end to end)
+# ---------------------------------------------------------------------------
+
+def _make_problem(num_robots, seed=0, n=24, num_lc=12):
+    from dpgo_tpu.utils.partition import partition_contiguous
+    from dpgo_tpu.utils.synthetic import make_measurements
+
+    rng = np.random.default_rng(seed)
+    meas, _ = make_measurements(rng, n=n, d=3, num_lc=num_lc,
+                                rot_noise=0.01, trans_noise=0.01)
+    return meas, partition_contiguous(meas, num_robots)
+
+
+def _run_fleet(part, num_robots, injector=None, kill=None, rounds=ROUNDS,
+               pace_s=0.0):
+    """Lockstep loopback fleet driver (the in-process twin of the TCP
+    example's robot loop), traced when a run is ambient."""
+    from dpgo_tpu.agent import PGOAgent
+    from dpgo_tpu.comms import (RetryPolicy, apply_peer_frame,
+                                loopback_fleet, pack_agent_frame)
+    from dpgo_tpu.config import AgentParams
+
+    from dpgo_tpu.utils.partition import agent_measurements
+
+    params = AgentParams(d=3, r=5, num_robots=num_robots)
+    agents = {rid: PGOAgent(rid, params) for rid in range(num_robots)}
+    for rid in range(1, num_robots):
+        agents[rid].set_lifting_matrix(agents[0].get_lifting_matrix())
+    for rid, ag in agents.items():
+        ag.set_pose_graph(*agent_measurements(part, rid))
+    policy = RetryPolicy(max_attempts=2, base_delay_s=0.002,
+                         max_delay_s=0.01, send_timeout_s=0.5,
+                         recv_timeout_s=0.5)
+    bus, clients = loopback_fleet(num_robots, injector=injector,
+                                  policy=policy, round_timeout_s=0.15,
+                                  miss_limit=5, liveness_timeout_s=0.5)
+    for c in clients.values():
+        c.channel.start_heartbeat(0.05)
+    dead = set()
+    for it in range(rounds):
+        if kill is not None and it == kill[1]:
+            dead.add(kill[0])
+            clients[kill[0]].close()
+        for rid, ag in agents.items():
+            if rid in dead:
+                continue
+            clients[rid].publish(
+                pack_agent_frame(ag, include_anchor=(rid == 0)),
+                timeout=0.5)
+        bus.round()
+        for rid, ag in agents.items():
+            if rid in dead:
+                continue
+            merged = clients[rid].collect(timeout=0.3)
+            if merged is not None:
+                for peer, pf in clients[rid].peer_frames(merged).items():
+                    apply_peer_frame(ag, peer, pf,
+                                     accept_anchor=(rid != 0 and peer == 0))
+                for lost in clients[rid].lost:
+                    ag.mark_neighbor_lost(lost)
+            ag.iterate(True)
+        if pace_s:
+            time.sleep(pace_s)
+    bus.close()
+    for rid, c in clients.items():
+        if rid not in dead:
+            c.close()
+    return agents, bus
+
+
+def test_traced_loopback_solve_produces_valid_chrome_trace(tmp_path):
+    """A traced 2-robot loopback solve exports a schema-valid Chrome
+    trace with at least one cross-robot flow edge per round — the CI
+    traced-deployment smoke."""
+    rounds = 8
+    meas, part = _make_problem(2)
+    d = str(tmp_path / "run")
+    with obs.run_scope(d):
+        _run_fleet(part, 2, rounds=rounds)
+
+    tl = timeline.merge([d])
+    trace_path = timeline.write_chrome_trace(
+        str(tmp_path / "trace.json"), tl)
+    with open(trace_path) as fh:
+        obj = json.load(fh)          # the file parses as plain JSON
+    counts = timeline.validate_chrome_trace(obj)
+    assert counts["spans"] > 4 * rounds   # publish/collect/scatter/iterate
+    assert counts["cross_robot_flows"] >= rounds
+    assert counts["pids"] >= 3            # bus + 2 robots
+    # Round-trips through the validator from the PATH form too.
+    assert timeline.validate_chrome_trace(trace_path) == counts
+    # Every robot's iterate spans are present as X events on its track.
+    xs = [e for e in obj["traceEvents"] if e.get("ph") == "X"
+          and e.get("name") == "iterate"]
+    assert {e["pid"] for e in xs} == {2, 3}
+    # Flow arrows bind sender publish time to receiver scatter: the
+    # start must not be after the finish (validator also enforces).
+    names = {e.get("name") for e in obj["traceEvents"]}
+    assert {"publish", "collect", "scatter", "bus_round", "frame"} <= names
+
+
+def test_chaos_traced_fleet_merged_trace_and_report(tmp_path, capsys):
+    """The acceptance scenario: a traced 4-robot loopback chaos run (10%
+    drop, robot 3 killed mid-solve) produces a merged Chrome trace where
+    every surviving robot's rounds share the timeline, cross-robot frame
+    edges render as flows, and the report CLI prints per-robot busy/wait
+    and critical-path stats (text and --json)."""
+    from dpgo_tpu.comms import FaultInjector, FaultSpec
+
+    meas, part = _make_problem(NUM_ROBOTS)
+    injector = FaultInjector(FaultSpec(drop=0.10), seed=7)
+    d = str(tmp_path / "chaos")
+    with obs.run_scope(d):
+        agents, bus = _run_fleet(part, NUM_ROBOTS, injector=injector,
+                                 kill=KILL, pace_s=PACE_S)
+    assert injector.stats["dropped"] > 0
+    assert bus.lost == {KILL[0]}
+    survivors = [r for r in range(NUM_ROBOTS) if r != KILL[0]]
+
+    # -- merged trace ------------------------------------------------------
+    tl = timeline.merge([d])
+    trace_path = timeline.write_chrome_trace(str(tmp_path / "t.json"), tl)
+    counts = timeline.validate_chrome_trace(trace_path)
+    assert counts["cross_robot_flows"] > 0
+    evs = tl.events
+    per_robot_iters = {
+        r: {e["iteration"] for e in evs if e.get("event") == "span"
+            and e.get("name") == "iterate" and e.get("robot") == r}
+        for r in survivors}
+    for r in survivors:
+        # Every survivor's rounds appear on the common timeline (late
+        # initialization may cost the non-anchor robots a few iterates).
+        assert len(per_robot_iters[r]) >= ROUNDS - 6, \
+            f"robot {r}: {len(per_robot_iters[r])} rounds on timeline"
+    # The killed robot stops appearing after its death round.
+    dead_iters = {e["iteration"] for e in evs if e.get("event") == "span"
+                  and e.get("name") == "iterate"
+                  and e.get("robot") == KILL[0]}
+    assert dead_iters and max(dead_iters) <= KILL[1] + 1
+
+    # -- report CLI --------------------------------------------------------
+    assert report_main([d]) == 0
+    out = capsys.readouterr().out
+    assert "fleet timeline:" in out
+    assert "busy" in out and "wait" in out
+    assert "critical path over" in out
+    assert "stragglers" in out
+
+    assert report_main(["--json", d]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    ft = rec["fleet_timeline"]
+    assert ft["num_flow_links"] > 0
+    for r in survivors:
+        row = ft["robots"][str(r)] if str(r) in ft["robots"] \
+            else ft["robots"][r]
+        assert row["busy_s"] > 0
+        assert row["iterations"] >= ROUNDS - 6
+    assert ft["round_critical_path"]["rounds"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Report CLI satellites
+# ---------------------------------------------------------------------------
+
+def test_report_cli_errors_on_missing_and_empty_dirs(tmp_path, capsys):
+    missing = str(tmp_path / "nope")
+    assert report_main([missing]) == 2
+    assert "not a run directory" in capsys.readouterr().err
+
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    assert report_main([empty]) == 2
+    assert "empty run directory" in capsys.readouterr().err
+
+    assert report_main(["--json", missing]) == 2
+
+
+def test_report_json_output_schema(tmp_path, capsys):
+    d = str(tmp_path / "run")
+    with obs.run_scope(d) as run:
+        run.metric("solver_cost", 1.5, phase="eval", iteration=1)
+        with trace.span("iterate", phase="compute", robot=0):
+            pass
+    assert report_main(["--json", d]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["run"] == run.run_id
+    assert rec["truncated"] is False
+    assert rec["event_kinds"]["span"] == 1
+    assert rec["fleet_timeline"]["robots"]
+    assert "metrics" in rec
+
+
+# ---------------------------------------------------------------------------
+# Truncated-tail tolerance (robot killed mid-write)
+# ---------------------------------------------------------------------------
+
+def test_read_events_tolerates_truncated_final_line(tmp_path):
+    p = str(tmp_path / "e.jsonl")
+    with open(p, "w") as fh:
+        fh.write(json.dumps({"event": "a", "seq": 0}) + "\n")
+        fh.write(json.dumps({"event": "b", "seq": 1}) + "\n")
+        fh.write('{"event": "c", "se')          # killed mid-write
+    with pytest.warns(RuntimeWarning, match="truncated final event line"):
+        evs = read_events(p)
+    assert [e["event"] for e in evs] == ["a", "b"]
+    with pytest.warns(RuntimeWarning):
+        evs, truncated = read_events_meta(p)
+    assert truncated and len(evs) == 2
+
+
+def test_read_events_still_raises_on_mid_file_corruption(tmp_path):
+    p = str(tmp_path / "e.jsonl")
+    with open(p, "w") as fh:
+        fh.write(json.dumps({"event": "a"}) + "\n")
+        fh.write("{definitely not json}\n")
+        fh.write(json.dumps({"event": "c"}) + "\n")
+    with pytest.raises(ValueError, match="corrupt event line"):
+        read_events(p)
+
+
+def test_timeline_cli(tmp_path, capsys):
+    pa, pb = _synthetic_pair(tmp_path)
+    out = str(tmp_path / "fleet.json")
+    assert timeline.main([pa, pb, "-o", out, "--report"]) == 0
+    printed = capsys.readouterr().out
+    assert "flow edges" in printed and "clock" in printed
+    counts = timeline.validate_chrome_trace(out)
+    assert counts["spans"] == 120
+    assert timeline.main([str(tmp_path / "missing")]) == 2
