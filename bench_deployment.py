@@ -239,6 +239,9 @@ def main() -> None:
     run = obs.start_run(args.telemetry) if args.telemetry else None
 
     meas, ds_name = load_measurements(args.n)
+    if run is not None:
+        run.set_fingerprint(dataset=ds_name, num_robots=args.robots,
+                            rank=args.rank)
     log(f"[bench_deployment] {ds_name}: {len(meas)} measurements over "
         f"{meas.num_poses} poses, {args.robots} robots, r={args.rank}")
 

@@ -167,6 +167,14 @@ class PGOAgent:
         self._nbr_pose_seq: dict[int, int] = {}
         self._nbr_aux_seq: dict[int, int] = {}
         self._lost_neighbors: set[int] = set()
+        # Numerical-health bookkeeping (dpgo_tpu.obs.health): anomalies
+        # this robot detected locally (NaN'd neighbor frames, non-finite
+        # iterate change).  The counters ride the agent's outgoing bus
+        # frame (``comms.bus.pack_agent_frame``) so the hub sees
+        # fleet-wide health; nonzero only when telemetry was on (detection
+        # is behind the zero-overhead fence).
+        self._anom_count = 0
+        self._anom_worst = 0  # 0 none / 1 warning / 2 critical
         self._global_anchor: np.ndarray | None = None
         # Nesterov sequences (PGOAgent.cpp:1054-1091)
         self._V: np.ndarray | None = None
@@ -519,6 +527,28 @@ class PGOAgent:
         seq_cache[neighbor_id] = int(sequence)
         return True
 
+    def _obs_anomaly(self, kind: str, severity: str, **fields) -> None:
+        """Report one locally-detected numerical anomaly through the run's
+        health monitor (``anomaly`` event + counter + dump/abort policy)
+        and bump the counters that ride this robot's outgoing bus frame.
+        Zero work when no run is ambient."""
+        run = obs.get_run()
+        if run is None:
+            return
+        from .obs.health import SEVERITIES, monitor_for
+
+        monitor_for(run).anomaly(kind, severity, robot=self.robot_id,
+                                 iteration=self._status.iteration_number,
+                                 **fields)
+        self._anom_count += 1
+        self._anom_worst = max(self._anom_worst,
+                               SEVERITIES.index(severity) + 1)
+
+    def health_counters(self) -> tuple[int, int]:
+        """``(anomaly_count, worst_severity)`` — worst is 0 none /
+        1 warning / 2 critical.  The payload ``pack_agent_frame`` ships."""
+        return self._anom_count, self._anom_worst
+
     def _obs_stale_dropped(self, neighbor_id: int) -> None:
         run = obs.get_run()
         if run is None:
@@ -601,6 +631,17 @@ class PGOAgent:
         vals = np.asarray(vals, np.float64)
         self._obs_comms_bytes("received", vals.nbytes + 8 * robots.size,
                               neighbor_id)
+        # NaN sentinel on the ingested neighbor frame (telemetry-on only:
+        # the isfinite sweep over the few public pose blocks is obs-owned
+        # work).  Detection only — the frame is still applied, so the
+        # solver's math is identical with telemetry on or off; the
+        # anomaly event + flight recorder are how the poisoning is
+        # diagnosed, and the counters ride the bus for fleet-wide view.
+        if obs.get_run() is not None and vals.size \
+                and not np.isfinite(vals).all():
+            self._obs_anomaly("non_finite_neighbor_frame", "critical",
+                              neighbor=int(neighbor_id),
+                              poses=int(vals.shape[0]))
         with self._lock:
             self._scatter_neighbor(robots, poses, vals)
             if (self._status.state == AgentState.WAIT_FOR_INITIALIZATION
@@ -1119,6 +1160,12 @@ class PGOAgent:
                           iteration=self._status.iteration_number,
                           stepped=stepped, rel_change=rel,
                           ready=bool(ready), latency_s=dt)
+                if stepped and not np.isfinite(rel):
+                    # The one scalar this path reads back went non-finite:
+                    # this robot's iterate (or a poisoned neighbor frame
+                    # it consumed) has diverged.
+                    self._obs_anomaly("non_finite_rel_change", "critical",
+                                      rel_change=rel)
                 # The compute half of the fleet timeline: one span per
                 # iterate, reusing the timestamps measured above.
                 trace.emit_span(run, "iterate", t0, t0_wall, dt,

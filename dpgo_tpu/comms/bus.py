@@ -93,6 +93,7 @@ class RoundBus:
         self._last_frames: dict[int, dict] = {}
         self._last_seqs: dict[int, int] = {}
         self._misses: dict[int, int] = {rid: 0 for rid in channels}
+        self._anom_seen: dict[int, int] = {}  # rid -> last gossiped count
         self.rounds_served = 0
 
     def _mark_lost(self, rid: int, reason: str) -> None:
@@ -133,6 +134,19 @@ class RoundBus:
         self._misses[rid] = 0
         self._last_frames[rid] = frame
         self._last_seqs[rid] = ch.last_recv_seq
+        # Fleet-wide numerical health: a robot whose frame gossips a grown
+        # anomaly counter gets surfaced on the HUB's event stream (the
+        # hub's report renders the fleet view; the robot's own run dir has
+        # the detailed anomaly events).
+        if "anom" in frame:
+            run = obs.get_run()
+            count, worst = (int(x) for x in np.asarray(frame["anom"])[:2])
+            if run is not None and count > self._anom_seen.get(rid, 0):
+                run.event("peer_anomaly", phase="health", peer=rid,
+                          count=count,
+                          severity=("critical" if worst >= 2 else "warning"),
+                          round=self.rounds_served)
+            self._anom_seen[rid] = max(self._anom_seen.get(rid, 0), count)
 
     def round(self) -> dict:
         """One relay round; returns the merged broadcast frame."""
@@ -306,6 +320,11 @@ class BusClient:
             self.staleness = max(0, int(staleness))
             return
         self.staleness = int(staleness)
+        run = obs.get_run()
+        if run is not None:
+            # Staleness is a convergence-relevant knob: stamp it into the
+            # fingerprint so --compare refuses lockstep-vs-overlap deltas.
+            run.set_fingerprint(staleness=self.staleness)
         self._ov_stop = False
 
         def run():
@@ -438,6 +457,14 @@ def pack_agent_frame(agent, robust: bool = False,
         [st.robot_id, st.state.value, st.instance_number,
          st.iteration_number, int(st.ready_to_terminate)], np.int64),
         "relchange": np.asarray(st.relative_change, np.float64)}
+    # Numerical-health gossip: anomaly counters detected locally
+    # (obs.health via PGOAgent._obs_anomaly) ride the round frame so the
+    # hub's report sees fleet-wide health.  Counters are only ever nonzero
+    # when telemetry was on (detection is fenced), so the telemetry-off
+    # wire is unchanged.
+    anom = getattr(agent, "health_counters", lambda: (0, 0))()
+    if anom[0]:
+        frame["anom"] = np.asarray(anom, np.int64)
     if packed:
         pub = agent.get_public_pose_arrays()
         if pub is not None:
@@ -468,6 +495,14 @@ def apply_peer_frame(agent, peer_id: int, pf: dict, robust: bool = False,
     span as the ``link_*`` fields the timeline renders as a cross-robot
     flow arrow."""
     ctx = unpack_trace_entries(pf)  # popped even with telemetry off
+    anom = pf.pop("anom", None)  # health gossip: popped even with obs off
+    if anom is not None:
+        run = obs.get_run()
+        if run is not None:
+            run.gauge("peer_anomalies_seen",
+                      "anomaly count gossiped by each peer").set(
+                float(np.asarray(anom)[0]), robot=agent.robot_id,
+                peer=peer_id)
     sp = trace.start_span("scatter", phase="comms", robot=agent.robot_id,
                           link=ctx)
     try:

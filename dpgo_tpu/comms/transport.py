@@ -33,6 +33,7 @@ import socket
 import threading
 import time
 
+from .. import obs
 from .faults import FaultInjector
 from .protocol import (DEFAULT_MAX_FRAME_BYTES, HEADER, FrameAssembler,
                        ProtocolError, decode_payload, encode_payload)
@@ -69,6 +70,12 @@ class Transport:
         self.injector = injector
         self.max_frame_bytes = int(max_frame_bytes)
         self.wire_format = wire_format
+        run = obs.get_run()
+        if run is not None:
+            # Wire identity into the run fingerprint: a v1-npz and a
+            # packed-wire run of the same deployment are not comparable
+            # runs for the convergence regression gate.
+            run.set_fingerprint(wire_format=wire_format)
 
     def send(self, arrays: dict, timeout: float | None = None) -> int:
         """Send one frame; returns wire bytes of the *intended* frame (what

@@ -232,6 +232,14 @@ def certify_solution(
                   eigenvalue_gap=gap, tol=tol, sigma=sigma_f,
                   stationarity_gap=float(stat), dim=dim,
                   duration_s=time.perf_counter() - t0)
+        # Verdict timeline -> numerical health: a streak of undecidable
+        # verdicts (REFUSE loop) is an anomaly the staircase driver would
+        # otherwise spin on silently.
+        from ..obs.health import monitor_for as _monitor_for
+
+        _monitor_for(run).observe_certificate(
+            certified=certified, decidable=decidable, lambda_min=lam_used,
+            source="certify_solution")
     return CertificateResult(
         certified=certified,
         lambda_min=lam_min_f,

@@ -35,6 +35,7 @@ Mapping to the reference:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 from functools import partial
@@ -1272,6 +1273,103 @@ def global_weights(weights: jax.Array, graph: MultiAgentGraph,
     return jnp.where(den > 0, num / jnp.maximum(den, 1.0), 1.0)
 
 
+def schedule_bounds(n_done: int, nwu: int, *, max_iters: int,
+                    eval_every: int, params: AgentParams | None,
+                    robust_on: bool, accel_on: bool):
+    """Host-side schedule arithmetic shared by ``run_rbcd`` and the flight
+    recorder's replay (``obs.recorder``): flags for round ``n_done + 1``
+    and the segment end — the plain tail runs to (exclusive) the next
+    flagged round, capped (inclusive) at the next eval boundary.
+
+    The modular counters of the reference (shouldUpdateLoopClosure-
+    Weights / shouldRestart, PGOAgent.cpp:1174-1179, 1033-1038) live on
+    the host: round variants compile branch-free.  Beyond-reference:
+    weight updates stop after robust_opt_num_weight_updates (<=0 means
+    unlimited, the reference behavior) — without the cap, post-
+    convergence weight updates keep annealing mu (<- 1.4 mu) and, with
+    warm start disabled, keep resetting the iterate to the initial
+    guess, so the solve would never settle.  The GNC
+    ratio freeze itself (computeConvergedLoopClosureRatio semantics,
+    PGOAgent.cpp:1247-1289) is decided ON DEVICE inside the flagged
+    round (see ``_rbcd_round``): a frozen flagged round computes exactly
+    a plain round, so the host keeps flagging on the modular schedule
+    with no weight readback and identical results.  Module-level so a
+    replay resumed from a snapshot at round ``n_done`` re-issues the
+    exact segment splits the original driver dispatched.
+    """
+    cap = params.robust_opt_num_weight_updates if params is not None else 0
+    updates_remaining = robust_on and (cap <= 0 or nwu < cap)
+    uw = updates_remaining and \
+        (n_done + 1) % params.robust_opt_inner_iters == 0
+    rs = accel_on and (n_done + 1) % params.restart_interval == 0
+    n0 = n_done + 1
+    end = max_iters
+    if updates_remaining:
+        end = min(end, (n0 // params.robust_opt_inner_iters + 1)
+                  * params.robust_opt_inner_iters - 1)
+    if accel_on:
+        end = min(end, (n0 // params.restart_interval + 1)
+                  * params.restart_interval - 1)
+    end = min(max(end, n0),
+              ((n0 - 1) // eval_every + 1) * eval_every, max_iters)
+    return uw, rs, end
+
+
+def _make_central_metrics(graph: MultiAgentGraph, edges_g: EdgeSet,
+                          n_total: int, num_meas: int, telemetry: bool):
+    """The jitted per-eval readback program of ``run_rbcd`` — one stacked
+    output = ONE device->host transfer per eval (each separate scalar
+    fetch costs a full round-trip on a tunneled TPU).  Factored out so the
+    flight recorder's replay evaluates the recorded trajectory through the
+    byte-identical XLA program (bit-for-bit reproduction requires the same
+    compiled reduction order, not merely the same math)."""
+
+    @jax.jit
+    def central_metrics(Xa, weights, ready, mu, rel_change):
+        Xg = gather_to_global(Xa, graph, n_total)
+        eg = edges_g._replace(weight=global_weights(weights, graph, num_meas))
+        f = quadratic.cost(Xg, eg)
+        g = manifold.rgrad(Xg, quadratic.egrad(Xg, eg))
+        vals = [f, manifold.norm(g), jnp.all(ready).astype(f.dtype)]
+        if telemetry:
+            e = graph.edges
+            upd = e.mask * e.is_lc * (1.0 - e.fixed_weight)
+            n_upd = jnp.maximum(jnp.sum(upd), 1.0)
+            vals += [mu.astype(f.dtype),
+                     jnp.sum((weights > 0.5) * upd) / n_upd,
+                     jnp.sum(weights * upd) / n_upd]
+            return jnp.concatenate(
+                [jnp.stack(vals), rel_change.astype(f.dtype)])
+        return jnp.stack(vals)
+
+    return central_metrics
+
+
+def _package_version() -> str:
+    """The dpgo_tpu version for run fingerprints (lazy import — the
+    package __init__ is not a dependency of this module at import time)."""
+    try:
+        from .. import __version__
+        return str(__version__)
+    except ImportError:  # pragma: no cover - partial installs
+        return "unknown"
+
+
+@contextlib.contextmanager
+def _crash_dump_scope(flight_rec):
+    """Dump the attached flight recorder's black box when the driver loop
+    dies — a crash is exactly the moment the ring buffer pays for itself.
+    ``FlightRecorder.dump`` is first-write-wins, so an anomaly dump that
+    already fired (e.g. the abort policy raising SolverHealthError) is
+    not overwritten by the crash handler."""
+    try:
+        yield
+    except Exception:
+        if flight_rec is not None:
+            flight_rec.dump("crash")
+        raise
+
+
 def run_rbcd(
     state: RBCDState,
     graph: MultiAgentGraph,
@@ -1325,25 +1423,8 @@ def run_rbcd(
     obs_run = obs.get_run()
     telemetry = obs_run is not None
 
-    @jax.jit
-    def central_metrics(Xa, weights, ready, mu, rel_change):
-        # One stacked output = ONE device->host readback per eval (each
-        # separate scalar fetch costs a full round-trip on a tunneled TPU).
-        Xg = gather_to_global(Xa, graph, n_total)
-        eg = edges_g._replace(weight=global_weights(weights, graph, num_meas))
-        f = quadratic.cost(Xg, eg)
-        g = manifold.rgrad(Xg, quadratic.egrad(Xg, eg))
-        vals = [f, manifold.norm(g), jnp.all(ready).astype(f.dtype)]
-        if telemetry:
-            e = graph.edges
-            upd = e.mask * e.is_lc * (1.0 - e.fixed_weight)
-            n_upd = jnp.maximum(jnp.sum(upd), 1.0)
-            vals += [mu.astype(f.dtype),
-                     jnp.sum((weights > 0.5) * upd) / n_upd,
-                     jnp.sum(weights * upd) / n_upd]
-            return jnp.concatenate(
-                [jnp.stack(vals), rel_change.astype(f.dtype)])
-        return jnp.stack(vals)
+    central_metrics = _make_central_metrics(graph, edges_g, n_total,
+                                            num_meas, telemetry)
 
     robust_on = params is not None and \
         params.robust.cost_type != RobustCostType.L2
@@ -1368,42 +1449,42 @@ def run_rbcd(
     terminated_by = "max_iters"
     it = 0
     num_weight_updates = 0
-    cap = params.robust_opt_num_weight_updates if params is not None else 0
 
     def _bounds(n_done, nwu):
-        """Flags for round ``n_done + 1`` and the segment end — the plain
-        tail runs to (exclusive) the next flagged round, capped (inclusive)
-        at the next eval boundary.
+        """Schedule arithmetic, shared with the flight-recorder replay —
+        see ``schedule_bounds``."""
+        return schedule_bounds(n_done, nwu, max_iters=max_iters,
+                               eval_every=eval_every, params=params,
+                               robust_on=robust_on, accel_on=accel_on)
 
-        The modular counters of the reference (shouldUpdateLoopClosure-
-        Weights / shouldRestart, PGOAgent.cpp:1174-1179, 1033-1038) live on
-        the host: round variants compile branch-free.  Beyond-reference:
-        weight updates stop after robust_opt_num_weight_updates (<=0 means
-        unlimited, the reference behavior) — without the cap, post-
-        convergence weight updates keep annealing mu (<- 1.4 mu) and, with
-        warm start disabled, keep resetting the iterate to the initial
-        guess, so the solve would never settle.  The GNC
-        ratio freeze itself (computeConvergedLoopClosureRatio semantics,
-        PGOAgent.cpp:1247-1289) is decided ON DEVICE inside the flagged
-        round (see ``_rbcd_round``): a frozen flagged round computes exactly
-        a plain round, so the host keeps flagging on the modular schedule
-        with no weight readback and identical results.
-        """
-        updates_remaining = robust_on and (cap <= 0 or nwu < cap)
-        uw = updates_remaining and \
-            (n_done + 1) % params.robust_opt_inner_iters == 0
-        rs = accel_on and (n_done + 1) % params.restart_interval == 0
-        n0 = n_done + 1
-        end = max_iters
-        if updates_remaining:
-            end = min(end, (n0 // params.robust_opt_inner_iters + 1)
-                      * params.robust_opt_inner_iters - 1)
-        if accel_on:
-            end = min(end, (n0 // params.restart_interval + 1)
-                      * params.restart_interval - 1)
-        end = min(max(end, n0),
-                  ((n0 - 1) // eval_every + 1) * eval_every, max_iters)
-        return uw, rs, end
+    health_mon = flight_rec = None
+    if telemetry:
+        from ..obs import health as health_mod
+
+        # Numerical-health layer (obs.health): judges the same scalars the
+        # stacked readback below already carries — no extra transfers.
+        # The flight recorder is opt-in (FlightRecorder.attach); when one
+        # is attached, register the problem so its black box is
+        # self-contained and replayable.
+        health_mon = health_mod.monitor_for(obs_run)
+        flight_rec = getattr(obs_run, "recorder", None)
+        if flight_rec is not None:
+            flight_rec.set_problem(part, meta, params, dtype,
+                                   eval_every=eval_every,
+                                   grad_norm_tol=grad_norm_tol,
+                                   max_iters=max_iters)
+        obs_run.set_fingerprint(
+            version=_package_version(),
+            solver="run_rbcd",
+            num_robots=meta.num_robots, rank=meta.rank, d=meta.d,
+            n_poses=n_total, n_meas=num_meas,
+            dtype=str(np.dtype(dtype)),
+            schedule=params.schedule.value if params is not None else None,
+            robust_cost=params.robust.cost_type.value
+            if params is not None else None,
+            sel_mode=resolved_sel_mode(params)
+            if params is not None else None,
+            eval_every=eval_every)
 
     if telemetry:
         obs_run.event("solve_start", phase="solve",
@@ -1437,74 +1518,92 @@ def run_rbcd(
     # TPU) is in flight.  Flags are host-deterministic functions of the
     # round index, so speculation never changes which rounds are flagged;
     # a termination at the boundary simply discards the speculative state.
-    spec = None  # (state, it, uw) one segment past the last eval boundary
-    t_solve0 = t_window = time.perf_counter()
-    it_window = 0
-    while it < max_iters:
-        target = min(((it // eval_every) + 1) * eval_every, max_iters)
-        if spec is not None:
-            # A spec can only be pending at the top of an outer iteration
-            # (set at the previous eval boundary, exactly one segment ahead).
-            state, it, uw = spec
-            num_weight_updates += int(uw)
-            spec = None
-        while it < target:
-            uw, rs, end = _bounds(it, num_weight_updates)
-            num_weight_updates += int(uw)
-            state = segment(state, end - it, uw, rs)
-            it = end
-        fut = central_metrics(state.X, state.weights, state.ready,
-                              state.mu, state.rel_change)
-        if it < max_iters:
-            uw, rs, end = _bounds(it, num_weight_updates)
-            spec = (segment(state, end - it, uw, rs), end, uw)
-        if telemetry:
-            t_rb_m, t_rb_w = time.monotonic(), time.time()
-        vec = np.asarray(fut)
-        if telemetry:
-            # The eval readback span: the device->host fetch the pipelined
-            # driver hides behind the speculative segment — its duration on
-            # the timeline shows how much of the round-trip stayed hidden.
-            trace.emit_span(obs_run, "eval_readback", t_rb_m, t_rb_w,
-                            time.monotonic() - t_rb_m, phase="eval",
-                            iteration=it)
-        f, gn, consensus = vec[:3]
-        cost_hist.append(float(f))
-        gn_hist.append(float(gn))
-        if telemetry:
-            # The fetch above already materialized everything this block
-            # reads — host-side bookkeeping only from here.
-            now = time.perf_counter()
-            dt, t_window = now - t_window, now
-            rounds = max(it - it_window, 1)
-            it_window = it
-            per_round = dt / rounds
-            mu_v, inl, mean_w = (float(x) for x in vec[3:6])
-            rel = vec[6:]
-            g_cost.set(float(f))
-            g_gn.set(float(gn))
-            c_rounds.inc(rounds)
-            c_evals.inc()
-            h_round.observe(per_round)
-            for a in range(rel.shape[0]):
-                g_agent_lat.set(per_round, agent=a)
-                g_agent_rel.set(float(rel[a]), agent=a)
-            ev = {"iteration": it, "round_latency_s": per_round,
-                  "rel_change_max": float(rel.max()) if rel.size else None}
-            obs_run.metric("solver_cost", float(f), phase="eval", **ev)
-            obs_run.metric("solver_grad_norm", float(gn), phase="eval", **ev)
-            if robust_on:
-                g_mu.set(mu_v)
-                g_inl.set(inl)
-                obs_run.metric("gnc_mu", mu_v, phase="eval", iteration=it)
-                obs_run.metric("gnc_inlier_fraction", inl, phase="eval",
-                               iteration=it, mean_weight=mean_w)
-        if float(gn) < grad_norm_tol:
-            terminated_by = "grad_norm"
-            break
-        if consensus > 0:
-            terminated_by = "consensus"
-            break
+    with _crash_dump_scope(flight_rec):
+        spec = None  # (state, it, uw) one segment past the last eval boundary
+        t_solve0 = t_window = time.perf_counter()
+        it_window = 0
+        while it < max_iters:
+            target = min(((it // eval_every) + 1) * eval_every, max_iters)
+            if spec is not None:
+                # A spec can only be pending at the top of an outer iteration
+                # (set at the previous eval boundary, exactly one segment ahead).
+                state, it, uw = spec
+                num_weight_updates += int(uw)
+                spec = None
+            while it < target:
+                uw, rs, end = _bounds(it, num_weight_updates)
+                num_weight_updates += int(uw)
+                state = segment(state, end - it, uw, rs)
+                it = end
+            fut = central_metrics(state.X, state.weights, state.ready,
+                                  state.mu, state.rel_change)
+            if it < max_iters:
+                uw, rs, end = _bounds(it, num_weight_updates)
+                spec = (segment(state, end - it, uw, rs), end, uw)
+            if telemetry:
+                t_rb_m, t_rb_w = time.monotonic(), time.time()
+            vec = np.asarray(fut)
+            if telemetry:
+                # The eval readback span: the device->host fetch the pipelined
+                # driver hides behind the speculative segment — its duration on
+                # the timeline shows how much of the round-trip stayed hidden.
+                trace.emit_span(obs_run, "eval_readback", t_rb_m, t_rb_w,
+                                time.monotonic() - t_rb_m, phase="eval",
+                                iteration=it)
+            f, gn, consensus = vec[:3]
+            cost_hist.append(float(f))
+            gn_hist.append(float(gn))
+            if telemetry:
+                # The fetch above already materialized everything this block
+                # reads — host-side bookkeeping only from here.
+                now = time.perf_counter()
+                dt, t_window = now - t_window, now
+                rounds = max(it - it_window, 1)
+                it_window = it
+                per_round = dt / rounds
+                mu_v, inl, mean_w = (float(x) for x in vec[3:6])
+                rel = vec[6:]
+                g_cost.set(float(f))
+                g_gn.set(float(gn))
+                c_rounds.inc(rounds)
+                c_evals.inc()
+                h_round.observe(per_round)
+                for a in range(rel.shape[0]):
+                    g_agent_lat.set(per_round, agent=a)
+                    g_agent_rel.set(float(rel[a]), agent=a)
+                ev = {"iteration": it, "round_latency_s": per_round,
+                      "rel_change_max": float(rel.max()) if rel.size else None}
+                obs_run.metric("solver_cost", float(f), phase="eval", **ev)
+                obs_run.metric("solver_grad_norm", float(gn), phase="eval", **ev)
+                if robust_on:
+                    g_mu.set(mu_v)
+                    g_inl.set(inl)
+                    obs_run.metric("gnc_mu", mu_v, phase="eval", iteration=it)
+                    obs_run.metric("gnc_inlier_fraction", inl, phase="eval",
+                                   iteration=it, mean_weight=mean_w)
+                # Flight recorder first (so an anomaly dump includes this
+                # eval), then the health verdict — which may dump and, per
+                # the abort policy, raise SolverHealthError.
+                if flight_rec is not None:
+                    flight_rec.record_eval(
+                        it, {"cost": float(f), "grad_norm": float(gn),
+                             "mu": mu_v, "inlier_frac": inl,
+                             "rel_change": rel},
+                        state=state, num_weight_updates=num_weight_updates)
+                if health_mon is not None:
+                    health_mon.observe_solver(
+                        it, float(f), float(gn),
+                        mu=mu_v if robust_on else None,
+                        inlier_frac=inl if robust_on else None,
+                        rel_change=rel,
+                        stage=robust.gnc_stage_index(mu_v, params.robust)
+                        if robust_on else None)
+            if float(gn) < grad_norm_tol:
+                terminated_by = "grad_norm"
+                break
+            if consensus > 0:
+                terminated_by = "consensus"
+                break
 
     # Final assembly as one jitted program (eager, the gather + rounding
     # chain costs ~15 s in per-op dispatches on a tunneled TPU at 15k poses).

@@ -36,6 +36,22 @@ robustness change reports through:
   per-robot streams (pairwise clock-offset estimation from the
   send/receive stamps riding heartbeats and traced frames) into a
   Perfetto-loadable Chrome trace with cross-robot flow arrows.
+* Numerical health (``health.py``) — in-band anomaly detectors fed by
+  the scalars the driver already reads back (NaN/Inf sentinel,
+  per-GNC-stage cost monotonicity, gradient-norm explosion, stall,
+  inlier-fraction collapse, certification REFUSE loops), emitting
+  structured ``anomaly`` events with optional callback/abort policy.
+* Flight recorder (``recorder.py``) — bounded ring buffer of recent
+  eval scalars + exact state snapshots, dumped as a self-contained
+  ``blackbox.npz`` + context JSONL on anomaly or crash;
+  ``python -m dpgo_tpu.obs.recorder --replay`` resumes from the last
+  healthy snapshot and reproduces the recorded trajectory bit-for-bit
+  on the deterministic CPU backend.
+* Convergence regression gate (``regress.py``) — ``report --compare
+  runA runB`` checks run B's convergence against run A's tail noise
+  bands, refuses apples-to-oranges comparisons on the config
+  fingerprint (``TelemetryRun.set_fingerprint``), and exits non-zero on
+  regression — CI's convergence analog of the perf smoke.
 
 Instrumentation discipline on accelerator hot paths: never add a host sync
 inside jitted code.  The solvers extend their *existing* phase-boundary
@@ -47,9 +63,18 @@ a telemetry-off run is byte-identical to the uninstrumented driver.
 
 from __future__ import annotations
 
-from .events import EventStream, metric_record, read_events, read_events_meta
+from .events import (
+    EventStream,
+    metric_record,
+    nonfinite_str,
+    read_events,
+    read_events_meta,
+    restore_nonfinite,
+)
 from .exporters import to_prometheus_text, write_tensorboard_scalars
+from .health import HealthConfig, HealthMonitor, SolverHealthError, monitor_for
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .recorder import FlightRecorder
 from .run import (
     TelemetryRun,
     end_run,
@@ -63,16 +88,23 @@ from . import trace  # noqa: E402  (span API: trace.span / trace.start_span)
 __all__ = [
     "Counter",
     "EventStream",
+    "FlightRecorder",
     "Gauge",
+    "HealthConfig",
+    "HealthMonitor",
     "Histogram",
     "MetricsRegistry",
+    "SolverHealthError",
     "TelemetryRun",
     "end_run",
     "get_run",
     "materialize",
     "metric_record",
+    "monitor_for",
+    "nonfinite_str",
     "read_events",
     "read_events_meta",
+    "restore_nonfinite",
     "run_scope",
     "start_run",
     "to_prometheus_text",

@@ -22,14 +22,53 @@ import time
 import warnings
 
 
+#: The one non-finite float convention of the whole obs stack: JSON has no
+#: literal for them, so they serialize as the Prometheus text-exposition
+#: strings and ``read_events`` restores them to floats on load — the
+#: snapshot (``metrics.py``), the exporters, and the event stream all
+#: round-trip through this single table.
+NONFINITE_STR = {"NaN": float("nan"), "+Inf": float("inf"),
+                 "-Inf": float("-inf")}
+#: Legacy spellings from pre-unification streams, restored on read only.
+_NONFINITE_LEGACY = {"nan": float("nan"), "inf": float("inf"),
+                     "-inf": float("-inf")}
+
+
+def nonfinite_str(v: float) -> str:
+    """Canonical string for a non-finite float (Prometheus convention)."""
+    if math.isnan(v):
+        return "NaN"
+    return "+Inf" if v > 0 else "-Inf"
+
+
+def restore_nonfinite(v):
+    """Inverse of the serialization convention: recursively convert the
+    canonical (and legacy) non-finite strings back to floats.  Applied by
+    ``read_events`` so a round-tripped stream yields real float NaN/Inf —
+    string payloads that happen to spell exactly ``"NaN"``/``"+Inf"``/
+    ``"-Inf"`` are, by convention, numbers."""
+    if isinstance(v, str):
+        if v in NONFINITE_STR:
+            return NONFINITE_STR[v]
+        if v in _NONFINITE_LEGACY:
+            return _NONFINITE_LEGACY[v]
+        return v
+    if isinstance(v, dict):
+        return {k: restore_nonfinite(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [restore_nonfinite(x) for x in v]
+    return v
+
+
 def _jsonable(v):
     """Coerce payload values to JSON-safe types (numpy scalars/arrays from
     phase-boundary readbacks arrive here routinely; non-finite floats have
-    no JSON literal, so they become strings rather than invalid output)."""
+    no JSON literal, so they become the canonical strings rather than
+    invalid output)."""
     if isinstance(v, (str, int, bool)) or v is None:
         return v
     if isinstance(v, float):
-        return v if math.isfinite(v) else str(v)
+        return v if math.isfinite(v) else nonfinite_str(v)
     if isinstance(v, dict):
         return {str(k): _jsonable(x) for k, x in v.items()}
     if hasattr(v, "item") and getattr(v, "ndim", None) == 0:
@@ -115,7 +154,11 @@ def read_events(path: str) -> list[dict]:
     is tolerated with a ``RuntimeWarning`` — a robot killed mid-write
     (exactly the ``tests/test_chaos.py`` scenarios) truncates its last
     line, and the events before it are intact and wanted.  Use
-    ``read_events_meta`` to get the truncation flag programmatically."""
+    ``read_events_meta`` to get the truncation flag programmatically.
+
+    Non-finite floats round-trip: values the writer serialized as the
+    canonical ``"NaN"``/``"+Inf"``/``"-Inf"`` strings (``_jsonable``) come
+    back as real floats (``restore_nonfinite``)."""
     events, _truncated = read_events_meta(path)
     return events
 
@@ -132,7 +175,7 @@ def read_events_meta(path: str) -> tuple[list[dict], bool]:
         if not line:
             continue
         try:
-            out.append(json.loads(line))
+            out.append(restore_nonfinite(json.loads(line)))
         except json.JSONDecodeError as e:
             if ln == last:
                 warnings.warn(

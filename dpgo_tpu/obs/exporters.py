@@ -10,6 +10,8 @@ from __future__ import annotations
 import math
 import os
 
+from .events import nonfinite_str
+
 
 def _fmt_labels(labels: dict, extra: dict | None = None) -> str:
     merged = dict(labels)
@@ -29,10 +31,10 @@ def _fmt_labels(labels: dict, extra: dict | None = None) -> str:
 
 
 def _fmt_value(v: float) -> str:
-    if math.isinf(v):
-        return "+Inf" if v > 0 else "-Inf"
-    if math.isnan(v):
-        return "NaN"
+    # Non-finite spelling shared with the snapshot/event serialization
+    # (events.nonfinite_str) — one convention across the whole stack.
+    if not math.isfinite(v):
+        return nonfinite_str(v)
     return repr(float(v))
 
 

@@ -17,6 +17,8 @@ from __future__ import annotations
 import math
 import threading
 
+from .events import nonfinite_str
+
 # Default histogram buckets: geometric, spanning 100 us .. ~100 s — sized
 # for round/iterate latencies, the dominant histogram use.
 DEFAULT_BUCKETS = tuple(1e-4 * (10 ** (k / 3.0)) for k in range(19))
@@ -197,7 +199,12 @@ class MetricsRegistry:
                 if isinstance(val, dict):
                     entry.update(val)
                 else:
-                    entry["value"] = val if math.isfinite(val) else str(val)
+                    # One non-finite convention across the stack: the same
+                    # canonical strings the Prometheus exposition and the
+                    # event stream use (events.nonfinite_str), restored to
+                    # floats by read_events.
+                    entry["value"] = val if math.isfinite(val) \
+                        else nonfinite_str(val)
                 series.append(entry)
             out[fam.name] = {"kind": fam.kind, "help": fam.help,
                              "unit": fam.unit, "series": series}

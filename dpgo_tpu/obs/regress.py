@@ -1,0 +1,258 @@
+"""Convergence regression gate: ``report --compare runA runB``.
+
+Compares two telemetry run directories' convergence trajectories and
+terminal metrics and exits non-zero on regression — the convergence
+analog of the CI perf smoke.  The comparison:
+
+* **Fingerprint gate.**  Both runs' config fingerprints (``run_summary``
+  ``channel="config"`` events / ``run.json``) must agree on every shared
+  identity key (dataset, num_robots, rank, schedule, wire format, ...);
+  an apples-to-oranges comparison is refused with a clear message rather
+  than producing a meaningless delta table.  Package version is recorded
+  but never gates — comparing across versions is the point of the gate.
+* **Terminal metrics with noise bands.**  For each gated metric
+  (``solver_cost``, ``solver_grad_norm`` — lower is better) run B's final
+  value is checked against run A's tail *noise band* (min/median/max over
+  the last ``tail`` evals — the ``cpu_arm_band`` schema of ``bench.py``'s
+  metric_record) widened by ``rtol``: B regresses when its final value
+  exceeds A's band max beyond tolerance, or goes non-finite where A was
+  finite.
+* **Trajectory deltas.**  Per-iteration aligned relative deviation over
+  the common eval grid, reported per metric (informational).
+* **Anomaly gate.**  Run B showing critical ``anomaly`` events where run
+  A had none is a regression regardless of the final numbers — a NaN'd
+  run that happens to dump a small last cost must not pass.
+
+Exit codes: 0 = no regression, 2 = regression or refused comparison.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+from .events import read_events_meta
+from .run import EVENTS_FILE, META_FILE
+
+#: Gated metrics and their improvement direction.
+GATED_METRICS = {"solver_cost": "lower", "solver_grad_norm": "lower"}
+#: Fingerprint keys that never gate (recorded for the report only).
+NON_GATING_KEYS = {"version"}
+
+
+def tail_band(values: list[float], k: int = 5) -> dict:
+    """Noise band over the trailing ``k`` values — the ``cpu_arm_band``
+    key schema (min/median/max + the window itself) from ``bench.py``."""
+    window = [float(v) for v in values[-max(k, 1):]]
+    finite = [v for v in window if math.isfinite(v)]
+    ref = sorted(finite)
+    med = (ref[len(ref) // 2] if len(ref) % 2 else
+           0.5 * (ref[len(ref) // 2 - 1] + ref[len(ref) // 2])) \
+        if ref else float("nan")
+    return {"min": min(window) if finite else float("nan"),
+            "median": med,
+            "max": max(window) if finite else float("nan"),
+            "windows": window}
+
+
+def _trajectory(events: list[dict], metric: str) -> list[tuple]:
+    return [(ev.get("iteration", ev.get("seq", 0)), float(ev["value"]))
+            for ev in events
+            if ev.get("event") == "metric" and ev.get("metric") == metric
+            and isinstance(ev.get("value"), (int, float))]
+
+
+def load_run(run_dir: str) -> dict:
+    """Events + merged fingerprint for one run dir; raises ValueError on a
+    dir with no event stream."""
+    ev_path = os.path.join(run_dir, EVENTS_FILE)
+    if not os.path.exists(ev_path):
+        raise ValueError(f"not a telemetry run directory (no {EVENTS_FILE}): "
+                         f"{run_dir}")
+    events, _trunc = read_events_meta(ev_path)
+    fingerprint: dict = {}
+    for ev in events:
+        if ev.get("event") == "run_summary" \
+                and ev.get("channel") == "config":
+            fingerprint.update(ev.get("fingerprint") or {})
+    meta_path = os.path.join(run_dir, META_FILE)
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as fh:
+                fingerprint.update(json.load(fh).get("fingerprint") or {})
+        except (OSError, ValueError):
+            pass
+    return {"run_dir": run_dir, "events": events, "fingerprint": fingerprint}
+
+
+def _critical_anomalies(events: list[dict]) -> int:
+    return sum(1 for ev in events if ev.get("event") == "anomaly"
+               and ev.get("severity") == "critical")
+
+
+def compare_runs(dir_a: str, dir_b: str, rtol: float = 0.05,
+                 atol: float = 1e-9, tail: int = 5,
+                 allow_mismatch: bool = False) -> dict:
+    """Full comparison record (see module docstring for the semantics)."""
+    a, b = load_run(dir_a), load_run(dir_b)
+    shared = set(a["fingerprint"]) & set(b["fingerprint"]) - NON_GATING_KEYS
+    mismatches = {k: [a["fingerprint"][k], b["fingerprint"][k]]
+                  for k in sorted(shared)
+                  if a["fingerprint"][k] != b["fingerprint"][k]}
+    out: dict = {
+        "run_a": dir_a, "run_b": dir_b,
+        "fingerprint_a": a["fingerprint"], "fingerprint_b": b["fingerprint"],
+        "fingerprint_mismatches": mismatches,
+        "compatible": not mismatches or allow_mismatch,
+        "metrics": {}, "regressions": [],
+    }
+    if mismatches and not allow_mismatch:
+        out["rc"] = 2
+        return out
+
+    names = sorted({ev.get("metric") for r in (a, b) for ev in r["events"]
+                    if ev.get("event") == "metric" and ev.get("metric")})
+    for name in names:
+        ta, tb = _trajectory(a["events"], name), _trajectory(b["events"], name)
+        if not ta or not tb:
+            continue
+        va, vb = [v for _, v in ta], [v for _, v in tb]
+        band_a, band_b = tail_band(va, tail), tail_band(vb, tail)
+        a_final, b_final = va[-1], vb[-1]
+        direction = GATED_METRICS.get(name)
+        # Aligned per-iteration relative deviation (informational).
+        da, db = dict(ta), dict(tb)
+        common = sorted(set(da) & set(db))
+        max_dev = max((abs(db[i] - da[i]) / max(abs(da[i]), atol)
+                       for i in common
+                       if math.isfinite(da[i]) and math.isfinite(db[i])),
+                      default=None)
+        regressed = False
+        why = None
+        if direction == "lower":
+            if not math.isfinite(b_final) and math.isfinite(a_final):
+                regressed, why = True, "non-finite final value"
+            elif math.isfinite(b_final) and math.isfinite(band_a["max"]):
+                bound = band_a["max"] * (1.0 + rtol) + atol \
+                    if band_a["max"] >= 0 \
+                    else band_a["max"] * (1.0 - rtol) + atol
+                if b_final > bound:
+                    regressed = True
+                    why = (f"final {b_final:.6g} above band max "
+                           f"{band_a['max']:.6g} (+{rtol * 100:.0f}%)")
+        entry = {"a_final": a_final, "b_final": b_final,
+                 "delta": b_final - a_final
+                 if math.isfinite(b_final) and math.isfinite(a_final)
+                 else None,
+                 "a_band": band_a, "b_band": band_b,
+                 "points": [len(ta), len(tb)],
+                 "max_rel_deviation": max_dev,
+                 "direction": direction, "regressed": regressed,
+                 "reason": why}
+        out["metrics"][name] = entry
+        if regressed:
+            out["regressions"].append(name)
+
+    crit_a = _critical_anomalies(a["events"])
+    crit_b = _critical_anomalies(b["events"])
+    out["critical_anomalies"] = [crit_a, crit_b]
+    if crit_b > crit_a:
+        out["regressions"].append("anomalies")
+        out["metrics"]["anomalies"] = {
+            "a_final": crit_a, "b_final": crit_b, "direction": "lower",
+            "regressed": True,
+            "reason": f"{crit_b} critical anomalies vs {crit_a}"}
+    out["rc"] = 2 if out["regressions"] else 0
+    return out
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def render_compare(cmp: dict) -> str:
+    lines = [f"== convergence compare: {cmp['run_a']} vs {cmp['run_b']} =="]
+    mism = cmp["fingerprint_mismatches"]
+    if mism and not cmp["compatible"]:
+        lines.append("REFUSED: runs are not comparable — config "
+                     "fingerprints disagree:")
+        for k, (va, vb) in sorted(mism.items()):
+            lines.append(f"  {k}: {va!r} vs {vb!r}")
+        lines.append("(re-run with matching configs, or pass "
+                     "--allow-mismatch to compare anyway)")
+        return "\n".join(lines)
+    if mism:
+        lines.append("fingerprint mismatches (overridden by "
+                     "--allow-mismatch): " + ", ".join(sorted(mism)))
+    else:
+        nkeys = len(set(cmp["fingerprint_a"]) & set(cmp["fingerprint_b"]))
+        lines.append(f"fingerprint: compatible ({nkeys} shared keys)")
+    header = (f"  {'metric':<28} {'A final':>12} {'B final':>12} "
+              f"{'delta':>11} {'A tail band':>26}  verdict")
+    lines.append(header)
+    for name, m in sorted(cmp["metrics"].items()):
+        band = m.get("a_band")
+        band_s = f"[{_fmt(band['min'])}, {_fmt(band['max'])}]" if band else "-"
+        delta = m.get("delta")
+        if delta is not None and math.isfinite(m["a_final"]) \
+                and abs(m["a_final"]) > 0:
+            delta_s = f"{100.0 * delta / abs(m['a_final']):+.2f}%"
+        else:
+            delta_s = _fmt(delta)
+        verdict = "REGRESSED" if m["regressed"] else (
+            "ok" if m.get("direction") else "info")
+        lines.append(f"  {name:<28} {_fmt(m['a_final']):>12} "
+                     f"{_fmt(m['b_final']):>12} {delta_s:>11} "
+                     f"{band_s:>26}  {verdict}")
+        if m.get("reason"):
+            lines.append(f"    ^ {m['reason']}")
+    if cmp["regressions"]:
+        lines.append(f"RESULT: REGRESSION in {', '.join(cmp['regressions'])}")
+    else:
+        lines.append("RESULT: no regression")
+    return "\n".join(lines)
+
+
+def run_compare(dir_a: str, dir_b: str, rtol: float = 0.05,
+                json_out: bool = False, allow_mismatch: bool = False) -> int:
+    """CLI body shared by ``report --compare`` and ``python -m
+    dpgo_tpu.obs.regress``; prints and returns the exit code."""
+    try:
+        cmp = compare_runs(dir_a, dir_b, rtol=rtol,
+                           allow_mismatch=allow_mismatch)
+    except (ValueError, OSError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if json_out:
+        print(json.dumps(cmp))
+    else:
+        print(render_compare(cmp))
+    return int(cmp["rc"])
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dpgo_tpu.obs.regress", description=__doc__)
+    ap.add_argument("run_a")
+    ap.add_argument("run_b")
+    ap.add_argument("--rtol", type=float, default=0.05,
+                    help="relative tolerance over run A's tail band "
+                         "(default 0.05)")
+    ap.add_argument("--allow-mismatch", action="store_true",
+                    help="compare despite fingerprint mismatches")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    return run_compare(args.run_a, args.run_b, rtol=args.rtol,
+                       json_out=args.json,
+                       allow_mismatch=args.allow_mismatch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

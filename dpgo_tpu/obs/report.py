@@ -3,16 +3,21 @@
 Usage::
 
     python -m dpgo_tpu.obs.report <run_dir> [<run_dir>...] [--json]
+    python -m dpgo_tpu.obs.report --compare <run_a> <run_b> [--json]
 
 Reads the artifacts a ``TelemetryRun`` persisted (``events.jsonl``,
 ``metrics.json``) and prints the run's story: event volume, per-iteration
 cost/gradient-norm trajectory, GNC mu annealing, round latency, per-phase
 wall-clock, communication volume, and — when the run carries ``span``
 events — the fleet timeline: per-robot busy/wait breakdown, per-round
-critical path, straggler ranking, and overlap efficiency.  ``--json``
+critical path, straggler ranking, and overlap efficiency.  Runs that hit
+numerical-health anomalies (``obs.health``) get a "numerical health"
+section and a pointer to the flight-recorder black box.  ``--json``
 emits the same content machine-readably (one JSON document per run dir).
-Pure host-side formatting — no devices are touched, so it runs anywhere
-the run directory is visible.
+``--compare`` invokes the convergence regression gate (``obs.regress``):
+exit 0 = no regression, 2 = regression or refused (mismatched
+fingerprints).  Pure host-side formatting — no devices are touched, so
+it runs anywhere the run directory is visible.
 """
 
 from __future__ import annotations
@@ -80,6 +85,36 @@ def _histogram_summary(name: str, fam: dict) -> list[str]:
         lab = f"{{{labels}}}" if labels else ""
         out.append(f"  {name}{lab}: n={n} mean={_fmt(mean)} p50<={med}")
     return out
+
+
+def _health_lines(events: list[dict]) -> list[str]:
+    """Render the numerical-health section: anomaly events (solver +
+    per-robot), fleet-wide peer anomaly sightings, and black-box dumps."""
+    anomalies = [ev for ev in events if ev.get("event") == "anomaly"]
+    peer = [ev for ev in events if ev.get("event") == "peer_anomaly"]
+    dumps = [ev for ev in events if ev.get("event") == "blackbox_dump"]
+    if not (anomalies or peer or dumps):
+        return []
+    crit = sum(1 for ev in anomalies if ev.get("severity") == "critical")
+    lines = [f"numerical health: {len(anomalies)} anomalies"
+             + (f" ({crit} critical)" if crit else "")]
+    for ev in anomalies[:10]:
+        where = f" robot {ev['robot']}" if "robot" in ev else ""
+        it = f" iter {ev['iteration']}" if "iteration" in ev else ""
+        lines.append(f"  [{ev.get('severity')}]{it}{where} "
+                     f"{ev.get('kind')} (stage {ev.get('stage', 0)})")
+    if len(anomalies) > 10:
+        lines.append(f"  ... {len(anomalies) - 10} more")
+    if peer:
+        tally = _TallyCounter(ev.get("peer") for ev in peer)
+        lines.append("  fleet: anomalies seen from "
+                     + ", ".join(f"robot {p} x{n}"
+                                 for p, n in sorted(tally.items())))
+    for ev in dumps:
+        lines.append(f"  blackbox: {ev.get('path')} (reason "
+                     f"{ev.get('reason')}, {ev.get('rounds_recorded')} "
+                     f"rounds, {ev.get('snapshots')} snapshots)")
+    return lines
 
 
 def _fleet_lines(stats: dict | None) -> list[str]:
@@ -163,9 +198,21 @@ def render_report(run_dir: str) -> str:
         if not any_traj:
             lines.append("  (no metric events)")
 
+        # Config fingerprint (run_summary channel="config" events, merged
+        # in stream order — what report --compare keys on).
+        fp: dict = {}
+        for ev in events:
+            if ev.get("event") == "run_summary" \
+                    and ev.get("channel") == "config":
+                fp.update(ev.get("fingerprint") or {})
+        if fp:
+            lines.append("config fingerprint: "
+                         + ", ".join(f"{k}={fp[k]}" for k in sorted(fp)))
+
         # Network health: the comms layer's terminal run_summary events
         # (one per channel, plus the bus's aggregate) and peer-loss story.
-        summaries = [ev for ev in events if ev.get("event") == "run_summary"]
+        summaries = [ev for ev in events if ev.get("event") == "run_summary"
+                     and ev.get("channel") != "config"]
         if summaries:
             lines.append("network health (comms):")
             for ev in summaries:
@@ -218,6 +265,7 @@ def render_report(run_dir: str) -> str:
                     f"/ {row.get('count', 0)} "
                     f"({row.get('avg_ms', 0.0):.2f} ms avg)")
 
+        lines.extend(_health_lines(events))
         lines.extend(_fleet_lines(fleet_timeline_stats(events)))
     else:
         lines.append("events: none")
@@ -262,7 +310,18 @@ def report_data(run_dir: str) -> dict:
         out["metric_events"] = [
             ev for ev in events if ev.get("event") == "metric"]
         out["network"] = [ev for ev in events
-                          if ev.get("event") == "run_summary"]
+                          if ev.get("event") == "run_summary"
+                          and ev.get("channel") != "config"]
+        fp: dict = {}
+        for ev in events:
+            if ev.get("event") == "run_summary" \
+                    and ev.get("channel") == "config":
+                fp.update(ev.get("fingerprint") or {})
+        out["fingerprint"] = fp
+        out["anomalies"] = [ev for ev in events
+                            if ev.get("event") in ("anomaly",
+                                                   "peer_anomaly",
+                                                   "blackbox_dump")]
         out["fleet_timeline"] = fleet_timeline_stats(events)
     m_path = os.path.join(run_dir, METRICS_FILE)
     if os.path.exists(m_path):
@@ -284,12 +343,28 @@ def _run_dir_error(rd: str) -> str | None:
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m dpgo_tpu.obs.report", description=__doc__)
-    ap.add_argument("run_dir", nargs="+",
+    ap.add_argument("run_dir", nargs="*",
                     help="telemetry run directory (holds events.jsonl)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output (one JSON document per "
                          "run dir) instead of the text report")
+    ap.add_argument("--compare", nargs=2, metavar=("RUN_A", "RUN_B"),
+                    help="convergence regression gate: compare two runs, "
+                         "exit 2 on regression or incomparable configs")
+    ap.add_argument("--rtol", type=float, default=0.05,
+                    help="--compare: relative tolerance over run A's tail "
+                         "noise band (default 0.05)")
+    ap.add_argument("--allow-mismatch", action="store_true",
+                    help="--compare: proceed despite fingerprint mismatches")
     args = ap.parse_args(argv)
+    if args.compare:
+        from .regress import run_compare
+
+        return run_compare(args.compare[0], args.compare[1],
+                           rtol=args.rtol, json_out=args.json,
+                           allow_mismatch=args.allow_mismatch)
+    if not args.run_dir:
+        ap.error("at least one run_dir is required (or --compare A B)")
     rc = 0
     try:
         for rd in args.run_dir:

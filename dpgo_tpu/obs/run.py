@@ -56,6 +56,13 @@ class TelemetryRun:
         self.registry = MetricsRegistry()
         self.events = EventStream(
             os.path.join(self.run_dir, EVENTS_FILE), self.run_id)
+        #: Config fingerprint (dataset, ranks, wire format, ... — whatever
+        #: the instrumented layers register via ``set_fingerprint``); the
+        #: regression gate (``obs.regress``) refuses apples-to-oranges
+        #: comparisons on it.
+        self.fingerprint: dict = {}
+        #: Optional attached ``obs.recorder.FlightRecorder``.
+        self.recorder = None
         self._closed = False
         self._t0_wall = time.time()
         self._t0_mono = time.monotonic()
@@ -82,6 +89,23 @@ class TelemetryRun:
     def histogram(self, name, help="", unit="", **kw):
         return self.registry.histogram(name, help, unit, **kw)
 
+    def set_fingerprint(self, **fields) -> dict:
+        """Merge config-identity fields (dataset, num_robots, rank,
+        sel_mode, wire format, package version, ...) into the run's
+        fingerprint and emit it as a ``run_summary`` event with
+        ``channel="config"`` — the record ``report --compare`` keys its
+        apples-to-oranges refusal on.  The merged fingerprint also lands
+        in ``run.json`` at close.  Fields set to None are dropped; later
+        calls override earlier keys (the most specific caller wins)."""
+        from .events import _jsonable
+
+        for k, v in fields.items():
+            if v is not None:
+                self.fingerprint[k] = _jsonable(v)
+        self.events.emit("run_summary", phase="config", channel="config",
+                         fingerprint=dict(self.fingerprint))
+        return dict(self.fingerprint)
+
     # -- persistence --------------------------------------------------------
 
     def write_snapshot(self) -> str:
@@ -107,6 +131,20 @@ class TelemetryRun:
                          duration_s=time.monotonic() - self._t0_mono)
         self.write_snapshot()
         self.events.close()
+        if self.fingerprint:
+            # Persist the final fingerprint into run.json so comparisons
+            # need not scan the event stream.
+            meta_path = os.path.join(self.run_dir, META_FILE)
+            try:
+                with open(meta_path) as fh:
+                    meta = json.load(fh)
+            except (OSError, ValueError):
+                meta = {"run": self.run_id}
+            meta["fingerprint"] = self.fingerprint
+            tmp = meta_path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(meta, fh)
+            os.replace(tmp, meta_path)
 
     @property
     def closed(self) -> bool:

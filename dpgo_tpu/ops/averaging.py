@@ -44,7 +44,15 @@ def single_translation_averaging(ts: jax.Array, tau: jax.Array | None = None,
 def single_rotation_averaging(Rs: jax.Array, kappa: jax.Array | None = None,
                               mask: jax.Array | None = None) -> jax.Array:
     """Project the weighted sum of ``Rs [k, d, d]`` onto SO(d)
-    (reference ``DPGO_utils.cpp:552-566``)."""
+    (reference ``DPGO_utils.cpp:552-566``).
+
+    Degenerate all-zero-weight input (e.g. GNC rejected every
+    measurement): the weighted sum is the zero matrix, whose SO(d)
+    projection is a valid (arbitrary but finite and deterministic)
+    rotation — never NaN.  Callers must detect the failure through the
+    empty ``inlier_mask`` of the robust variants, not through the
+    returned value (same contract as the 0-not-NaN translation
+    average)."""
     k = Rs.shape[0]
     w = jnp.ones(k, Rs.dtype) if kappa is None else kappa
     if mask is not None:

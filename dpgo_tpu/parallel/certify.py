@@ -35,6 +35,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..ops import manifold, quadratic
 from ..models import rbcd
 from ..models.rbcd import MultiAgentGraph
@@ -482,6 +483,22 @@ def certify_sharded(X, graph: MultiAgentGraph, mesh=None,
         f64_solve if global_ctx is not None else None)
     if vec64 is not None:
         direction = jnp.asarray(vec64, jnp.asarray(direction).dtype)
+    run = obs.get_run()
+    if run is not None:
+        # Verdict timeline on the distributed path too: the staircase's
+        # REFUSE loops (docs/NEXT.md) are exactly the streaks the health
+        # layer flags; every scalar here was already materialized above.
+        lam_used = lam_f64 if lam_f64 is not None else lam_min_f
+        run.event("certificate", phase="certify", sharded=True,
+                  certified=certified, decidable=decidable,
+                  lambda_min=lam_min_f, lambda_min_f64=lam_f64,
+                  eigenvalue_gap=lam_used + tol, tol=tol, sigma=sigma_f,
+                  stationarity_gap=float(stat))
+        from ..obs.health import monitor_for as _monitor_for
+
+        _monitor_for(run).observe_certificate(
+            certified=certified, decidable=decidable, lambda_min=lam_used,
+            source="certify_sharded")
     return CertificateResult(
         certified=certified,
         lambda_min=lam_min_f,

@@ -322,6 +322,11 @@ def solve_rbcd_sharded(
                   "modeled per-device interconnect bytes per round",
                   unit="bytes").set(bytes_round)
         run.event("phase_timings", phase="setup", timings=timer.as_dict())
+        # Mesh identity into the run fingerprint: a 1-device and an
+        # 8-device solve of the same problem are not comparable runs for
+        # the convergence regression gate (report --compare).
+        run.set_fingerprint(solver="solve_rbcd_sharded",
+                            mesh_size=mesh_size, exchange=exchange)
     return rbcd.run_rbcd(state, graph, meta, step, part, max_iters,
                          grad_norm_tol, eval_every, dtype, params=params,
                          multi_step=multi, segment=seg)

@@ -76,6 +76,27 @@ def gnc_init_mu(params: RobustCostParams) -> float:
     return params.gnc_init_mu
 
 
+def gnc_stage_index(mu, params: RobustCostParams) -> int:
+    """Host-side GNC stage label: the number of annealing steps taken to
+    reach ``mu`` from ``gnc_init_mu`` (0 before the first update, capped at
+    ``gnc_max_iters`` like ``gnc_update_mu``'s schedule).
+
+    The observability layer (``obs.health``) keys its per-stage baselines
+    — cost monotonicity, gradient-norm floor, stall windows — on this
+    index: within one stage the GNC objective is fixed and the cost should
+    be monotone; across stages it legitimately jumps.  Pure float math on
+    an already-read-back scalar, never called inside jitted code."""
+    import math
+
+    mu = float(mu)
+    mu0 = float(params.gnc_init_mu)
+    step = float(params.gnc_mu_step)
+    if mu <= 0 or mu0 <= 0 or step <= 1.0 or mu <= mu0:
+        return 0
+    k = round(math.log(mu / mu0) / math.log(step))
+    return max(0, min(int(k), int(params.gnc_max_iters)))
+
+
 def is_weight_converged(w: jax.Array, tol: float = 1e-4) -> jax.Array:
     """Elementwise: has this edge's GNC weight converged to {0, 1}?
 

@@ -74,10 +74,16 @@ class RoundTimer:
         self._t0[phase] = time.perf_counter()
 
     def stop(self, phase: str, sync=None) -> float:
+        if phase not in self._t0:
+            # Checked BEFORE the sync materialization: a mistyped phase
+            # must fail fast with the clear error, not first pay a
+            # device->host transfer for a window that was never opened.
+            open_ = ", ".join(sorted(self._t0)) or "none"
+            raise ValueError(
+                f"stop({phase!r}) without a matching start() "
+                f"(open phases: {open_})")
         if sync is not None:
             np.asarray(sync)
-        if phase not in self._t0:
-            raise ValueError(f"stop({phase!r}) without a matching start()")
         dt = time.perf_counter() - self._t0.pop(phase)
         self.totals[phase] = self.totals.get(phase, 0.0) + dt
         self.counts[phase] = self.counts.get(phase, 0) + 1

@@ -94,6 +94,9 @@ def main() -> None:
                   example="multi_robot_example", dataset=args.dataset,
                   num_robots=args.num_robots, rank=args.rank,
                   schedule=args.schedule, robust=args.robust)
+        # Dataset identity for report --compare's apples-to-oranges gate
+        # (the solver fingerprints everything else it knows).
+        run.set_fingerprint(dataset=args.dataset)
 
     t0 = time.perf_counter()
     result = rbcd.solve_rbcd(

@@ -105,6 +105,9 @@ def run_robot(args) -> None:
     run = obs.start_run(
         os.path.join(out_dir, "telemetry", f"robot{rid}")) \
         if args.telemetry else None
+    if run is not None:
+        run.set_fingerprint(dataset=args.dataset, num_robots=args.robots,
+                            rank=args.rank, robust=robust)
 
     meas = read_g2o(args.dataset)
     rp = RobustCostParams(cost_type=RobustCostType.GNC_TLS) if robust \

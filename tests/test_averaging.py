@@ -113,6 +113,67 @@ def test_robust_averaging_float32(rng):
     assert res.inlier_mask.tolist() == [True] * 4
 
 
+def test_degenerate_zero_weight_translation_is_zero_not_nan(rng):
+    """All-zero weights (GNC rejected every measurement): the documented
+    contract is a 0 vector, never NaN — callers detect the failure via
+    the empty inlier set, not the value."""
+    ts = jnp.asarray(rng.standard_normal((5, 3)))
+    t = averaging.single_translation_averaging(ts, tau=jnp.zeros(5))
+    assert np.array_equal(np.asarray(t), np.zeros(3))
+    # Zero via the mask path too.
+    t2 = averaging.single_translation_averaging(
+        ts, tau=jnp.ones(5), mask=jnp.zeros(5))
+    assert np.array_equal(np.asarray(t2), np.zeros(3))
+    # And in f32 (the TPU deployment precision).
+    t3 = averaging.single_translation_averaging(
+        jnp.asarray(ts, jnp.float32), tau=jnp.zeros(5, jnp.float32))
+    assert np.isfinite(np.asarray(t3)).all()
+
+
+def test_degenerate_zero_weight_rotation_is_finite(rng):
+    """Zero-weight rotation averaging projects the zero matrix: an
+    arbitrary but FINITE, deterministic rotation — never NaN."""
+    Rs = jnp.asarray(np.stack([random_rotation(rng) for _ in range(4)]))
+    R = np.asarray(averaging.single_rotation_averaging(
+        Rs, kappa=jnp.zeros(4)))
+    assert np.isfinite(R).all()
+    # A valid member of O(d) (orthonormal rows).
+    assert np.allclose(R @ R.T, np.eye(3), atol=1e-6)
+    R2 = np.asarray(averaging.single_rotation_averaging(
+        Rs, kappa=jnp.zeros(4)))
+    assert np.array_equal(R, R2)  # deterministic
+
+    Rp, tp = averaging.single_pose_averaging(
+        Rs, jnp.asarray(rng.standard_normal((4, 3))),
+        kappa=jnp.zeros(4), tau=jnp.zeros(4))
+    assert np.isfinite(np.asarray(Rp)).all()
+    assert np.array_equal(np.asarray(tp), np.zeros(3))
+
+
+def test_all_outlier_robust_averaging_reports_empty_inlier_set(rng):
+    """The caller-facing failure signal for degenerate robust averaging:
+    mutually-inconsistent measurements under a tight threshold finish
+    with finite outputs and an EMPTY inlier mask (the abort-and-retry
+    trigger of distributed initialization, ``PGOAgent.cpp:396-400``)."""
+    rots = [random_rotation(rng) for _ in range(6)]
+    # Ensure genuine mutual disagreement (random rotations are far apart
+    # w.h.p.; the fixed seed makes this deterministic).
+    Rs = jnp.asarray(np.stack(rots))
+    thresh = lie.angular_to_chordal_so3(1e-4)  # nothing can agree
+    res = averaging.robust_single_rotation_averaging(
+        Rs, error_threshold=thresh)
+    assert not np.asarray(res.inlier_mask).any()
+    assert np.isfinite(np.asarray(res.R)).all()
+    assert np.isfinite(np.asarray(res.weights)).all()
+
+    ts = jnp.asarray(5.0 * rng.standard_normal((6, 3)))
+    resp = averaging.robust_single_pose_averaging(
+        Rs, ts, error_threshold=1e-4)
+    assert not np.asarray(resp.inlier_mask).any()
+    assert np.isfinite(np.asarray(resp.R)).all()
+    assert np.isfinite(np.asarray(resp.t)).all()
+
+
 def test_robust_averaging_is_jittable(rng):
     import jax
 

@@ -312,8 +312,12 @@ def test_run_summary_and_counters_with_telemetry_on(tmp_path):
         cb.close()
     evs = read_events(str(tmp_path / "run" / "events.jsonl"))
     summaries = {e["channel"]: e for e in evs
-                 if e["event"] == "run_summary"}
+                 if e["event"] == "run_summary"
+                 and e.get("channel") != "config"}  # fingerprint rides too
     assert set(summaries) == {"a->b", "b->a"}
+    # The transport stamped its wire format into the config fingerprint.
+    configs = [e for e in evs if e.get("channel") == "config"]
+    assert configs and configs[-1]["fingerprint"]["wire_format"]
     assert summaries["a->b"]["messages_sent"] == 2
     assert summaries["b->a"]["messages_received"] == 1
     assert summaries["b->a"]["stale_dropped"] == 1

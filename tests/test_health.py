@@ -1,0 +1,324 @@
+"""Numerical-health layer (``dpgo_tpu.obs.health``): anomaly detectors,
+abort/callback policy, the instrumented solver path, per-agent sentinels,
+and the fleet-wide health gossip riding the comms bus."""
+
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpgo_tpu import obs
+from dpgo_tpu.obs.events import read_events
+from dpgo_tpu.obs.health import (HealthConfig, HealthMonitor,
+                                 SolverHealthError, monitor_for)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_ambient_run():
+    obs.end_run()
+    yield
+    obs.end_run()
+
+
+def _events(d):
+    return read_events(os.path.join(d, "events.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# Detector unit tests
+# ---------------------------------------------------------------------------
+
+def test_monitor_for_fence_and_reuse(tmp_path):
+    assert monitor_for() is None  # telemetry off -> no detector exists
+    with obs.run_scope(str(tmp_path / "r")) as run:
+        mon = monitor_for()
+        assert isinstance(mon, HealthMonitor)
+        assert monitor_for() is mon  # cached on the run
+        mon2 = monitor_for(run, HealthConfig(stall_window=3))
+        assert mon2 is not mon and monitor_for() is mon2  # config replaces
+
+
+def test_nan_sentinel_fires_critical_anomaly(tmp_path):
+    d = str(tmp_path / "r")
+    with obs.run_scope(d) as run:
+        mon = HealthMonitor(run)
+        fired = mon.observe_solver(4, float("nan"), 1.0)
+        assert [a["kind"] for a in fired] == ["non_finite"]
+        assert fired[0]["severity"] == "critical"
+        assert fired[0]["signals"] == ["cost"]
+        # Per-agent rel-change NaN is attributed to the agent.
+        fired = mon.observe_solver(6, 1.0, 1.0,
+                                   rel_change=np.array([0.1, np.nan]))
+        assert fired[0]["agents"] == [1]
+    evs = [e for e in _events(d) if e["event"] == "anomaly"]
+    assert len(evs) == 2
+    assert all(e["phase"] == "health" for e in evs)
+    assert evs[0]["iteration"] == 4
+    # The counter metric tallied by kind/severity.
+    snap = run.registry.snapshot()
+    (s,) = snap["anomalies_total"]["series"]
+    assert s["value"] == 2.0
+
+
+def test_cost_spike_is_stage_scoped(tmp_path):
+    """Non-monotone cost flags within a GNC stage; a mu transition resets
+    the baseline so the legitimate GNC cost jump does not flag."""
+    with obs.run_scope(str(tmp_path / "r")) as run:
+        mon = HealthMonitor(run, HealthConfig(cost_spike_rtol=0.25))
+        assert mon.observe_solver(1, 100.0, 1.0, mu=1e-4) == []
+        assert mon.observe_solver(2, 90.0, 1.0, mu=1e-4) == []
+        # Within-stage spike beyond 25%: flags.
+        (a,) = mon.observe_solver(3, 140.0, 1.0, mu=1e-4)
+        assert a["kind"] == "cost_spike" and a["severity"] == "warning"
+        # mu annealed -> new stage: a bigger cost is NOT an anomaly.
+        assert mon.observe_solver(4, 500.0, 1.0, mu=1.4e-4) == []
+        assert mon.anomalies[-1]["stage"] == 0  # spike was in stage 0
+
+
+def test_grad_explosion_and_stall(tmp_path):
+    with obs.run_scope(str(tmp_path / "r")) as run:
+        mon = HealthMonitor(run, HealthConfig(grad_explosion_factor=100.0,
+                                              stall_window=3,
+                                              stall_rtol=1e-3))
+        assert mon.observe_solver(1, 10.0, 1.0) == []
+        (a,) = mon.observe_solver(2, 9.0, 150.0)
+        assert a["kind"] == "grad_explosion" and a["severity"] == "critical"
+        # Stall: three evals with < 0.1% improvement, fired exactly once.
+        assert mon.observe_solver(3, 9.0, 1.0) == []
+        fired = mon.observe_solver(4, 8.9999, 1.0)
+        assert [x["kind"] for x in fired] == ["stall"]
+        assert mon.observe_solver(5, 8.9998, 1.0) == []  # once per stage
+
+
+def test_inlier_collapse(tmp_path):
+    with obs.run_scope(str(tmp_path / "r")) as run:
+        mon = HealthMonitor(run, HealthConfig(inlier_collapse_drop=0.4))
+        assert mon.observe_solver(1, 1.0, 1.0, inlier_frac=0.9) == []
+        assert mon.observe_solver(2, 1.0, 1.0, inlier_frac=0.8) == []
+        (a,) = mon.observe_solver(3, 1.0, 1.0, inlier_frac=0.3)
+        assert a["kind"] == "inlier_collapse"
+        assert a["running_max"] == pytest.approx(0.9)
+
+
+def test_cert_refuse_loop(tmp_path):
+    with obs.run_scope(str(tmp_path / "r")) as run:
+        mon = HealthMonitor(run, HealthConfig(cert_refuse_streak=2))
+        assert mon.observe_certificate(False, decidable=False) == []
+        (a,) = mon.observe_certificate(False, decidable=False)
+        assert a["kind"] == "cert_refuse_loop"
+        assert a["refusals"] == 2
+        # Streak flagged once; a decidable verdict resets it.
+        assert mon.observe_certificate(False, decidable=False) == []
+        assert mon.observe_certificate(True, decidable=True) == []
+        assert mon.observe_certificate(False, decidable=False) == []
+        (b,) = mon.observe_certificate(False, decidable=False)
+        assert b["kind"] == "cert_refuse_loop"
+
+
+def test_callback_and_abort_policy(tmp_path):
+    with obs.run_scope(str(tmp_path / "r")) as run:
+        seen = []
+        mon = HealthMonitor(run, HealthConfig(abort_on=frozenset({"critical"})))
+        mon.on_anomaly(seen.append)
+        with pytest.raises(SolverHealthError) as ei:
+            mon.observe_solver(7, float("inf"), 1.0)
+        assert ei.value.anomalies[0]["kind"] == "non_finite"
+        assert seen and seen[0]["kind"] == "non_finite"
+        # Kind-targeted abort.
+        mon2 = HealthMonitor(run, HealthConfig(
+            cost_spike_rtol=0.1, abort_on=frozenset({"cost_spike"})))
+        mon2.observe_solver(1, 10.0, 1.0)
+        with pytest.raises(SolverHealthError):
+            mon2.observe_solver(2, 20.0, 1.0)
+
+
+def test_anomaly_triggers_recorder_dump(tmp_path):
+    """The dump policy: a critical anomaly dumps an attached recorder's
+    black box (first dump wins)."""
+    from dpgo_tpu.obs.recorder import FlightRecorder
+
+    d = str(tmp_path / "r")
+    with obs.run_scope(d) as run:
+        rec = FlightRecorder.attach(run)
+        rec.record_eval(2, {"cost": 1.0, "grad_norm": 0.5})
+        mon = HealthMonitor(run)
+        mon.observe_solver(4, float("nan"), 1.0)
+        assert rec._dumped == "anomaly:non_finite"
+        assert os.path.exists(os.path.join(d, "blackbox.npz"))
+    evs = _events(d)
+    (dump,) = [e for e in evs if e["event"] == "blackbox_dump"]
+    assert dump["reason"] == "anomaly:non_finite"
+
+
+# ---------------------------------------------------------------------------
+# Instrumented solver path
+# ---------------------------------------------------------------------------
+
+def _tiny_problem(n=40, num_lc=20, seed=0):
+    from dpgo_tpu.utils.synthetic import make_measurements
+
+    meas, _ = make_measurements(np.random.default_rng(seed), n=n, d=3,
+                                num_lc=num_lc, rot_noise=0.01,
+                                trans_noise=0.01)
+    return meas
+
+
+def test_healthy_solve_emits_no_anomalies(tmp_path):
+    from dpgo_tpu.config import AgentParams, RobustCostParams, RobustCostType
+    from dpgo_tpu.models import rbcd
+
+    d = str(tmp_path / "run")
+    with obs.run_scope(d):
+        rbcd.solve_rbcd(
+            _tiny_problem(), 2,
+            params=AgentParams(
+                d=3, r=5, num_robots=2,
+                robust=RobustCostParams(cost_type=RobustCostType.GNC_TLS),
+                robust_opt_inner_iters=4),
+            max_iters=8, eval_every=2, grad_norm_tol=1e-9,
+            dtype=jnp.float64)
+    assert [e for e in _events(d) if e["event"] == "anomaly"] == []
+
+
+def test_certify_refuse_loop_reaches_health(tmp_path):
+    """certify_solution with f64 verification disabled on an undecidable
+    problem feeds the REFUSE-loop detector."""
+    from dpgo_tpu.models import certify
+
+    d = str(tmp_path / "run")
+    with obs.run_scope(d) as run:
+        monitor_for(run, HealthConfig(cert_refuse_streak=2))
+        mon = monitor_for(run)
+        # Drive the verdict timeline directly (an undecidable eigensolve
+        # needs a large ill-conditioned graph; the wiring is what's under
+        # test — certify_solution calls observe_certificate, asserted in
+        # test_obs-style integration below).
+        mon.observe_certificate(False, decidable=False,
+                                source="certify_solution")
+        mon.observe_certificate(False, decidable=False,
+                                source="certify_solution")
+    evs = [e for e in _events(d) if e["event"] == "anomaly"]
+    assert [e["kind"] for e in evs] == ["cert_refuse_loop"]
+
+
+def test_certify_solution_observes_verdict(tmp_path, monkeypatch):
+    """The real certify_solution path lands on the monitor's verdict
+    stream."""
+    from dpgo_tpu.models import certify, local_pgo
+    from dpgo_tpu.types import edge_set_from_measurements
+
+    meas = _tiny_problem(n=20, num_lc=8)
+    edges = edge_set_from_measurements(meas, dtype=jnp.float64)
+    res = local_pgo.solve_local(meas, rank=5)
+    d = str(tmp_path / "run")
+    with obs.run_scope(d) as run:
+        certify.certify_solution(res.X, edges)
+        mon = monitor_for(run)
+        # One decidable verdict observed -> refusal streak is clear.
+        assert mon._cert_refusals == 0
+        assert mon.anomalies == []
+
+
+# ---------------------------------------------------------------------------
+# Deployment plane: per-agent sentinels + bus gossip
+# ---------------------------------------------------------------------------
+
+def test_agent_nan_neighbor_frame_anomaly(tmp_path):
+    from test_agent import exchange, make_agents
+
+    d = str(tmp_path / "run")
+    with obs.run_scope(d) as run:
+        agents, _part, _ = make_agents(2, n=12, num_lc=6)
+        exchange(agents)
+        for ag in agents:
+            ag.iterate()
+        # Poison one neighbor frame of robot 0 with NaN values.
+        nbr = agents[0].get_neighbors()[0]
+        poses = agents[0].get_neighbor_public_poses(nbr)
+        vals = np.full((len(poses), agents[0].r, agents[0].d + 1), np.nan)
+        agents[0].update_neighbor_poses_packed(
+            nbr, np.full(len(poses), nbr), np.asarray(poses), vals)
+        assert agents[0].health_counters() == (1, 2)  # one critical
+        assert agents[1].health_counters() == (0, 0)
+        snap = run.registry.snapshot()
+    evs = [e for e in _events(d) if e["event"] == "anomaly"]
+    (a,) = evs
+    assert a["kind"] == "non_finite_neighbor_frame"
+    assert a["robot"] == 0 and a["neighbor"] == nbr
+    assert a["severity"] == "critical"
+    (s,) = [s for s in snap["anomalies_total"]["series"]
+            if ("robot", "0") in s["labels"].items()]
+    assert s["value"] == 1.0
+
+
+def test_anomaly_counters_ride_the_bus(tmp_path):
+    """pack_agent_frame ships the counters; the hub surfaces grown counts
+    as peer_anomaly events; a peer's ingest records the gossip gauge."""
+    from test_agent import exchange, make_agents
+    from dpgo_tpu.comms.bus import (apply_peer_frame, loopback_fleet,
+                                    pack_agent_frame)
+
+    d = str(tmp_path / "run")
+    with obs.run_scope(d) as run:
+        agents, _part, _ = make_agents(2, n=12, num_lc=6)
+        exchange(agents)
+        agents[0]._obs_anomaly("non_finite_rel_change", "critical")
+        frame = pack_agent_frame(agents[0])
+        assert list(np.asarray(frame["anom"])) == [1, 2]
+        # Healthy agent ships no anom entry at all.
+        assert "anom" not in pack_agent_frame(agents[1])
+
+        bus, clients = loopback_fleet(1)
+        try:
+            clients[0].publish(frame)
+            merged = bus.round()
+            assert "r0|anom" in merged
+        finally:
+            bus.close()
+            for c in clients.values():
+                c.close()
+
+        # Receiver-side ingest: the anom entry is popped (never parsed as
+        # poses/weights) and lands on the gossip gauge.
+        pf = {k.split("|", 1)[1]: v for k, v in merged.items()
+              if k.startswith("r0|")}
+        apply_peer_frame(agents[1], 0, pf)
+        assert "anom" not in pf
+        snap = run.registry.snapshot()
+    evs = _events(d)
+    (pa,) = [e for e in evs if e["event"] == "peer_anomaly"]
+    assert pa["peer"] == 0 and pa["count"] == 1
+    assert pa["severity"] == "critical"
+    gauge = snap["peer_anomalies_seen"]["series"]
+    assert any(s["value"] == 1.0 for s in gauge)
+
+
+def test_health_layer_is_zero_overhead_when_off(monkeypatch):
+    """Telemetry off: no HealthMonitor constructed, no recorder buffers
+    allocated, no anomaly scan over received frames."""
+    from test_agent import exchange, make_agents
+    from dpgo_tpu.obs import health as health_mod
+    from dpgo_tpu.obs import recorder as recorder_mod
+    from dpgo_tpu.config import AgentParams
+    from dpgo_tpu.models import rbcd
+
+    def boom(*a, **kw):
+        raise AssertionError("health/recorder path taken while disabled")
+
+    monkeypatch.setattr(health_mod.HealthMonitor, "__init__", boom)
+    monkeypatch.setattr(recorder_mod.FlightRecorder, "__init__", boom)
+
+    assert obs.get_run() is None
+    res = rbcd.solve_rbcd(_tiny_problem(), 2,
+                          params=AgentParams(d=3, r=5, num_robots=2),
+                          max_iters=4, eval_every=2, grad_norm_tol=1e-9,
+                          dtype=jnp.float64)
+    assert res.iterations > 0
+
+    agents, _part, _ = make_agents(2, n=10, num_lc=4)
+    exchange(agents)
+    for ag in agents:
+        ag.iterate()
+    assert all(ag.health_counters() == (0, 0) for ag in agents)

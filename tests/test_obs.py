@@ -150,22 +150,48 @@ def test_metric_record_matches_bench_schema():
                         vs_baseline=33.4)
     assert list(rec)[:3] == ["metric", "value", "unit"]
     assert rec["vs_baseline"] == 33.4
-    # Non-finite floats and numpy scalars serialize cleanly.
+    # Non-finite floats and numpy scalars serialize cleanly, on the one
+    # canonical (Prometheus-style) spelling.
     rec2 = metric_record("m", np.float64(2.0), extra=float("inf"))
-    assert rec2["value"] == 2.0 and rec2["extra"] == "inf"
+    assert rec2["value"] == 2.0 and rec2["extra"] == "+Inf"
     json.dumps(rec2)
 
 
 def test_event_payloads_coerce_numpy(tmp_path):
     es = EventStream(str(tmp_path / "e.jsonl"), "r")
     es.emit("x", arr=np.arange(3), scalar=np.float32(1.5),
-            nested={"a": np.int64(2)}, nan=float("nan"))
+            nested={"a": np.int64(2)}, nan=float("nan"),
+            pinf=float("inf"), ninf=float("-inf"))
     es.close()
+    # On disk: the canonical non-finite strings (valid JSON).
+    raw = json.loads(open(str(tmp_path / "e.jsonl")).readline())
+    assert raw["nan"] == "NaN"
+    assert raw["pinf"] == "+Inf" and raw["ninf"] == "-Inf"
+    # Through read_events: restored to real floats (the round-trip).
     (ev,) = read_events(str(tmp_path / "e.jsonl"))
     assert ev["arr"] == [0, 1, 2]
     assert ev["scalar"] == 1.5
     assert ev["nested"] == {"a": 2}
-    assert ev["nan"] == "nan"
+    import math
+
+    assert math.isnan(ev["nan"])
+    assert ev["pinf"] == float("inf") and ev["ninf"] == float("-inf")
+
+
+def test_nonfinite_convention_unified_across_snapshot_and_prometheus():
+    """The metrics snapshot and the Prometheus exposition spell non-finite
+    values identically (the satellite: metrics.py stringified str(float)
+    while the exporter emitted NaN/+Inf)."""
+    reg = MetricsRegistry()
+    reg.gauge("g_nan").set(float("nan"))
+    reg.gauge("g_inf").set(float("inf"))
+    snap = reg.snapshot()
+    assert snap["g_nan"]["series"][0]["value"] == "NaN"
+    assert snap["g_inf"]["series"][0]["value"] == "+Inf"
+    text = to_prometheus_text(reg)
+    assert "g_nan NaN" in text
+    assert "g_inf +Inf" in text
+    json.dumps(snap)
 
 
 # ---------------------------------------------------------------------------
@@ -399,18 +425,21 @@ def test_sharded_solve_telemetry(tmp_path):
 def test_telemetry_off_is_zero_overhead(monkeypatch):
     """With no ambient run, an instrumented solve emits ZERO events, makes
     ZERO registry calls, performs ZERO obs-owned device->host transfers in
-    the RBCD round loop, and constructs ZERO tracing spans — the
-    instrumentation's only cost is the ``get_run() is None`` guard."""
+    the RBCD round loop, constructs ZERO tracing spans, ZERO health
+    detectors, and ZERO flight-recorder buffers — the instrumentation's
+    only cost is the ``get_run() is None`` guard."""
     from dpgo_tpu.config import AgentParams
     from dpgo_tpu.models import rbcd
+    from dpgo_tpu.obs import health as health_mod
     from dpgo_tpu.obs import metrics as metrics_mod
+    from dpgo_tpu.obs import recorder as recorder_mod
     from dpgo_tpu.obs import trace as trace_mod
 
     def boom(*a, **kw):
         raise AssertionError("telemetry path taken while disabled")
 
     # Any event emission, any registry mutation, any obs-owned transfer,
-    # any span construction trips the failure.
+    # any span/detector/recorder construction trips the failure.
     monkeypatch.setattr(EventStream, "emit", boom)
     monkeypatch.setattr(run_mod, "materialize", boom)
     monkeypatch.setattr(obs, "materialize", boom)
@@ -419,6 +448,10 @@ def test_telemetry_off_is_zero_overhead(monkeypatch):
     monkeypatch.setattr(metrics_mod.Histogram, "observe_many", boom)
     monkeypatch.setattr(trace_mod.Span, "__init__", boom)
     monkeypatch.setattr(trace_mod, "emit_span", boom)
+    monkeypatch.setattr(health_mod.HealthMonitor, "__init__", boom)
+    monkeypatch.setattr(health_mod.HealthMonitor, "observe_solver", boom)
+    monkeypatch.setattr(recorder_mod.FlightRecorder, "__init__", boom)
+    monkeypatch.setattr(recorder_mod.FlightRecorder, "record_eval", boom)
 
     assert obs.get_run() is None
     meas = _tiny_problem()
@@ -434,6 +467,7 @@ def test_telemetry_off_is_zero_overhead(monkeypatch):
 
 def test_telemetry_off_agent_paths(monkeypatch):
     from test_agent import exchange, make_agents
+    from dpgo_tpu.obs import health as health_mod
     from dpgo_tpu.obs import trace as trace_mod
 
     def boom(*a, **kw):
@@ -443,6 +477,8 @@ def test_telemetry_off_agent_paths(monkeypatch):
     monkeypatch.setattr(run_mod, "materialize", boom)
     monkeypatch.setattr(trace_mod.Span, "__init__", boom)
     monkeypatch.setattr(trace_mod, "emit_span", boom)
+    monkeypatch.setattr(health_mod.HealthMonitor, "__init__", boom)
+    monkeypatch.setattr(health_mod, "monitor_for", boom)
 
     agents, _part, _ = make_agents(2, n=10, num_lc=4)
     for _ in range(2):

@@ -58,6 +58,30 @@ def test_round_timer_stop_without_start_raises():
     t = profiling.RoundTimer()
     with pytest.raises(ValueError, match="without a matching start"):
         t.stop("never_started")
+    # The error names the phases that ARE open — the actionable detail
+    # when a phase string is mistyped mid-refactor.
+    t.start("solve")
+    with pytest.raises(ValueError, match=r"open phases: solve"):
+        t.stop("slove")
+    assert "solve" in t._t0  # the open window survives the failed stop
+
+
+def test_round_timer_stop_guard_precedes_sync():
+    """A never-started stop must fail fast WITHOUT materializing the sync
+    value — no device->host transfer paid for a window that never
+    opened."""
+
+    class Probe:
+        materialized = False
+
+        def __array__(self, dtype=None, copy=None):
+            Probe.materialized = True
+            return np.zeros(1)
+
+    t = profiling.RoundTimer()
+    with pytest.raises(ValueError, match="without a matching start"):
+        t.stop("never_started", sync=Probe())
+    assert not Probe.materialized
 
 
 def test_round_timer_sync_fence_materializes_device_value():

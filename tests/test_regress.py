@@ -1,0 +1,167 @@
+"""Convergence regression gate (``dpgo_tpu.obs.regress`` /
+``report --compare``): clean seeded runs pass, synthetic regressions fail
+with rc 2 and a readable delta table, mismatched fingerprints are refused."""
+
+import json
+import os
+import shutil
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpgo_tpu import obs
+from dpgo_tpu.obs.regress import compare_runs, render_compare, tail_band
+from dpgo_tpu.obs.report import main as report_main
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_ambient_run():
+    obs.end_run()
+    yield
+    obs.end_run()
+
+
+def _tiny_problem(n=40, num_lc=20, seed=0):
+    from dpgo_tpu.utils.synthetic import make_measurements
+
+    meas, _ = make_measurements(np.random.default_rng(seed), n=n, d=3,
+                                num_lc=num_lc, rot_noise=0.01,
+                                trans_noise=0.01)
+    return meas
+
+
+def _solve_into(run_dir, seed=0, num_robots=2, max_iters=8):
+    from dpgo_tpu.config import AgentParams
+    from dpgo_tpu.models import rbcd
+
+    with obs.run_scope(run_dir):
+        rbcd.solve_rbcd(_tiny_problem(seed=seed), num_robots,
+                        params=AgentParams(d=3, r=5, num_robots=num_robots,
+                                           rel_change_tol=1e-16),
+                        max_iters=max_iters, eval_every=2,
+                        grad_norm_tol=1e-12, dtype=jnp.float64)
+
+
+def test_tail_band_matches_cpu_arm_band_schema():
+    band = tail_band([3.0, 1.0, 2.0, 4.0], k=3)
+    # The cpu_arm_band key set of bench.py's metric_record.
+    assert {"min", "median", "max", "windows"} <= set(band)
+    assert band["min"] == 1.0 and band["max"] == 4.0
+    assert band["median"] == 2.0
+    nanband = tail_band([float("nan")])
+    assert np.isnan(nanband["median"])
+
+
+def test_clean_seeded_runs_compare_equal(tmp_path, capsys):
+    a, b = str(tmp_path / "runA"), str(tmp_path / "runB")
+    _solve_into(a, seed=0)
+    _solve_into(b, seed=0)
+    cmp = compare_runs(a, b)
+    assert cmp["rc"] == 0 and cmp["regressions"] == []
+    assert cmp["fingerprint_mismatches"] == {}
+    # The deterministic CPU trajectories are identical.
+    assert cmp["metrics"]["solver_cost"]["max_rel_deviation"] == 0.0
+    assert report_main(["--compare", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "no regression" in out
+    # --json emits the machine document.
+    assert report_main(["--compare", a, b, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["rc"] == 0
+
+
+def test_corrupted_metric_fails_with_rc2(tmp_path, capsys):
+    """The CI scenario: copy a clean run, inflate its final solver_cost,
+    compare must fail rc 2 with a human-readable delta table."""
+    a, c = str(tmp_path / "runA"), str(tmp_path / "runC")
+    _solve_into(a, seed=0)
+    shutil.copytree(a, c)
+    ev_path = os.path.join(c, "events.jsonl")
+    lines = open(ev_path).read().splitlines()
+    out, seen = [], 0
+    cost_lines = sum(1 for ln in lines if '"metric": "solver_cost"' in ln)
+    for ln in lines:
+        if '"metric": "solver_cost"' in ln:
+            seen += 1
+            if seen == cost_lines:  # corrupt the FINAL cost event
+                ev = json.loads(ln)
+                ev["value"] = ev["value"] * 10.0
+                ln = json.dumps(ev)
+        out.append(ln)
+    open(ev_path, "w").write("\n".join(out) + "\n")
+
+    assert report_main(["--compare", a, c]) == 2
+    text = capsys.readouterr().out
+    assert "REGRESSED" in text and "solver_cost" in text
+    assert "REGRESSION" in text
+    # Direction matters: the corrupted run as baseline sees an
+    # IMPROVEMENT, which does not regress.
+    assert report_main(["--compare", c, a]) == 0
+    capsys.readouterr()
+
+
+def test_nonfinite_final_value_regresses(tmp_path):
+    a, c = str(tmp_path / "runA"), str(tmp_path / "runC")
+    _solve_into(a, seed=0)
+    shutil.copytree(a, c)
+    ev_path = os.path.join(c, "events.jsonl")
+    lines = open(ev_path).read().splitlines()
+    for i in range(len(lines) - 1, -1, -1):
+        if '"metric": "solver_grad_norm"' in lines[i]:
+            ev = json.loads(lines[i])
+            ev["value"] = "NaN"  # the canonical non-finite serialization
+            lines[i] = json.dumps(ev)
+            break
+    open(ev_path, "w").write("\n".join(lines) + "\n")
+    cmp = compare_runs(a, c)
+    assert "solver_grad_norm" in cmp["regressions"]
+    assert cmp["metrics"]["solver_grad_norm"]["reason"] \
+        == "non-finite final value"
+
+
+def test_critical_anomalies_regress_even_with_equal_metrics(tmp_path):
+    a, c = str(tmp_path / "runA"), str(tmp_path / "runC")
+    _solve_into(a, seed=0)
+    shutil.copytree(a, c)
+    with open(os.path.join(c, "events.jsonl"), "a") as fh:
+        fh.write(json.dumps({"run": "x", "seq": 999, "t_wall": 0.0,
+                             "t_mono": 0.0, "event": "anomaly",
+                             "kind": "non_finite",
+                             "severity": "critical"}) + "\n")
+    cmp = compare_runs(a, c)
+    assert cmp["rc"] == 2 and "anomalies" in cmp["regressions"]
+
+
+def test_fingerprint_mismatch_refused(tmp_path, capsys):
+    """Apples-to-oranges comparisons (different robot counts here) are
+    refused with a clear message; --allow-mismatch overrides."""
+    a, b = str(tmp_path / "runA"), str(tmp_path / "runB")
+    _solve_into(a, seed=0, num_robots=2)
+    _solve_into(b, seed=0, num_robots=4)
+    assert report_main(["--compare", a, b]) == 2
+    out = capsys.readouterr().out
+    assert "REFUSED" in out and "num_robots" in out
+    assert "2" in out and "4" in out
+    # Override: compared anyway, mismatches noted.
+    rc = report_main(["--compare", a, b, "--allow-mismatch"])
+    out = capsys.readouterr().out
+    assert "overridden" in out
+    assert rc in (0, 2)  # gate result now depends on the actual deltas
+
+
+def test_compare_rejects_non_run_dir(tmp_path, capsys):
+    a = str(tmp_path / "runA")
+    _solve_into(a, seed=0)
+    assert report_main(["--compare", a, str(tmp_path / "nope")]) == 2
+    assert "not a telemetry run" in capsys.readouterr().err
+
+
+def test_fingerprint_persisted_into_run_json(tmp_path):
+    a = str(tmp_path / "runA")
+    _solve_into(a, seed=0)
+    meta = json.load(open(os.path.join(a, "run.json")))
+    fp = meta["fingerprint"]
+    assert fp["num_robots"] == 2 and fp["rank"] == 5
+    assert fp["dtype"] == "float64"
+    assert "version" in fp
