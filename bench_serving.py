@@ -1,0 +1,189 @@
+"""Serving-plane throughput benchmark: batched QPS vs. sequential cold solves.
+
+The serving acceptance measurement (ROADMAP item 1): N concurrent
+mixed-size problems through the batched solve server
+(``dpgo_tpu.serve``) vs. the same problems solved one at a time with
+``solve_rbcd`` — the library's cold path, where every distinct problem
+shape compiles and dispatches its own programs.  The batched arm pads the
+problems into shape buckets and solves many per device dispatch through
+the fingerprint-keyed executable cache, which is exactly the work the
+sequential arm repeats per problem.
+
+Both arms run cold in one process (the persistent XLA disk cache is
+disabled below so "cold" is real on every invocation) and must agree on
+final costs within ``--parity-rtol``.  Emits ONE ``metric_record`` JSON
+line on stdout (the BENCH_r0*.json schema), and with ``--telemetry`` the
+serve plane's per-tenant SLO events land in a run directory the report
+CLI renders (``python -m dpgo_tpu.obs.report <dir>`` -> "serving"
+section with QPS, occupancy, and p50/p99 latency).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python bench_serving.py --n-problems 8 \
+        --telemetry /tmp/serve_bench_run
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# Cold means cold: a warm persistent compile cache would hide exactly the
+# per-shape compilation cost the sequential arm is supposed to pay.
+os.environ.setdefault("DPGO_TPU_COMPILATION_CACHE", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+from dpgo_tpu import obs  # noqa: E402
+from dpgo_tpu.config import AgentParams  # noqa: E402
+from dpgo_tpu.models import rbcd  # noqa: E402
+from dpgo_tpu.utils.synthetic import make_measurements  # noqa: E402
+
+
+def make_problems(n_problems: int, base_n: int, spread: int, seed: int):
+    """Mixed-size synthetic pose graphs: sizes fan out over ``spread``
+    poses so no two problems share a raw shape (the sequential arm gets
+    no accidental jit-cache reuse), while bucketing coalesces them."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for k in range(n_problems):
+        n = base_n + (k * spread) // max(1, n_problems - 1)
+        meas, _ = make_measurements(
+            np.random.default_rng(seed + 7 * k), n=n, d=3,
+            num_lc=6 + k % 5, rot_noise=0.01, trans_noise=0.01)
+        out.append(meas)
+    rng.shuffle(out)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n-problems", type=int, default=8)
+    ap.add_argument("--robots", type=int, default=2)
+    ap.add_argument("--base-n", type=int, default=40, help="smallest problem")
+    ap.add_argument("--spread", type=int, default=14,
+                    help="pose-count fan-out across problems")
+    ap.add_argument("--max-iters", type=int, default=10)
+    ap.add_argument("--eval-every", type=int, default=5)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--quantum", type=int, default=64,
+                    help="serve bucket quantum (coarser = fewer buckets)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--parity-rtol", type=float, default=1e-4,
+                    help="required relative agreement of final costs")
+    ap.add_argument("--tenants", type=int, default=2,
+                    help="requests round-robin over this many tenants")
+    ap.add_argument("--telemetry", metavar="DIR", default=None)
+    args = ap.parse_args(argv)
+
+    from dpgo_tpu.serve import SolveRequest, SolveServer
+
+    problems = make_problems(args.n_problems, args.base_n, args.spread,
+                             args.seed)
+    params = AgentParams(d=3, r=5, num_robots=args.robots)
+    gtol = 1e-12  # run full --max-iters in both arms: equal work per problem
+
+    def log(msg):
+        print(msg, file=sys.stderr, flush=True)
+
+    # --- Arm 1: sequential cold solves (the library path) ------------------
+    log(f"[seq] {args.n_problems} problems x {args.robots} robots, "
+        f"max_iters {args.max_iters}")
+    t0 = time.perf_counter()
+    seq_results = [
+        rbcd.solve_rbcd(m, args.robots, params=params,
+                        max_iters=args.max_iters, grad_norm_tol=gtol,
+                        eval_every=args.eval_every)
+        for m in problems
+    ]
+    t_seq = time.perf_counter() - t0
+    qps_seq = args.n_problems / t_seq
+    log(f"[seq] {t_seq:.2f}s ({qps_seq:.3f} problems/s)")
+
+    # --- Arm 2: batched serving ------------------------------------------
+    from dpgo_tpu.obs.events import metric_record
+
+    scope = obs.run_scope(args.telemetry) if args.telemetry else None
+    run = scope.__enter__() if scope else None
+    try:
+        t0 = time.perf_counter()
+        with SolveServer(max_batch=args.max_batch, batch_window_s=0.02,
+                         quantum=args.quantum) as srv:
+            tickets = [
+                srv.submit(SolveRequest(
+                    meas=m, num_robots=args.robots, params=params,
+                    tenant=f"tenant{k % max(1, args.tenants)}",
+                    max_iters=args.max_iters, grad_norm_tol=gtol,
+                    eval_every=args.eval_every))
+                for k, m in enumerate(problems)
+            ]
+            srv_results = [t.result(timeout=3600) for t in tickets]
+            lat = [t.latency_s for t in tickets]
+            cache = srv.cache.stats()
+        t_batch = time.perf_counter() - t0
+        qps_batch = args.n_problems / t_batch
+        log(f"[serve] {t_batch:.2f}s ({qps_batch:.3f} problems/s), "
+            f"cache {cache}")
+
+        # --- Parity -------------------------------------------------------
+        worst = 0.0
+        for a, b in zip(seq_results, srv_results):
+            ca, cb = a.cost_history[-1], b.cost_history[-1]
+            rel = abs(ca - cb) / max(1.0, abs(ca))
+            worst = max(worst, rel)
+            if rel > args.parity_rtol:
+                log(f"PARITY FAIL: sequential {ca} vs batched {cb} "
+                    f"(rel {rel})")
+                return 1
+        log(f"[parity] worst relative final-cost diff {worst:.3g}")
+
+        lat = sorted(x for x in lat if x is not None)
+        p50 = lat[len(lat) // 2] if lat else None
+        p99 = lat[min(len(lat) - 1, int(round(0.99 * (len(lat) - 1))))] \
+            if lat else None
+
+        rec = metric_record(
+            "serving_batched_qps",
+            round(qps_batch, 4),
+            "problems/s",
+            n_problems=args.n_problems,
+            robots=args.robots,
+            sequential_qps=round(qps_seq, 4),
+            speedup_vs_sequential=round(qps_batch / qps_seq, 3),
+            latency_p50_s=round(p50, 4) if p50 is not None else None,
+            latency_p99_s=round(p99, 4) if p99 is not None else None,
+            parity_worst_rel=float(f"{worst:.3g}"),
+            cache_compiles=cache["compiles"],
+            cache_hits=cache["hits"],
+            max_batch=args.max_batch,
+            quantum=args.quantum,
+        )
+        if run is not None:
+            # The bench record rides the run's event stream too, so the
+            # report CLI and the regression gate see it alongside the
+            # per-tenant serving SLOs.
+            run.metric(rec["metric"], rec["value"], rec.get("unit"),
+                       phase="bench",
+                       **{k: v for k, v in rec.items()
+                          if k not in ("metric", "value", "unit")})
+    finally:
+        if scope:
+            scope.__exit__(None, None, None)
+    print(json.dumps(rec), flush=True)
+
+    if args.telemetry:
+        from dpgo_tpu.obs.report import render_report
+
+        log(render_report(args.telemetry))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

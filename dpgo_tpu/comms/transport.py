@@ -59,6 +59,12 @@ class Transport:
     archive, kept so a new robot can keep speaking v1 to an old bus).
     Receives always auto-detect the format off the payload magic, so
     mixed-version fleets interoperate.
+
+    ``max_frame_bytes`` bounds frames in BOTH directions (default 64 MiB):
+    an outgoing frame over the cap, or an incoming length header claiming
+    more, raises ``ProtocolError`` before any buffer is sized from it.
+    The serving front-end threads its ``--max-frame-mb`` flag through
+    here, so one knob governs problem-upload and result-download sizing.
     """
 
     def __init__(self, src="", dst="",
@@ -68,6 +74,9 @@ class Transport:
         self.src = src
         self.dst = dst
         self.injector = injector
+        if int(max_frame_bytes) <= 0:
+            raise ValueError(
+                f"max_frame_bytes must be positive, got {max_frame_bytes}")
         self.max_frame_bytes = int(max_frame_bytes)
         self.wire_format = wire_format
         run = obs.get_run()

@@ -1193,6 +1193,27 @@ def _global_init_jit(edges_g: EdgeSet, graph: MultiAgentGraph,
     return scatter_to_agents(X0g, graph)
 
 
+def lifted_init(edges_g: EdgeSet, graph: MultiAgentGraph, meta: GraphMeta,
+                n_total: int, init: str = "chordal") -> jax.Array:
+    """Centralized lifted init evaluated directly on a (possibly padded)
+    global edge set, scattered to agents.
+
+    The serving plane (``dpgo_tpu.serve``) initializes on the *padded*
+    bucket problem, so one compiled init program serves every problem in a
+    shape bucket instead of one per raw problem size; masked padding edges
+    contribute nothing to the chordal least squares, and padded per-agent
+    rows resolve to global pose 0's block through the padded
+    ``global_index`` (a valid Stiefel point), exactly as short agents
+    already do in unpadded graphs."""
+    if init == "chordal":
+        fn = chordal.chordal_initialization
+    elif init == "odometry":
+        fn = chordal.odometry_from_edges
+    else:
+        raise ValueError(f"unknown centralized init policy {init!r}")
+    return _global_init_jit(edges_g, graph, meta, n_total, fn)
+
+
 def centralized_chordal_init(part: Partition, meta: GraphMeta, graph: MultiAgentGraph,
                              dtype=jnp.float32) -> jax.Array:
     """Centralized chordal init, lifted and scattered to agents — the demo
@@ -1202,8 +1223,8 @@ def centralized_chordal_init(part: Partition, meta: GraphMeta, graph: MultiAgent
     thousands of individual device ops — ~105 s on the tunneled TPU for
     ais2klinik vs ~12 s compiled (and ~0 steady-state)."""
     edges_g = edge_set_from_measurements(part.meas_global, dtype=dtype)
-    return _global_init_jit(edges_g, graph, meta, part.meas_global.num_poses,
-                            chordal.chordal_initialization)
+    return lifted_init(edges_g, graph, meta, part.meas_global.num_poses,
+                       "chordal")
 
 
 def centralized_odometry_init(part: Partition, meta: GraphMeta,
@@ -1225,8 +1246,8 @@ def centralized_odometry_init(part: Partition, meta: GraphMeta,
     tight odometry (sphere2500-like); prefer chordal +
     ``solve_rbcd_robust_iterated`` when drift dominates."""
     edges_g = edge_set_from_measurements(part.meas_global, dtype=dtype)
-    return _global_init_jit(edges_g, graph, meta, part.meas_global.num_poses,
-                            chordal.odometry_from_edges)
+    return lifted_init(edges_g, graph, meta, part.meas_global.num_poses,
+                       "odometry")
 
 
 def lifting_matrix(meta: GraphMeta, dtype=jnp.float32) -> jax.Array:
@@ -1647,6 +1668,92 @@ def initial_state_for(init: str, part: Partition, meta: GraphMeta,
     raise ValueError(f"unknown init policy {init!r}")
 
 
+@dataclasses.dataclass(frozen=True)
+class PreparedProblem:
+    """A built, dispatch-ready problem — the schedulable unit of the
+    serving plane (``dpgo_tpu.serve``).
+
+    Splits ``solve_rbcd`` into its two halves: *problem build* (partition,
+    padded per-agent graph/EdgeSet, metadata, initial lifted state) and
+    *solve dispatch* (``dispatch_prepared`` -> ``run_rbcd``).  A prepared
+    problem is reusable: it can be dispatched more than once (e.g. with
+    different termination settings), padded to a shape bucket and stacked
+    with compatible problems for a batched ``vmap`` solve, or held in a
+    queue awaiting device capacity — none of which re-runs the host-side
+    graph construction.
+    """
+
+    part: Partition
+    graph: MultiAgentGraph
+    meta: GraphMeta
+    params: AgentParams
+    dtype: object
+    X0: jax.Array | None = None
+
+    @property
+    def n_total(self) -> int:
+        return self.part.meas_global.num_poses
+
+    @property
+    def num_meas(self) -> int:
+        return len(self.part.meas_global)
+
+
+def prepare_problem(
+    meas: Measurements,
+    num_robots: int,
+    params: AgentParams | None = None,
+    dtype=jnp.float64,
+    part: Partition | None = None,
+    init: str | None = "chordal",
+    pallas_sel: bool | None = None,
+) -> PreparedProblem:
+    """Problem build: partition, per-agent graph assembly, and (unless
+    ``init=None``) the initial lifted state.
+
+    ``init=None`` defers initialization — the serving plane pads the
+    problem to its shape bucket first and initializes on the padded
+    problem, so the compiled init program is shared across the bucket."""
+    params = params or AgentParams(d=meas.d, r=5, num_robots=num_robots)
+    part = part or partition_contiguous(meas, num_robots)
+    graph, meta = build_graph(part, params.r, dtype, pallas_sel=pallas_sel,
+                              sel_mode=resolved_sel_mode(params))
+    X0 = initial_state_for(init, part, meta, graph, params, dtype) \
+        if init is not None else None
+    return PreparedProblem(part=part, graph=graph, meta=meta, params=params,
+                           dtype=dtype, X0=X0)
+
+
+def dispatch_prepared(
+    prob: PreparedProblem,
+    max_iters: int | None = None,
+    grad_norm_tol: float = 0.1,
+    eval_every: int = 1,
+    state: RBCDState | None = None,
+) -> RBCDResult:
+    """Solve dispatch for a prepared problem: build the step closures and
+    run the shared driver loop (``run_rbcd``).  ``state`` overrides the
+    fresh ``init_state`` — e.g. to resume from a snapshot."""
+    params = prob.params
+    max_iters = params.max_num_iters if max_iters is None else max_iters
+    if state is None:
+        if prob.X0 is None:
+            raise ValueError(
+                "prepared problem has no initial state — prepare with "
+                "init=... or pass state=")
+        state = init_state(prob.graph, prob.meta, prob.X0, params=params)
+    graph, meta = prob.graph, prob.meta
+    step = lambda s, uw, rs: rbcd_step(s, graph, meta, params,
+                                       update_weights=uw, restart=rs)
+    multi = lambda s, k: rbcd_steps(s, graph, k, meta, params)
+    seg = lambda s, k, uw, rs: rbcd_segment(s, graph, k, meta, params,
+                                            first_update_weights=uw,
+                                            first_restart=rs)
+    return run_rbcd(state, graph, meta, step, prob.part, max_iters,
+                    grad_norm_tol, eval_every, prob.dtype, params=params,
+                    multi_step=multi, segment=seg)
+
+
 def solve_rbcd(
     meas: Measurements,
     num_robots: int,
@@ -1658,24 +1765,13 @@ def solve_rbcd(
     part: Partition | None = None,
     init: str = "chordal",
 ) -> RBCDResult:
-    """Distributed solve on one device with centralized monitoring."""
-    params = params or AgentParams(d=meas.d, r=5, num_robots=num_robots)
-    max_iters = params.max_num_iters if max_iters is None else max_iters
-
-    part = part or partition_contiguous(meas, num_robots)
-    graph, meta = build_graph(part, params.r, dtype,
-                              sel_mode=resolved_sel_mode(params))
-    X0 = initial_state_for(init, part, meta, graph, params, dtype)
-    state = init_state(graph, meta, X0, params=params)
-    step = lambda s, uw, rs: rbcd_step(s, graph, meta, params,
-                                       update_weights=uw, restart=rs)
-    multi = lambda s, k: rbcd_steps(s, graph, k, meta, params)
-    seg = lambda s, k, uw, rs: rbcd_segment(s, graph, k, meta, params,
-                                            first_update_weights=uw,
-                                            first_restart=rs)
-    return run_rbcd(state, graph, meta, step, part, max_iters,
-                    grad_norm_tol, eval_every, dtype, params=params,
-                    multi_step=multi, segment=seg)
+    """Distributed solve on one device with centralized monitoring —
+    ``prepare_problem`` + ``dispatch_prepared`` in one call."""
+    prob = prepare_problem(meas, num_robots, params=params, dtype=dtype,
+                           part=part, init=init)
+    return dispatch_prepared(prob, max_iters=max_iters,
+                             grad_norm_tol=grad_norm_tol,
+                             eval_every=eval_every)
 
 
 def solve_rbcd_robust_iterated(

@@ -117,6 +117,98 @@ def _health_lines(events: list[dict]) -> list[str]:
     return lines
 
 
+def serving_stats(events: list[dict]) -> dict | None:
+    """Per-tenant serving SLOs from the serve plane's event schema
+    (``serve_request`` / ``serve_batch`` / ``serve_shed`` — the same
+    records ``bench_serving.py`` writes), shared by the text report, the
+    ``--json`` payload, and the bench's assertions.
+
+    Per tenant: request count, QPS over the tenant's request window,
+    queue-wait p50, and solve-latency p50/p99 (exact percentiles from the
+    per-request events, not histogram-bucket approximations).  Fleet-wide:
+    batch count, mean batch occupancy/size, and shed tallies by tenant and
+    reason."""
+    reqs = [ev for ev in events if ev.get("event") == "serve_request"]
+    batches = [ev for ev in events if ev.get("event") == "serve_batch"]
+    sheds = [ev for ev in events if ev.get("event") == "serve_shed"]
+    if not (reqs or batches or sheds):
+        return None
+
+    def _pct(vals, q):
+        if not vals:
+            return None
+        vals = sorted(vals)
+        k = min(len(vals) - 1, max(0, int(round(q / 100.0 * (len(vals) - 1)))))
+        return vals[k]
+
+    tenants: dict = {}
+    for ev in reqs:
+        tenants.setdefault(ev.get("tenant", "?"), []).append(ev)
+    out_t = {}
+    for tenant, evs in sorted(tenants.items()):
+        lats = [ev["latency_s"] for ev in evs
+                if isinstance(ev.get("latency_s"), (int, float))]
+        waits = [ev["queue_wait_s"] for ev in evs
+                 if isinstance(ev.get("queue_wait_s"), (int, float))]
+        # Completion events of one batch land within microseconds of each
+        # other, so the serving window runs from the first request's
+        # SUBMIT (its completion stamp minus its latency) to the last
+        # completion.
+        first_submit = evs[0]["t_mono"] - (evs[0].get("latency_s") or 0.0)
+        window = evs[-1]["t_mono"] - first_submit
+        out_t[tenant] = {
+            "requests": len(evs),
+            "qps": len(evs) / window if window > 0 else None,
+            "queue_wait_p50_s": _pct(waits, 50),
+            "latency_p50_s": _pct(lats, 50),
+            "latency_p99_s": _pct(lats, 99),
+        }
+    occ = [ev["occupancy"] for ev in batches
+           if isinstance(ev.get("occupancy"), (int, float))]
+    sizes = [ev["size"] for ev in batches
+             if isinstance(ev.get("size"), (int, float))]
+    shed_tally = dict(_TallyCounter(
+        (ev.get("tenant", "?"), ev.get("reason", "?")) for ev in sheds))
+    return {
+        "tenants": out_t,
+        "batches": {
+            "count": len(batches),
+            "mean_occupancy": sum(occ) / len(occ) if occ else None,
+            "mean_size": sum(sizes) / len(sizes) if sizes else None,
+        },
+        "shed": [{"tenant": t, "reason": r, "count": n}
+                 for (t, r), n in sorted(shed_tally.items())],
+    }
+
+
+def _serving_lines(stats: dict | None) -> list[str]:
+    """Render the serving section (serve-plane events present)."""
+    if not stats:
+        return []
+    lines = ["serving:"]
+    for tenant, row in stats["tenants"].items():
+        parts = [f"{row['requests']} requests"]
+        if row["qps"] is not None:
+            parts.append(f"{row['qps']:.2f} req/s")
+        if row["queue_wait_p50_s"] is not None:
+            parts.append(f"queue wait p50 {row['queue_wait_p50_s'] * 1e3:.1f}ms")
+        if row["latency_p50_s"] is not None:
+            parts.append(f"latency p50 {row['latency_p50_s']:.3f}s"
+                         + (f" / p99 {row['latency_p99_s']:.3f}s"
+                            if row["latency_p99_s"] is not None else ""))
+        lines.append(f"  tenant {tenant}: " + ", ".join(parts))
+    b = stats["batches"]
+    if b["count"]:
+        lines.append(
+            f"  batches: {b['count']} dispatched, mean occupancy "
+            f"{b['mean_occupancy'] * 100:.0f}%, mean size "
+            f"{b['mean_size']:.1f}")
+    for s in stats["shed"]:
+        lines.append(f"  shed: tenant {s['tenant']} x{s['count']} "
+                     f"({s['reason']})")
+    return lines
+
+
 def _fleet_lines(stats: dict | None) -> list[str]:
     """Render the fleet-timeline section (tracing spans present)."""
     if not stats:
@@ -265,6 +357,7 @@ def render_report(run_dir: str) -> str:
                     f"/ {row.get('count', 0)} "
                     f"({row.get('avg_ms', 0.0):.2f} ms avg)")
 
+        lines.extend(_serving_lines(serving_stats(events)))
         lines.extend(_health_lines(events))
         lines.extend(_fleet_lines(fleet_timeline_stats(events)))
     else:
@@ -322,6 +415,7 @@ def report_data(run_dir: str) -> dict:
                             if ev.get("event") in ("anomaly",
                                                    "peer_anomaly",
                                                    "blackbox_dump")]
+        out["serving"] = serving_stats(events)
         out["fleet_timeline"] = fleet_timeline_stats(events)
     m_path = os.path.join(run_dir, METRICS_FILE)
     if os.path.exists(m_path):

@@ -1,0 +1,51 @@
+"""PGO-as-a-service: the multi-tenant batched solve front-end.
+
+The solver core is a library: one caller, one problem, one cold solve.
+This package is the serving plane over it — the piece that makes
+distributed certifiably-correct PGO (Tian et al., T-RO 2021) deployable
+as a *shared backend* rather than a per-robot binary:
+
+* ``bucketing`` — pads prepared problems (``models.rbcd.PreparedProblem``)
+  into shape buckets so compatible requests stack into one batched array
+  program.
+* ``cache`` — the compiled-executable cache, keyed by the canonical config
+  fingerprint (the same shape/dtype/schedule field set
+  ``TelemetryRun.set_fingerprint`` records for the regression gate).
+* ``runner`` — the batched dispatch: many problems per device call via
+  ``vmap`` over the RBCD segment, one compiled program per bucket.
+* ``server`` — the request plane: bounded queue, per-tenant quotas,
+  deadline-aware shedding, warm pools, and per-tenant SLO metrics through
+  ``dpgo_tpu.obs``.
+* ``frontend`` — the TCP front-end over ``comms.transport.TcpTransport``
+  (length-prefixed packed frames; g2o problem upload, result download).
+
+Quickstart (in-process)::
+
+    from dpgo_tpu.serve import SolveServer, SolveRequest
+    with SolveServer(max_batch=8) as srv:
+        tickets = [srv.submit(SolveRequest(meas, num_robots=2))
+                   for meas in problems]
+        results = [t.result() for t in tickets]
+
+TCP: ``python -m dpgo_tpu.serve --port 0`` then
+``serve.frontend.solve_g2o(host, port, g2o_bytes, num_robots=2)``.
+"""
+
+from .bucketing import BucketShape, bucket_shape_of, pad_problem
+from .cache import ExecutableCache, problem_fingerprint
+from .runner import run_bucket
+from .server import (OverCapacityError, SolveRequest, SolveServer,
+                     SolveTicket)
+
+__all__ = [
+    "BucketShape",
+    "bucket_shape_of",
+    "pad_problem",
+    "ExecutableCache",
+    "problem_fingerprint",
+    "run_bucket",
+    "OverCapacityError",
+    "SolveRequest",
+    "SolveServer",
+    "SolveTicket",
+]

@@ -1,0 +1,80 @@
+"""Serving CLI: ``python -m dpgo_tpu.serve`` starts a TCP solve server.
+
+::
+
+    python -m dpgo_tpu.serve --port 9100 --max-batch 8 --max-frame-mb 64 \
+        --telemetry /tmp/serve_run
+
+Prints ``listening on HOST:PORT`` once bound (``--port 0`` = OS-assigned,
+so scripts can parse the resolved port), serves until interrupted, and —
+with ``--telemetry`` — writes a run directory the report CLI renders with
+the per-tenant "serving" SLO section::
+
+    python -m dpgo_tpu.obs.report /tmp/serve_run
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from .. import obs
+from .frontend import ServeFrontend
+from .server import SolveServer
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m dpgo_tpu.serve",
+                                 description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="TCP port (0 = OS-assigned, printed once bound)")
+    ap.add_argument("--max-frame-mb", type=float, default=64.0,
+                    help="transport frame-size cap in MiB (both directions; "
+                         "oversize frames raise a clean ProtocolError)")
+    ap.add_argument("--max-batch", type=int, default=8,
+                    help="max problems per batched device dispatch")
+    ap.add_argument("--max-queue", type=int, default=64,
+                    help="bounded admission queue length")
+    ap.add_argument("--batch-window-ms", type=float, default=5.0,
+                    help="coalescing window before forming a batch")
+    ap.add_argument("--quantum", type=int, default=32,
+                    help="shape-bucket rounding quantum (pose/edge counts)")
+    ap.add_argument("--tenant-quota", type=int, default=None,
+                    help="max in-flight requests per tenant")
+    ap.add_argument("--wire", choices=("packed", "npz"), default="packed",
+                    help="outgoing wire format (receives auto-detect)")
+    ap.add_argument("--telemetry", metavar="DIR", default=None,
+                    help="write a telemetry run (SLO metrics/events) here")
+    args = ap.parse_args(argv)
+
+    scope = obs.run_scope(args.telemetry) if args.telemetry else None
+    run = scope.__enter__() if scope else None
+    try:
+        with SolveServer(max_batch=args.max_batch, max_queue=args.max_queue,
+                         batch_window_s=args.batch_window_ms / 1e3,
+                         tenant_quota=args.tenant_quota,
+                         quantum=args.quantum) as server:
+            with ServeFrontend(
+                    server, host=args.host, port=args.port,
+                    max_frame_bytes=int(args.max_frame_mb * 2 ** 20),
+                    wire_format=args.wire) as fe:
+                print(f"listening on {fe.host}:{fe.port}", flush=True)
+                if run is not None:
+                    run.event("serve_listen", phase="serve", host=fe.host,
+                              port=fe.port,
+                              max_frame_bytes=fe.max_frame_bytes)
+                try:
+                    while True:
+                        time.sleep(1.0)
+                except KeyboardInterrupt:
+                    print("shutting down", flush=True)
+    finally:
+        if scope:
+            scope.__exit__(None, None, None)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,195 @@
+"""Shape bucketing: pad prepared problems so compatible requests stack.
+
+A batched solve (``runner.run_bucket``) vmaps one compiled RBCD program
+over a leading problem axis, which requires every problem in the batch to
+share its padded array shapes exactly.  Requests rarely arrive
+shape-identical, so each prepared problem is *padded up* to a bucket
+shape — every padded dimension rounded to a quantum — and problems land
+in the same bucket iff all rounded dimensions (and the solver config)
+agree.
+
+Padding is pure masking, not new math: padded poses carry
+``pose_mask = 0`` and no edges, padded edges carry ``mask = 0``, so every
+kernel the solver runs already ignores them — the same mechanism that
+handles agents shorter than ``n_max`` in any unpadded graph.  The one
+subtlety is index remapping: edge endpoints in the neighbor-slot range
+``[n_max, n_max + s_max)`` shift with the local-pose range they sit
+behind, and ELL incidence slots in the ``j``-endpoint half
+``[e_max, 2 e_max)`` shift with the edge count.
+
+The Pallas edge-tile fields are deliberately dropped (the serving plane
+builds graphs with ``pallas_sel=False``): tile layouts bake ``n + s``
+into their one-hot pad index, and a ``vmap`` over the kernel call is not
+part of the supported surface.  Batched serving runs the ELL/dense
+formulations; the single-problem kernel path is unaffected.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Schedule
+from ..models import rbcd
+from ..types import EdgeSet, edge_set_from_measurements
+
+
+class BucketShape(NamedTuple):
+    """Padded array dimensions of one shape bucket (all ints)."""
+
+    n_max: int
+    e_max: int
+    s_max: int
+    p_max: int
+    k_inc: int
+    n_total: int
+    num_meas: int
+
+
+@dataclasses.dataclass(frozen=True)
+class PaddedProblem:
+    """A prepared problem padded to its bucket shape, ready to stack."""
+
+    prob: rbcd.PreparedProblem  # the original (unpadded) problem
+    graph: rbcd.MultiAgentGraph
+    meta: rbcd.GraphMeta
+    edges_g: EdgeSet  # padded global edge set (metrics + init)
+    X0: jax.Array
+    shape: BucketShape
+
+
+def _round_up(x: int, q: int) -> int:
+    return max(q, -(-int(x) // q) * q)
+
+
+def bucket_shape_of(prob: rbcd.PreparedProblem, quantum: int = 32,
+                    small_quantum: int = 8) -> BucketShape:
+    """The bucket this problem pads into: large dimensions (pose/edge
+    counts) round to ``quantum``, small per-agent tables (neighbor slots,
+    public poses, ELL degree) to ``small_quantum``.  Problems whose raw
+    sizes differ by less than a quantum coalesce; the config fields that
+    must also agree live in the cache key (``cache.problem_fingerprint``),
+    not here."""
+    m = prob.meta
+    return BucketShape(
+        n_max=_round_up(m.n_max, quantum),
+        e_max=_round_up(m.e_max, quantum),
+        s_max=_round_up(m.s_max, small_quantum),
+        p_max=_round_up(m.p_max, small_quantum),
+        k_inc=_round_up(prob.graph.inc_slot.shape[-1], small_quantum),
+        n_total=_round_up(prob.n_total, quantum),
+        num_meas=_round_up(prob.num_meas, quantum),
+    )
+
+
+def padded_meta(prob: rbcd.PreparedProblem, shape: BucketShape) -> rbcd.GraphMeta:
+    """GraphMeta at the bucket shape.  ``num_colors`` is normalized to 1
+    for every schedule but COLORED (the only consumer), so two problems
+    whose greedy colorings happen to differ still share a bucket."""
+    m = prob.meta
+    colors = m.num_colors if prob.params.schedule == Schedule.COLORED else 1
+    return rbcd.GraphMeta(
+        num_robots=m.num_robots, n_max=shape.n_max, e_max=shape.e_max,
+        s_max=shape.s_max, p_max=shape.p_max, d=m.d, rank=m.rank,
+        num_colors=colors)
+
+
+def _pad_tail(a: np.ndarray, axis: int, target: int, fill=0) -> np.ndarray:
+    grow = target - a.shape[axis]
+    if grow == 0:
+        return a
+    width = [(0, 0)] * a.ndim
+    width[axis] = (0, grow)
+    return np.pad(a, width, constant_values=fill)
+
+
+def pad_problem(prob: rbcd.PreparedProblem, shape: BucketShape,
+                init: str = "chordal") -> PaddedProblem:
+    """Pad a prepared problem to ``shape`` and (if it carries no ``X0``)
+    initialize it on the *padded* problem, so the compiled init program is
+    shared bucket-wide."""
+    g, m = prob.graph, prob.meta
+    dn = shape.n_max - m.n_max
+    de = shape.e_max - m.e_max
+    ds = shape.s_max - m.s_max
+    dp = shape.p_max - m.p_max
+    k_old = g.inc_slot.shape[-1]
+    dk = shape.k_inc - k_old
+    if min(dn, de, ds, dp, dk, shape.n_total - prob.n_total,
+           shape.num_meas - prob.num_meas) < 0:
+        raise ValueError(f"bucket shape {shape} smaller than problem "
+                         f"({m}, K={k_old}, n_total={prob.n_total}, "
+                         f"m={prob.num_meas})")
+    A, d = m.num_robots, m.d
+    fdt = np.asarray(g.edges.R).dtype
+
+    e = g.edges
+    # Endpoint indices: the neighbor-slot range moves with n_max.
+    ei = np.asarray(e.i)
+    ej = np.asarray(e.j)
+    ei = np.where(ei >= m.n_max, ei + dn, ei)
+    ej = np.where(ej >= m.n_max, ej + dn, ej)
+    eye = np.broadcast_to(np.eye(d, dtype=fdt), (A, de, d, d))
+    edges = EdgeSet(
+        i=jnp.asarray(_pad_tail(ei, 1, shape.e_max)),
+        j=jnp.asarray(_pad_tail(ej, 1, shape.e_max)),
+        R=jnp.asarray(np.concatenate([np.asarray(e.R), eye], axis=1)),
+        t=jnp.asarray(_pad_tail(np.asarray(e.t), 1, shape.e_max)),
+        kappa=jnp.asarray(_pad_tail(np.asarray(e.kappa), 1, shape.e_max)),
+        tau=jnp.asarray(_pad_tail(np.asarray(e.tau), 1, shape.e_max)),
+        weight=jnp.asarray(
+            _pad_tail(np.asarray(e.weight), 1, shape.e_max, fill=1.0)),
+        mask=jnp.asarray(_pad_tail(np.asarray(e.mask), 1, shape.e_max)),
+        is_lc=jnp.asarray(_pad_tail(np.asarray(e.is_lc), 1, shape.e_max)),
+        fixed_weight=jnp.asarray(
+            _pad_tail(np.asarray(e.fixed_weight), 1, shape.e_max)),
+    )
+
+    # ELL incidence: the j-endpoint half [e_max, 2 e_max) moves with e_max.
+    inc = np.asarray(g.inc_slot)
+    inc = np.where(inc >= m.e_max, inc + de, inc)
+    inc = _pad_tail(_pad_tail(inc, 2, shape.k_inc), 1, shape.n_max)
+    inc_mask = _pad_tail(_pad_tail(np.asarray(g.inc_mask), 2, shape.k_inc),
+                         1, shape.n_max)
+
+    graph = rbcd.MultiAgentGraph(
+        edges=edges,
+        meas_id=jnp.asarray(_pad_tail(np.asarray(g.meas_id), 1, shape.e_max)),
+        n=g.n,
+        pose_mask=jnp.asarray(
+            _pad_tail(np.asarray(g.pose_mask), 1, shape.n_max)),
+        pub_idx=jnp.asarray(_pad_tail(np.asarray(g.pub_idx), 1, shape.p_max)),
+        pub_mask=jnp.asarray(
+            _pad_tail(np.asarray(g.pub_mask), 1, shape.p_max)),
+        nbr_robot=jnp.asarray(
+            _pad_tail(np.asarray(g.nbr_robot), 1, shape.s_max)),
+        nbr_pub=jnp.asarray(_pad_tail(np.asarray(g.nbr_pub), 1, shape.s_max)),
+        nbr_mask=jnp.asarray(
+            _pad_tail(np.asarray(g.nbr_mask), 1, shape.s_max)),
+        # Padded rows point at global pose 0 — masked out of the global
+        # gather, and resolving to a valid Stiefel block on scatter (the
+        # same convention build_graph uses for agents shorter than n_max).
+        global_index=jnp.asarray(
+            _pad_tail(np.asarray(g.global_index), 1, shape.n_max)),
+        inc_slot=jnp.asarray(inc),
+        inc_mask=jnp.asarray(inc_mask),
+        color=g.color,
+        eidx_i=None, eidx_j=None, rot_t=None, trn_t=None,
+    )
+    meta = padded_meta(prob, shape)
+    edges_g = edge_set_from_measurements(
+        prob.part.meas_global, pad_to=shape.num_meas, dtype=prob.dtype)
+
+    if prob.X0 is not None:
+        X0 = np.asarray(prob.X0)
+        pad_rows = np.broadcast_to(
+            X0[:, :1], (A, dn) + X0.shape[2:])
+        X0 = jnp.asarray(np.concatenate([X0, pad_rows], axis=1))
+    else:
+        X0 = rbcd.lifted_init(edges_g, graph, meta, shape.n_total, init)
+    return PaddedProblem(prob=prob, graph=graph, meta=meta,
+                         edges_g=edges_g, X0=X0, shape=shape)

@@ -1,0 +1,107 @@
+"""The compiled-executable cache, keyed by config fingerprint.
+
+Batched solve programs are expensive to build (XLA compilation of a
+vmapped fused RBCD segment runs seconds on CPU, tens of seconds for large
+buckets on TPU); the whole point of bucketing is that identical request
+shapes re-dispatch the same executable.  The cache key is the canonical
+config fingerprint — deliberately the same shape/dtype/schedule field set
+``run_rbcd`` registers via ``TelemetryRun.set_fingerprint`` for the
+regression gate (``obs/run.py``), because that canonicalization was
+designed to capture exactly what makes two solves the "same program":
+pose/edge/slot counts, rank, d, dtype, schedule, robust cost, selection
+mode.  Two requests whose fingerprints agree reuse one executable; a
+differing rank, dtype, or schedule misses and compiles its own.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+
+from ..models.rbcd import GraphMeta, resolved_sel_mode
+from ..obs.events import _jsonable
+
+
+def problem_fingerprint(meta: GraphMeta, params, dtype, shape=None,
+                        batch: int | None = None,
+                        kind: str | None = None) -> dict:
+    """Canonical (JSON-able) fingerprint of a batched solve program.
+
+    Field names follow ``run_rbcd``'s ``set_fingerprint`` record where the
+    concepts coincide (num_robots/rank/d/dtype/schedule/robust_cost/
+    sel_mode), extended with the padded bucket shape, the remaining solver
+    configuration (``params`` is a frozen dataclass — its repr is a stable
+    canonical form), the batch width, and the program kind
+    (segment/metrics/finalize)."""
+    fp = {
+        "solver": "serve_batch",
+        "num_robots": meta.num_robots,
+        "rank": meta.rank,
+        "d": meta.d,
+        "n_max": meta.n_max,
+        "e_max": meta.e_max,
+        "s_max": meta.s_max,
+        "p_max": meta.p_max,
+        "num_colors": meta.num_colors,
+        "dtype": str(np.dtype(dtype)),
+        "schedule": params.schedule.value,
+        "robust_cost": params.robust.cost_type.value,
+        "sel_mode": resolved_sel_mode(params),
+        "params": repr(params),
+    }
+    if shape is not None:
+        fp["bucket_shape"] = tuple(shape)
+    if batch is not None:
+        fp["batch"] = int(batch)
+    if kind is not None:
+        fp["kind"] = str(kind)
+    return {k: _jsonable(v) for k, v in fp.items()}
+
+
+def fingerprint_key(fp: dict) -> str:
+    """Stable hashable form of a fingerprint dict."""
+    return json.dumps(fp, sort_keys=True)
+
+
+class ExecutableCache:
+    """Fingerprint-keyed store of built executables with hit/compile
+    accounting.
+
+    ``get`` returns the cached executable for ``fp`` or invokes
+    ``builder()`` exactly once and caches its result.  ``compiles`` counts
+    builder invocations — the observable the bucketing tests pin: a stream
+    of identical-fingerprint requests must leave it flat."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[str, object] = {}
+        self.compiles = 0
+        self.hits = 0
+
+    def get(self, fp: dict, builder):
+        key = fingerprint_key(fp)
+        with self._lock:
+            if key in self._entries:
+                self.hits += 1
+                return self._entries[key]
+        # Build outside the lock (builders may themselves trigger long XLA
+        # compiles); a racing duplicate build is wasted work, not an error.
+        built = builder()
+        with self._lock:
+            if key not in self._entries:
+                self._entries[key] = built
+                self.compiles += 1
+            else:
+                self.hits += 1
+            return self._entries[key]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "compiles": self.compiles,
+                    "hits": self.hits}

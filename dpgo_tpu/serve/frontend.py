@@ -1,0 +1,213 @@
+"""TCP front-end: g2o problem upload / result download over the packed wire.
+
+Reuses the deployment plane's transport stack unchanged: length-prefixed
+frames (``comms.transport.TcpTransport``) carrying the v2 packed columnar
+payload (``comms.protocol``), with the frame-size cap
+constructor-configurable end to end (``--max-frame-mb`` on the CLI).
+A request frame is an array dict — the g2o file bytes as a ``uint8``
+array plus scalar config entries — and the reply carries the rounded
+trajectory, cost/grad-norm histories, and termination info (or a
+structured error; shed requests come back with ``shed=1`` and the
+admission ``reason`` so clients can back off).
+
+One thread per connection, sequential requests per connection; the actual
+queueing/batching discipline lives in ``server.SolveServer``, which this
+module only adapts to the wire.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..comms.protocol import DEFAULT_MAX_FRAME_BYTES, ProtocolError
+from ..comms.transport import (TcpTransport, TransportClosed,
+                               TransportTimeout, connect_tcp, listen_tcp)
+from ..config import AgentParams
+from ..utils.g2o import read_g2o
+from .server import OverCapacityError, SolveRequest, SolveServer
+
+
+def _pack_str(s: str) -> np.ndarray:
+    return np.frombuffer(s.encode("utf-8"), np.uint8)
+
+
+def _unpack_str(a) -> str:
+    return bytes(np.asarray(a, np.uint8)).decode("utf-8")
+
+
+def handle_request(server: SolveServer, frame: dict) -> dict:
+    """One request frame -> one reply frame (in-process; the wire layer
+    above is a pass-through)."""
+    op = _unpack_str(frame["op"]) if "op" in frame else "solve"
+    if op == "ping":
+        return {"ok": np.int8(1)}
+    if op != "solve":
+        return {"ok": np.int8(0), "error": _pack_str(f"unknown op {op!r}")}
+    try:
+        meas = read_g2o(bytes(np.asarray(frame["g2o"], np.uint8)))
+        num_robots = int(np.asarray(frame["num_robots"]))
+        rank = int(np.asarray(frame["rank"])) if "rank" in frame else 5
+        req = SolveRequest(
+            meas=meas,
+            num_robots=num_robots,
+            params=AgentParams(d=meas.d, r=rank, num_robots=num_robots),
+            tenant=_unpack_str(frame["tenant"]) if "tenant" in frame
+            else "default",
+            deadline_s=float(np.asarray(frame["deadline_s"]))
+            if "deadline_s" in frame else None,
+            max_iters=int(np.asarray(frame["max_iters"]))
+            if "max_iters" in frame else None,
+            grad_norm_tol=float(np.asarray(frame["grad_norm_tol"]))
+            if "grad_norm_tol" in frame else 0.1,
+            eval_every=int(np.asarray(frame["eval_every"]))
+            if "eval_every" in frame else 1,
+        )
+        res = server.submit(req).result()
+    except OverCapacityError as e:
+        return {"ok": np.int8(0), "shed": np.int8(1),
+                "reason": _pack_str(e.reason), "error": _pack_str(str(e))}
+    except Exception as e:  # bad payload, solver failure: structured reply
+        return {"ok": np.int8(0), "error": _pack_str(f"{type(e).__name__}: {e}")}
+    return {
+        "ok": np.int8(1),
+        "T": np.asarray(res.T),
+        "cost_history": np.asarray(res.cost_history, np.float64),
+        "grad_norm_history": np.asarray(res.grad_norm_history, np.float64),
+        "iterations": np.int32(res.iterations),
+        "terminated_by": _pack_str(res.terminated_by),
+    }
+
+
+class ServeFrontend:
+    """TCP listener bound to a ``SolveServer``.  Binds on construction
+    (``port=0`` = OS-assigned; read the resolved ``.port``), accepts on a
+    daemon thread, one handler thread per connection."""
+
+    def __init__(self, server: SolveServer, host: str = "127.0.0.1",
+                 port: int = 0,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                 wire_format: str = "packed"):
+        self.server = server
+        self.max_frame_bytes = int(max_frame_bytes)
+        self.wire_format = wire_format
+        self._listener = listen_tcp(host, port)
+        self.host, self.port = self._listener.getsockname()[:2]
+        self._transports: list[TcpTransport] = []
+        self._lock = threading.Lock()
+        self._closed = False
+        self._accepter = threading.Thread(target=self._accept, daemon=True,
+                                          name="dpgo-serve-accept")
+        self._accepter.start()
+
+    def _accept(self) -> None:
+        while True:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            tr = TcpTransport(sock, src="serve-frontend",
+                              max_frame_bytes=self.max_frame_bytes,
+                              wire_format=self.wire_format)
+            with self._lock:
+                if self._closed:
+                    tr.close()
+                    return
+                self._transports.append(tr)
+            threading.Thread(target=self._serve_conn, args=(tr,),
+                             daemon=True).start()
+
+    def _serve_conn(self, tr: TcpTransport) -> None:
+        while True:
+            try:
+                frame = tr.recv()
+            except (TransportClosed, TransportTimeout):
+                return
+            except ProtocolError as e:
+                try:
+                    tr.send({"ok": np.int8(0),
+                             "error": _pack_str(f"protocol error: {e}")})
+                    continue
+                except (TransportClosed, ProtocolError):
+                    return
+            try:
+                tr.send(handle_request(self.server, frame))
+            except ProtocolError as e:
+                # Reply exceeds the frame cap: report instead of dying.
+                try:
+                    tr.send({"ok": np.int8(0),
+                             "error": _pack_str(f"reply too large: {e}")})
+                except (TransportClosed, ProtocolError):
+                    return
+            except TransportClosed:
+                return
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            transports = list(self._transports)
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for tr in transports:
+            tr.close()
+
+    def __enter__(self) -> "ServeFrontend":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def solve_g2o(host: str, port: int, g2o, num_robots: int,
+              tenant: str = "default", rank: int = 5,
+              max_iters: int | None = None, grad_norm_tol: float = 0.1,
+              eval_every: int = 1, deadline_s: float | None = None,
+              timeout: float | None = None,
+              max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+              wire_format: str = "packed") -> dict:
+    """Submit one g2o problem to a remote front-end and wait for the
+    result.  ``g2o`` is the file's bytes or a path.  Returns a dict with
+    ``ok`` plus either the result arrays (``T``, ``cost_history``,
+    ``grad_norm_history``, ``iterations``, ``terminated_by``) or the
+    structured error (``error``, ``shed``, ``reason``)."""
+    if isinstance(g2o, str):
+        with open(g2o, "rb") as fh:
+            g2o = fh.read()
+    frame = {
+        "op": _pack_str("solve"),
+        "g2o": np.frombuffer(g2o, np.uint8),
+        "num_robots": np.int32(num_robots),
+        "rank": np.int32(rank),
+        "tenant": _pack_str(tenant),
+        "grad_norm_tol": np.float64(grad_norm_tol),
+        "eval_every": np.int32(eval_every),
+    }
+    if max_iters is not None:
+        frame["max_iters"] = np.int32(max_iters)
+    if deadline_s is not None:
+        frame["deadline_s"] = np.float64(deadline_s)
+    sock = connect_tcp(host, port)
+    tr = TcpTransport(sock, src="serve-client",
+                      max_frame_bytes=max_frame_bytes,
+                      wire_format=wire_format)
+    try:
+        tr.send(frame)
+        reply = tr.recv(timeout=timeout)
+    finally:
+        tr.close()
+    out = {"ok": bool(int(np.asarray(reply["ok"])))}
+    if out["ok"]:
+        out["T"] = np.asarray(reply["T"])
+        out["cost_history"] = np.asarray(reply["cost_history"])
+        out["grad_norm_history"] = np.asarray(reply["grad_norm_history"])
+        out["iterations"] = int(np.asarray(reply["iterations"]))
+        out["terminated_by"] = _unpack_str(reply["terminated_by"])
+    else:
+        out["error"] = _unpack_str(reply.get("error", _pack_str("")))
+        out["shed"] = bool(int(np.asarray(reply.get("shed", 0))))
+        if "reason" in reply:
+            out["reason"] = _unpack_str(reply["reason"])
+    return out

@@ -1,0 +1,181 @@
+"""Batched dispatch: many problems per device call.
+
+``run_bucket`` stacks shape-identical padded problems (``bucketing``)
+along a leading batch axis and drives ONE compiled program per schedule
+segment — ``vmap`` of the fused RBCD segment (``models.rbcd._rbcd_segment``)
+over the problem axis — instead of one driver loop per problem.  The
+device amortizes dispatch and compilation across the batch; the math per
+problem is the single-problem ELL formulation unchanged (vmap is
+semantically per-example), so batched results match sequential solves
+within kernel tolerance.
+
+The batch axis is padded to the next power of two by replicating the last
+problem, so one executable per (bucket, pow2-width) serves every
+occupancy instead of one per exact batch size.
+
+Executables come from the caller's ``ExecutableCache`` keyed by the
+config fingerprint (``cache.problem_fingerprint``): segment, metrics, and
+finalize programs are each cached independently.
+
+Termination mirrors ``run_rbcd``: per problem, the centralized gradient
+norm against ``grad_norm_tol`` or all-agents consensus; the batch keeps
+stepping until every member has terminated (a converged member's extra
+rounds only polish its iterate — cost is monotone under the plain
+schedule), with each member's history truncated at its own termination
+eval.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import RobustCostType
+from ..models import rbcd
+from ..ops import manifold, quadratic
+from .bucketing import PaddedProblem
+from .cache import ExecutableCache, problem_fingerprint
+
+
+def _tree_stack(trees):
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _make_segment_exec(meta: rbcd.GraphMeta, params):
+    def seg(state_b, graph_b, k, uw, rs):
+        one = lambda s, g: rbcd._rbcd_segment(
+            s, g, k, meta, params, first_update_weights=uw, first_restart=rs)
+        return jax.vmap(one)(state_b, graph_b)
+
+    return jax.jit(seg, static_argnames=("uw", "rs"))
+
+
+def _make_metrics_exec(meta: rbcd.GraphMeta, n_total: int, num_meas: int):
+    def one(Xa, weights, ready, graph, eg):
+        Xg = rbcd.gather_to_global(Xa, graph, n_total)
+        egw = eg._replace(
+            weight=rbcd.global_weights(weights, graph, num_meas))
+        f = quadratic.cost(Xg, egw)
+        g = manifold.rgrad(Xg, quadratic.egrad(Xg, egw))
+        return jnp.stack(
+            [f, manifold.norm(g), jnp.all(ready).astype(f.dtype)])
+
+    return jax.jit(jax.vmap(one))
+
+
+def _make_finalize_exec(meta: rbcd.GraphMeta, n_total: int, num_meas: int):
+    def one(Xa, weights, graph):
+        Xg = rbcd.gather_to_global(Xa, graph, n_total)
+        T = rbcd.round_global(Xg, rbcd.lifting_matrix(meta, Xg.dtype))
+        return T, rbcd.global_weights(weights, graph, num_meas)
+
+    return jax.jit(jax.vmap(one))
+
+
+def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
+               max_iters: int | None = None, grad_norm_tol: float = 0.1,
+               eval_every: int = 1):
+    """Solve a list of same-bucket padded problems as one batched program.
+
+    Returns ``(results, info)``: per-problem ``RBCDResult`` (trajectories
+    and weights sliced back to the problem's real pose/measurement
+    counts), and a dict of batch statistics (rounds, evals, batch width,
+    occupancy) for the serving metrics."""
+    if not padded:
+        return [], {"rounds": 0, "evals": 0, "batch": 0, "occupancy": 0.0}
+    first = padded[0]
+    meta, params, dtype = first.meta, first.prob.params, first.prob.dtype
+    shape = first.shape
+    for p in padded[1:]:
+        if p.shape != shape or p.meta != meta or p.prob.params != params \
+                or p.prob.dtype != dtype:
+            raise ValueError(
+                "run_bucket requires shape/config-identical problems — "
+                "bucketing must never mix incompatible shapes "
+                f"({p.shape} vs {shape})")
+    max_iters = params.max_num_iters if max_iters is None else max_iters
+
+    B_real = len(padded)
+    B = _next_pow2(B_real)
+    states = [rbcd.init_state(p.graph, meta, p.X0, params=params)
+              for p in padded]
+    graphs = [p.graph for p in padded]
+    edges_g = [p.edges_g for p in padded]
+    while len(states) < B:  # replicate the tail to the pow2 width
+        states.append(states[B_real - 1])
+        graphs.append(graphs[B_real - 1])
+        edges_g.append(edges_g[B_real - 1])
+    state_b = _tree_stack(states)
+    graph_b = _tree_stack(graphs)
+    eg_b = _tree_stack(edges_g)
+
+    seg = cache.get(
+        problem_fingerprint(meta, params, dtype, shape, B, "segment"),
+        lambda: _make_segment_exec(meta, params))
+    met = cache.get(
+        problem_fingerprint(meta, params, dtype, shape, B, "metrics"),
+        lambda: _make_metrics_exec(meta, shape.n_total, shape.num_meas))
+    fin = cache.get(
+        problem_fingerprint(meta, params, dtype, shape, B, "finalize"),
+        lambda: _make_finalize_exec(meta, shape.n_total, shape.num_meas))
+
+    robust_on = params.robust.cost_type != RobustCostType.L2
+    accel_on = params.acceleration
+
+    it = 0
+    nwu = 0
+    evals = 0
+    done = [False] * B_real
+    cost_hist = [[] for _ in range(B_real)]
+    gn_hist = [[] for _ in range(B_real)]
+    term = ["max_iters"] * B_real
+    iters = [max_iters] * B_real
+    while it < max_iters and not all(done):
+        target = min(((it // eval_every) + 1) * eval_every, max_iters)
+        while it < target:
+            uw, rs, end = rbcd.schedule_bounds(
+                it, nwu, max_iters=max_iters, eval_every=eval_every,
+                params=params, robust_on=robust_on, accel_on=accel_on)
+            nwu += int(uw)
+            state_b = seg(state_b, graph_b, end - it, uw=uw, rs=rs)
+            it = end
+        vec = np.asarray(met(state_b.X, state_b.weights, state_b.ready,
+                             graph_b, eg_b))
+        evals += 1
+        for b in range(B_real):
+            if done[b]:
+                continue
+            f, gn, consensus = vec[b]
+            cost_hist[b].append(float(f))
+            gn_hist[b].append(float(gn))
+            if float(gn) < grad_norm_tol:
+                done[b], term[b], iters[b] = True, "grad_norm", it
+            elif consensus > 0:
+                done[b], term[b], iters[b] = True, "consensus", it
+
+    T_b, w_b = fin(state_b.X, state_b.weights, graph_b)
+    T_b = np.asarray(T_b)
+    w_b = np.asarray(w_b)
+    X_b = np.asarray(state_b.X)
+    results = []
+    for b, p in enumerate(padded):
+        results.append(rbcd.RBCDResult(
+            T=jnp.asarray(T_b[b, :p.prob.n_total]),
+            X=jnp.asarray(X_b[b, :, :p.prob.meta.n_max]),
+            cost_history=cost_hist[b],
+            grad_norm_history=gn_hist[b],
+            iterations=iters[b],
+            terminated_by=term[b],
+            weights=jnp.asarray(w_b[b, :p.prob.num_meas]),
+        ))
+    info = {"rounds": it, "evals": evals, "batch": B,
+            "size": B_real, "occupancy": B_real / float(B)}
+    return results, info

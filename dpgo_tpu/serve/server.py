@@ -1,0 +1,364 @@
+"""The request plane: queueing, admission control, batching, SLO metrics.
+
+``SolveServer`` is the in-process serving API (the TCP front-end in
+``frontend`` is a thin shell over it).  ``submit`` performs admission
+control synchronously — a bounded queue and per-tenant in-flight quotas
+raise ``OverCapacityError`` immediately, so an overloaded server fails
+fast instead of buffering unboundedly — and returns a ``SolveTicket``
+future.  A single worker thread drains the queue: it prepares each
+request (problem build, ``models.rbcd.prepare_problem``), pads it into
+its shape bucket (``bucketing``), sheds requests whose deadline expired
+while queued (``OverCapacityError`` with ``reason="deadline"``), groups
+compatible requests, and dispatches one batched solve per group
+(``runner.run_bucket``) through the fingerprint-keyed executable cache.
+
+Warm pools: ``warm(requests)`` runs representative requests through the
+full pipeline at ``max_iters=1``, populating the executable cache (and
+XLA's jit caches) before traffic arrives, so the first real request of a
+bucket doesn't pay compilation.
+
+Per-tenant SLO metrics ride the ambient telemetry run (``dpgo_tpu.obs``)
+when one is installed: ``serve_request`` / ``serve_batch`` /
+``serve_shed`` events (the schema the report CLI's "serving" section and
+``bench_serving.py`` share) plus queue-wait/latency histograms, an
+occupancy gauge, and request/shed counters.  With telemetry off the
+entire path constructs no obs objects — every metrics site sits behind
+``obs.get_run() is not None``, same fence as the solver core.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+
+import jax.numpy as jnp
+
+from .. import obs
+from ..config import AgentParams
+from ..models.rbcd import prepare_problem
+from ..types import Measurements
+from .bucketing import bucket_shape_of, pad_problem
+from .cache import ExecutableCache, fingerprint_key, problem_fingerprint
+from .runner import run_bucket
+
+
+class OverCapacityError(RuntimeError):
+    """The server refused or shed this request.  ``reason`` is one of
+    ``"queue"`` (bounded queue full), ``"tenant_quota"`` (per-tenant
+    in-flight cap), ``"deadline"`` (shed after waiting past its deadline),
+    or ``"closed"`` (server shut down with the request still queued)."""
+
+    def __init__(self, message: str, reason: str = "capacity"):
+        super().__init__(message)
+        self.reason = reason
+
+
+@dataclasses.dataclass
+class SolveRequest:
+    """One tenant's problem: measurements plus solve/termination config.
+
+    Requests whose built problems round to the same shape bucket AND agree
+    on (params, dtype, max_iters, grad_norm_tol, eval_every) batch
+    together; anything else dispatches separately."""
+
+    meas: Measurements
+    num_robots: int
+    params: AgentParams | None = None
+    tenant: str = "default"
+    #: Relative deadline (seconds from submit).  A request still queued
+    #: past its deadline is shed, never solved late.
+    deadline_s: float | None = None
+    max_iters: int | None = None
+    grad_norm_tol: float = 0.1
+    eval_every: int = 1
+    dtype: object = jnp.float64
+
+
+class SolveTicket:
+    """Future for one submitted request."""
+
+    def __init__(self, request: SolveRequest):
+        self.request = request
+        self.t_submit = time.monotonic()
+        self.t_dispatch: float | None = None
+        self.t_done: float | None = None
+        self._event = threading.Event()
+        self._result = None
+        self._exception: BaseException | None = None
+        # worker-side scratch
+        self._padded = None
+        self._key: str | None = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None):
+        """The ``RBCDResult``; raises the solve's exception (including
+        ``OverCapacityError`` for shed requests) or ``TimeoutError``."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("solve not finished within timeout")
+        if self._exception is not None:
+            raise self._exception
+        return self._result
+
+    @property
+    def queue_wait_s(self) -> float | None:
+        return None if self.t_dispatch is None \
+            else self.t_dispatch - self.t_submit
+
+    @property
+    def latency_s(self) -> float | None:
+        return None if self.t_done is None else self.t_done - self.t_submit
+
+    def _finish(self, result=None, exception=None) -> None:
+        self.t_done = time.monotonic()
+        self._result = result
+        self._exception = exception
+        self._event.set()
+
+
+class SolveServer:
+    """Multi-tenant batched PGO solve server (in-process API).
+
+    Use as a context manager; ``close()`` drains nothing — queued requests
+    are shed with ``reason="closed"``."""
+
+    def __init__(self, max_batch: int = 8, max_queue: int = 64,
+                 batch_window_s: float = 0.005,
+                 tenant_quota: int | None = None, quantum: int = 32,
+                 init: str = "chordal"):
+        if max_batch < 1 or max_queue < 1:
+            raise ValueError("max_batch and max_queue must be >= 1")
+        self.max_batch = int(max_batch)
+        self.max_queue = int(max_queue)
+        self.batch_window_s = float(batch_window_s)
+        self.tenant_quota = tenant_quota
+        self.quantum = int(quantum)
+        self.init = init
+        self.cache = ExecutableCache()
+        self._cond = threading.Condition()
+        self._pending: deque[SolveTicket] = deque()
+        self._inflight: dict[str, int] = {}
+        self._closed = False
+        run = obs.get_run()
+        if run is not None:
+            run.set_fingerprint(serve_max_batch=self.max_batch,
+                                serve_quantum=self.quantum)
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="dpgo-serve-worker")
+        self._worker.start()
+
+    # -- client API ---------------------------------------------------------
+
+    def submit(self, request: SolveRequest) -> SolveTicket:
+        """Admit a request (or raise ``OverCapacityError``) and return its
+        ticket.  Admission is synchronous and cheap; problem build happens
+        on the worker."""
+        ticket = SolveTicket(request)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("server is closed")
+            if len(self._pending) >= self.max_queue:
+                self._obs_shed(request.tenant, "queue", 0.0)
+                raise OverCapacityError(
+                    f"queue full ({self.max_queue} requests pending)",
+                    reason="queue")
+            if self.tenant_quota is not None and \
+                    self._inflight.get(request.tenant, 0) >= self.tenant_quota:
+                self._obs_shed(request.tenant, "tenant_quota", 0.0)
+                raise OverCapacityError(
+                    f"tenant {request.tenant!r} at its in-flight quota "
+                    f"({self.tenant_quota})", reason="tenant_quota")
+            self._inflight[request.tenant] = \
+                self._inflight.get(request.tenant, 0) + 1
+            self._pending.append(ticket)
+            self._cond.notify_all()
+        return ticket
+
+    def solve(self, request: SolveRequest, timeout: float | None = None):
+        """Blocking convenience: ``submit(...).result(timeout)``."""
+        return self.submit(request).result(timeout)
+
+    def warm(self, requests: list[SolveRequest]) -> int:
+        """Warm pool: run representative requests through prepare -> pad ->
+        batched dispatch at ``max_iters=1``, so their buckets' executables
+        are compiled and cached before real traffic.  Returns the number
+        of distinct buckets warmed."""
+        groups: dict[str, list] = {}
+        for req in requests:
+            padded, key = self._prepare(req)
+            groups.setdefault(key, []).append((padded, req))
+        for members in groups.values():
+            padded_list = [p for p, _ in members][:self.max_batch]
+            req0 = members[0][1]
+            run_bucket(padded_list, self.cache, max_iters=1,
+                       grad_norm_tol=req0.grad_norm_tol,
+                       eval_every=1)
+        run = obs.get_run()
+        if run is not None:
+            run.event("serve_warm", phase="serve", buckets=len(groups),
+                      requests=len(requests))
+        return len(groups)
+
+    def close(self) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._worker.join()
+
+    def __enter__(self) -> "SolveServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- worker -------------------------------------------------------------
+
+    def _prepare(self, req: SolveRequest):
+        """Problem build + bucket padding for one request; returns the
+        padded problem and its full batch-compatibility key."""
+        prob = prepare_problem(req.meas, req.num_robots, params=req.params,
+                               dtype=req.dtype, init=None, pallas_sel=False)
+        shape = bucket_shape_of(prob, quantum=self.quantum)
+        padded = pad_problem(prob, shape, init=self.init)
+        fp = problem_fingerprint(padded.meta, prob.params, req.dtype, shape)
+        fp["termination"] = [req.max_iters or prob.params.max_num_iters,
+                             req.grad_norm_tol, req.eval_every]
+        return padded, fingerprint_key(fp)
+
+    def _release(self, tickets) -> None:
+        with self._cond:
+            for t in tickets:
+                tenant = t.request.tenant
+                n = self._inflight.get(tenant, 1) - 1
+                if n <= 0:
+                    self._inflight.pop(tenant, None)
+                else:
+                    self._inflight[tenant] = n
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._closed:
+                    self._cond.wait()
+                if self._closed:
+                    leftovers = list(self._pending)
+                    self._pending.clear()
+                    break
+                n_pending = len(self._pending)
+            # Batching window: give concurrent submitters a moment to
+            # coalesce before forming a batch (skip when already full).
+            if n_pending < self.max_batch and self.batch_window_s > 0:
+                time.sleep(self.batch_window_s)
+            self._dispatch_once()
+        for t in leftovers:
+            t._finish(exception=OverCapacityError(
+                "server closed with request still queued", reason="closed"))
+        self._release(leftovers)
+
+    def _dispatch_once(self) -> None:
+        with self._cond:
+            snapshot = list(self._pending)
+        if not snapshot:
+            return
+        now = time.monotonic()
+        shed, failed = [], []
+        for t in snapshot:
+            dl = t.request.deadline_s
+            if dl is not None and (now - t.t_submit) > dl:
+                shed.append(t)
+                continue
+            if t._padded is None:
+                try:
+                    t._padded, t._key = self._prepare(t.request)
+                except Exception as e:  # bad request: report, don't die
+                    t._finish(exception=e)
+                    failed.append(t)
+        for t in shed:
+            waited = now - t.t_submit
+            t._finish(exception=OverCapacityError(
+                f"deadline ({t.request.deadline_s:.3f}s) expired after "
+                f"{waited:.3f}s in queue", reason="deadline"))
+            self._obs_shed(t.request.tenant, "deadline", waited)
+        drop = set(shed) | set(failed)
+        ready = [t for t in snapshot if t not in drop and t._padded is not None]
+        batch = []
+        if ready:
+            lead_key = ready[0]._key
+            batch = [t for t in ready if t._key == lead_key][:self.max_batch]
+        with self._cond:
+            for t in list(drop) + batch:
+                try:
+                    self._pending.remove(t)
+                except ValueError:
+                    pass
+        self._release(list(drop))
+        if batch:
+            self._run_batch(batch)
+
+    def _run_batch(self, tickets: list[SolveTicket]) -> None:
+        t0 = time.monotonic()
+        for t in tickets:
+            t.t_dispatch = t0
+        req0 = tickets[0].request
+        try:
+            results, info = run_bucket(
+                [t._padded for t in tickets], self.cache,
+                max_iters=req0.max_iters, grad_norm_tol=req0.grad_norm_tol,
+                eval_every=req0.eval_every)
+        except Exception as e:
+            for t in tickets:
+                t._finish(exception=e)
+            self._release(tickets)
+            return
+        for t, res in zip(tickets, results):
+            t._finish(result=res)
+        self._release(tickets)
+        self._obs_batch(tickets, results, info, time.monotonic() - t0)
+
+    # -- telemetry (every site behind the zero-overhead fence) --------------
+
+    def _obs_shed(self, tenant: str, reason: str, waited_s: float) -> None:
+        run = obs.get_run()
+        if run is None:
+            return
+        run.counter("serve_shed_total",
+                    "requests shed by admission control").inc(
+            tenant=tenant, reason=reason)
+        run.event("serve_shed", phase="serve", tenant=tenant, reason=reason,
+                  waited_s=waited_s)
+
+    def _obs_batch(self, tickets, results, info, duration_s: float) -> None:
+        run = obs.get_run()
+        if run is None:
+            return
+        bucket = str(tuple(tickets[0]._padded.shape))
+        run.gauge("serve_batch_occupancy",
+                  "fraction of the batched executable's slots carrying "
+                  "real requests").set(info["occupancy"])
+        run.event("serve_batch", phase="serve", bucket=bucket,
+                  size=info["size"], batch=info["batch"],
+                  occupancy=info["occupancy"], rounds=info["rounds"],
+                  evals=info["evals"], duration_s=duration_s,
+                  cache=self.cache.stats())
+        c_req = run.counter("serve_requests_total", "requests served")
+        h_wait = run.histogram("serve_queue_wait_seconds",
+                               "submit -> dispatch wait", unit="s")
+        h_lat = run.histogram("serve_solve_latency_seconds",
+                              "submit -> result latency", unit="s")
+        for t, res in zip(tickets, results):
+            tenant = t.request.tenant
+            c_req.inc(tenant=tenant)
+            h_wait.observe(t.queue_wait_s or 0.0, tenant=tenant)
+            h_lat.observe(t.latency_s or 0.0, tenant=tenant)
+            run.event(
+                "serve_request", phase="serve", tenant=tenant, bucket=bucket,
+                queue_wait_s=t.queue_wait_s, latency_s=t.latency_s,
+                iterations=res.iterations, terminated_by=res.terminated_by,
+                cost=res.cost_history[-1] if res.cost_history else None,
+                grad_norm=res.grad_norm_history[-1]
+                if res.grad_norm_history else None)

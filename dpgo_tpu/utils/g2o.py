@@ -10,6 +10,8 @@ container directly (no per-edge objects).
 
 from __future__ import annotations
 
+import io
+
 import numpy as np
 
 from ..types import Measurements
@@ -35,24 +37,54 @@ def key_to_robot_keyframe(key):
     return robot.astype(np.int32), index.astype(np.int64)
 
 
-def read_g2o(path: str, backend: str = "auto") -> Measurements:
-    """Parse a .g2o file into a ``Measurements`` batch.
+def _is_bytes_like(source) -> bool:
+    return isinstance(source, (bytes, bytearray, memoryview))
+
+
+def _open_g2o_text(source):
+    """A text stream over any accepted g2o source: a filesystem path,
+    raw ``bytes``/``bytearray``/``memoryview`` (an uploaded payload — the
+    serving plane parses request bodies without temp files), or a
+    file-like object opened in text or binary mode."""
+    if _is_bytes_like(source):
+        return io.StringIO(bytes(source).decode("utf-8"))
+    if hasattr(source, "read"):
+        data = source.read()
+        if isinstance(data, bytes):
+            data = data.decode("utf-8")
+        return io.StringIO(data)
+    return open(source)
+
+
+def read_g2o(source, backend: str = "auto") -> Measurements:
+    """Parse a .g2o dataset into a ``Measurements`` batch.
+
+    ``source`` is a filesystem path, the file's ``bytes`` (also
+    ``bytearray``/``memoryview``), or a file-like object — in-memory
+    sources let a server parse uploaded g2o payloads without temp files.
 
     ``backend``: ``"auto"`` uses the native (C++) loader when available —
     the framework's IO layer is native like the reference's
     (``native/g2o_parser.cpp``) — and falls back to the pure-Python parser;
     ``"native"`` / ``"python"`` force one side (native raises when the
-    library can't be built).
+    library can't be built).  The native loader reads from the filesystem
+    only: in-memory sources always parse in Python (``backend="native"``
+    with one raises).
     """
     if backend not in ("auto", "native", "python"):
         raise ValueError(f"unknown backend {backend!r}")
-    if backend != "python":
+    in_memory = _is_bytes_like(source) or hasattr(source, "read")
+    if backend != "python" and not in_memory:
         from . import native_io
         if backend == "native":
-            return native_io.read_g2o_native(path)
+            return native_io.read_g2o_native(source)
         if native_io.native_available():
-            return native_io.read_g2o_native(path)
-    return read_g2o_python(path)
+            return native_io.read_g2o_native(source)
+    if backend == "native" and in_memory:
+        raise ValueError(
+            "backend='native' requires a filesystem path; bytes/file-like "
+            "sources parse with the Python backend")
+    return read_g2o_python(source)
 
 
 def write_g2o(meas: Measurements, path: str) -> None:
@@ -97,8 +129,9 @@ def write_g2o(meas: Measurements, path: str) -> None:
                      + "\n")
 
 
-def read_g2o_python(path: str) -> Measurements:
+def read_g2o_python(source) -> Measurements:
     """Pure-Python (vectorized numpy) g2o parser — the portable fallback.
+    Accepts the same path / bytes / file-like sources as ``read_g2o``.
 
     Supports ``EDGE_SE2`` and ``EDGE_SE3:QUAT``; ``VERTEX_*`` lines only
     contribute to the pose count, as in the reference (which ignores vertex
@@ -117,7 +150,7 @@ def read_g2o_python(path: str) -> Measurements:
     num_vertices = 0
     max_index = -1
 
-    with open(path) as f:
+    with _open_g2o_text(source) as f:
         for line in f:
             toks = line.split()  # whitespace-agnostic, like the reference's stringstream
             if not toks:
@@ -148,7 +181,8 @@ def read_g2o_python(path: str) -> Measurements:
     if se2_rows and se3_rows:
         raise ValueError("Mixed SE2/SE3 edges in one file")
     if not se2_rows and not se3_rows:
-        raise ValueError(f"No edges found in {path}")
+        where = source if isinstance(source, str) else "g2o source"
+        raise ValueError(f"No edges found in {where}")
 
     if se3_rows:
         d = 3

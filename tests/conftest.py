@@ -126,6 +126,7 @@ SLOW_TESTS = {
     "test_recentered_gradient_error_scales_with_d",
     "test_two_process_tcp_solve_converges",
     "test_three_process_tcp_chaos_degrades_gracefully",
+    "test_tcp_serve_solve_roundtrip",
     "test_comm_model_matches_compiled_collectives",
     "test_sharded_staircase_escapes_winding_minimum",
     "test_f32_staircase_polishes_before_certifying",

@@ -496,3 +496,23 @@ def test_comms_telemetry_off_emits_zero_obs_events(monkeypatch):
     ch.send({"v": np.asarray(1)})
     assert ch.totals.retries == 1
     ch.close()
+
+
+def test_transport_frame_cap_constructor_validation():
+    """The frame-size cap is a constructor knob on every transport (the
+    serving front-end threads --max-frame-mb through it); a non-positive
+    cap is a configuration error, caught at construction."""
+    a, b = LoopbackTransport.pair(max_frame_bytes=512)
+    try:
+        assert a.max_frame_bytes == b.max_frame_bytes == 512
+        with pytest.raises(ProtocolError, match="cap"):
+            a.send({"big": np.zeros(4096)})
+        a.send({"ok": np.zeros(4)})  # link still usable under the cap
+        assert "ok" in b.recv(timeout=5)
+    finally:
+        a.close()
+        b.close()
+    with pytest.raises(ValueError, match="positive"):
+        LoopbackTransport.pair(max_frame_bytes=0)
+    with pytest.raises(ValueError, match="positive"):
+        LoopbackTransport.pair(max_frame_bytes=-1)

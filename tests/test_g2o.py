@@ -73,3 +73,58 @@ def test_se2_kappa_is_i33(data_dir, tmp_path):
     assert np.isclose(m.kappa[0], 9.0)
     # tau = 2 / tr(inv(diag(4,4))) = 2 / 0.5 = 4
     assert np.isclose(m.tau[0], 4.0)
+
+
+def _synthetic_meas(n=20, d=3, seed=0):
+    from dpgo_tpu.utils.synthetic import make_measurements
+
+    meas, _ = make_measurements(np.random.default_rng(seed), n=n, d=d,
+                                num_lc=4, rot_noise=0.01, trans_noise=0.01)
+    return meas
+
+
+def test_read_g2o_bytes_and_file_like_round_trip(tmp_path):
+    """write_g2o -> read back as path, bytes, bytearray, and file-like
+    (binary + text) — all five sources parse identically, so the serving
+    plane can decode uploaded payloads without temp files."""
+    import io
+
+    for d in (2, 3):
+        meas = _synthetic_meas(d=d, seed=d)
+        path = str(tmp_path / f"rt_{d}.g2o")
+        g2o.write_g2o(meas, path)
+        with open(path, "rb") as fh:
+            raw = fh.read()
+        from_path = g2o.read_g2o(path)
+        variants = [
+            g2o.read_g2o(raw),
+            g2o.read_g2o(bytearray(raw)),
+            g2o.read_g2o(io.BytesIO(raw)),
+            g2o.read_g2o(io.StringIO(raw.decode())),
+        ]
+        for m in variants:
+            assert m.d == from_path.d == meas.d
+            assert len(m) == len(from_path) == len(meas)
+            np.testing.assert_array_equal(m.p1, from_path.p1)
+            np.testing.assert_array_equal(m.p2, from_path.p2)
+            np.testing.assert_allclose(m.R, from_path.R, atol=1e-12)
+            np.testing.assert_allclose(m.t, from_path.t, atol=1e-12)
+            np.testing.assert_allclose(m.kappa, from_path.kappa, atol=1e-9)
+            np.testing.assert_allclose(m.tau, from_path.tau, atol=1e-9)
+        # The write -> read cycle preserves the original measurements.
+        np.testing.assert_allclose(from_path.R, meas.R, atol=1e-9)
+        np.testing.assert_allclose(from_path.t, meas.t, atol=1e-9)
+
+
+def test_read_g2o_native_backend_requires_path():
+    import pytest
+
+    with pytest.raises(ValueError, match="filesystem path"):
+        g2o.read_g2o(b"EDGE_SE2 0 1 1 0 0 4 0 0 4 0 9\n", backend="native")
+
+
+def test_read_g2o_bytes_no_edges_message():
+    import pytest
+
+    with pytest.raises(ValueError, match="No edges found in g2o source"):
+        g2o.read_g2o(b"VERTEX_SE2 0 0 0 0\n")

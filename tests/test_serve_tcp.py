@@ -1,0 +1,123 @@
+"""TCP front-end of the serving plane: wire round-trip, frame-size caps,
+and structured error replies.  The full solve-over-TCP test is
+slow-marked (real sockets + solver compile) and runs in the CI serving
+job; the protocol-level tests stay in tier-1."""
+
+import numpy as np
+import pytest
+
+from dpgo_tpu.comms.protocol import ProtocolError
+from dpgo_tpu.comms.transport import TcpTransport, connect_tcp
+from dpgo_tpu.config import AgentParams
+from dpgo_tpu.models import rbcd
+from dpgo_tpu.serve import SolveServer
+from dpgo_tpu.serve.frontend import (ServeFrontend, _pack_str, _unpack_str,
+                                     handle_request, solve_g2o)
+from dpgo_tpu.utils.g2o import write_g2o
+from dpgo_tpu.utils.synthetic import make_measurements
+
+PARAMS = AgentParams(d=3, r=5, num_robots=2)
+
+
+def _g2o_bytes(tmp_path, n=24, seed=0):
+    meas, _ = make_measurements(np.random.default_rng(seed), n=n, d=3,
+                                num_lc=5, rot_noise=0.01, trans_noise=0.01)
+    path = str(tmp_path / f"prob_{n}_{seed}.g2o")
+    write_g2o(meas, path)
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+def test_frontend_ping_and_unknown_op():
+    with SolveServer(max_batch=2, batch_window_s=0.0) as srv:
+        with ServeFrontend(srv) as fe:
+            sock = connect_tcp("127.0.0.1", fe.port)
+            tr = TcpTransport(sock, src="test-client")
+            try:
+                tr.send({"op": _pack_str("ping")})
+                assert int(np.asarray(tr.recv(timeout=10)["ok"])) == 1
+                tr.send({"op": _pack_str("launch-missiles")})
+                reply = tr.recv(timeout=10)
+                assert int(np.asarray(reply["ok"])) == 0
+                assert "unknown op" in _unpack_str(reply["error"])
+            finally:
+                tr.close()
+
+
+def test_client_side_frame_cap_raises_protocol_error(tmp_path):
+    raw = _g2o_bytes(tmp_path)
+    with SolveServer(max_batch=2, batch_window_s=0.0) as srv:
+        with ServeFrontend(srv) as fe:
+            with pytest.raises(ProtocolError, match="exceeds"):
+                solve_g2o("127.0.0.1", fe.port, raw, num_robots=2,
+                          max_frame_bytes=256)
+
+
+def test_server_side_frame_cap_reports_structured_error(tmp_path):
+    raw = _g2o_bytes(tmp_path)
+    with SolveServer(max_batch=2, batch_window_s=0.0) as srv:
+        # --max-frame-mb analog: a cap smaller than the upload.
+        with ServeFrontend(srv, max_frame_bytes=1024) as fe:
+            sock = connect_tcp("127.0.0.1", fe.port)
+            tr = TcpTransport(sock, src="test-client")
+            try:
+                tr.send({"op": _pack_str("solve"),
+                         "g2o": np.frombuffer(raw, np.uint8),
+                         "num_robots": np.int32(2)})
+                reply = tr.recv(timeout=10)
+                assert int(np.asarray(reply["ok"])) == 0
+                assert "protocol error" in _unpack_str(reply["error"])
+            finally:
+                tr.close()
+
+
+def test_handle_request_solves_g2o_payload_in_process(tmp_path):
+    """The frontend handler parses uploaded g2o bytes without temp files
+    (read_g2o bytes input) and returns the result arrays."""
+    raw = _g2o_bytes(tmp_path)
+    with SolveServer(max_batch=2, batch_window_s=0.0, quantum=64) as srv:
+        reply = handle_request(srv, {
+            "op": _pack_str("solve"),
+            "g2o": np.frombuffer(raw, np.uint8),
+            "num_robots": np.int32(2),
+            "max_iters": np.int32(4),
+            "grad_norm_tol": np.float64(1e-12),
+            "eval_every": np.int32(2),
+            "tenant": _pack_str("acme"),
+        })
+    assert int(np.asarray(reply["ok"])) == 1
+    assert np.isfinite(np.asarray(reply["cost_history"])).all()
+    assert reply["T"].shape[-2:] == (3, 4)
+    assert _unpack_str(reply["terminated_by"]) in (
+        "grad_norm", "consensus", "max_iters")
+
+
+def test_handle_request_bad_payload_structured_error():
+    with SolveServer(max_batch=2, batch_window_s=0.0) as srv:
+        reply = handle_request(srv, {
+            "op": _pack_str("solve"),
+            "g2o": np.frombuffer(b"VERTEX_SE3:QUAT 0 garbage\n", np.uint8),
+            "num_robots": np.int32(2),
+        })
+    assert int(np.asarray(reply["ok"])) == 0
+    assert _unpack_str(reply["error"])
+
+
+def test_tcp_serve_solve_roundtrip(tmp_path):
+    """Full solve over a real socket, compared against the library path.
+    Slow-marked: runs in the CI serving job, not tier-1."""
+    raw = _g2o_bytes(tmp_path, n=30, seed=3)
+    from dpgo_tpu.utils.g2o import read_g2o
+
+    meas = read_g2o(raw)
+    ref = rbcd.solve_rbcd(meas, 2, params=PARAMS, max_iters=4,
+                          grad_norm_tol=1e-12, eval_every=2)
+    with SolveServer(max_batch=2, batch_window_s=0.0, quantum=64) as srv:
+        with ServeFrontend(srv) as fe:
+            out = solve_g2o("127.0.0.1", fe.port, raw, num_robots=2,
+                            max_iters=4, grad_norm_tol=1e-12, eval_every=2,
+                            timeout=300)
+    assert out["ok"]
+    assert abs(out["cost_history"][-1] - ref.cost_history[-1]) <= \
+        1e-8 * max(1.0, abs(ref.cost_history[-1]))
+    assert out["T"].shape == np.asarray(ref.T).shape
