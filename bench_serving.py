@@ -81,9 +81,18 @@ def main(argv=None) -> int:
     ap.add_argument("--tenants", type=int, default=2,
                     help="requests round-robin over this many tenants")
     ap.add_argument("--telemetry", metavar="DIR", default=None)
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="expose live /metrics,/healthz,/statusz during the "
+                         "batched arm (requires --telemetry)")
+    ap.add_argument("--slo-latency-s", type=float, default=None,
+                    help="per-request latency objective -> burn-rate SLO "
+                         "gauges/events in the run")
+    ap.add_argument("--profile-dir", metavar="DIR", default=None,
+                    help="jax.profiler window over the first batches "
+                         "(requires --telemetry)")
     args = ap.parse_args(argv)
 
-    from dpgo_tpu.serve import SolveRequest, SolveServer
+    from dpgo_tpu.serve import ServeSLO, SolveRequest, SolveServer
 
     problems = make_problems(args.n_problems, args.base_n, args.spread,
                              args.seed)
@@ -115,7 +124,14 @@ def main(argv=None) -> int:
     try:
         t0 = time.perf_counter()
         with SolveServer(max_batch=args.max_batch, batch_window_s=0.02,
-                         quantum=args.quantum) as srv:
+                         quantum=args.quantum,
+                         slo=ServeSLO(latency_s=args.slo_latency_s)
+                         if args.slo_latency_s is not None else None,
+                         metrics_port=args.metrics_port,
+                         profile_dir=args.profile_dir) as srv:
+            if srv.sidecar is not None:
+                log(f"[serve] metrics on {srv.sidecar.host}:"
+                    f"{srv.sidecar.port}")
             tickets = [
                 srv.submit(SolveRequest(
                     meas=m, num_robots=args.robots, params=params,
@@ -179,8 +195,16 @@ def main(argv=None) -> int:
     print(json.dumps(rec), flush=True)
 
     if args.telemetry:
+        # The batched arm ran traced (admission -> queue -> dispatch ->
+        # reply spans with batch-mate flow arrows): export the Perfetto
+        # timeline next to the run artifacts.
+        from dpgo_tpu.obs import timeline
         from dpgo_tpu.obs.report import render_report
 
+        trace_path = timeline.write_chrome_trace(
+            os.path.join(args.telemetry, "trace.json"),
+            timeline.merge([args.telemetry]))
+        log(f"[bench_serving] Perfetto timeline: {trace_path}")
         log(render_report(args.telemetry))
     return 0
 

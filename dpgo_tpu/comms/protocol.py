@@ -370,6 +370,15 @@ TRACE_T_KEY = "_trace_t"    # float64 [t_send_mono, t_send_wall]
 #: offsets from the send/receive timestamp pairs.
 CLOCK_KEY = "_ts"
 
+#: Named negative ``origin`` / trace ``robot`` sentinels.  Robot ids are
+#: non-negative; everything else on a timeline identifies itself with one
+#: of these.  ``obs.timeline`` maps the serving-plane pair (<= -3) onto
+#: the host track, the hub onto the bus track.
+ORIGIN_BUS_HUB = -1
+ORIGIN_UNKNOWN = -2
+ORIGIN_SERVE_CLIENT = -3   # serve front-end client (solve_g2o)
+ORIGIN_SERVE_SERVER = -4   # serve server/worker side
+
 
 def pack_trace_entries(trace_id: int, span_id: int, robot: int) -> dict:
     """The optional trace-context frame entries for one outgoing message,

@@ -83,9 +83,11 @@ from .run import (
     run_scope,
     start_run,
 )
+from . import profile  # noqa: E402  (serving compile/device profiling)
 from . import trace  # noqa: E402  (span API: trace.span / trace.start_span)
 
 __all__ = [
+    "profile",
     "Counter",
     "EventStream",
     "FlightRecorder",

@@ -38,16 +38,45 @@ def _fmt_value(v: float) -> str:
     return repr(float(v))
 
 
+def _escape_help(s: str) -> str:
+    # HELP text escapes only backslash and newline (the label escaping
+    # above additionally covers quotes; HELP is unquoted).
+    return str(s).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+#: Declared-unit spellings -> the canonical Prometheus name suffix.
+_UNIT_SUFFIX = {"s": "seconds", "sec": "seconds", "seconds": "seconds",
+                "B": "bytes", "bytes": "bytes"}
+
+
+def exposition_name(name: str, unit: str = "") -> str:
+    """The family's name on the wire: Prometheus naming wants the base
+    unit as a name suffix (``_seconds``, ``_bytes``) so scrapes validate
+    cleanly.  Families that declared a unit but don't carry its token in
+    the name get the suffix appended (before a trailing ``_total``);
+    names already mentioning the unit anywhere — ``comms_bytes_sent``,
+    ``round_latency_seconds`` — pass through untouched, so pre-existing
+    dashboards keep their series."""
+    suffix = _UNIT_SUFFIX.get(unit or "")
+    if suffix is None or suffix in name.split("_"):
+        return name
+    if name.endswith("_total"):
+        return name[:-len("_total")] + f"_{suffix}_total"
+    return f"{name}_{suffix}"
+
+
 def to_prometheus_text(registry) -> str:
     """Prometheus text exposition (format version 0.0.4) of a
-    ``MetricsRegistry``: ``# HELP`` / ``# TYPE`` headers per family,
-    histogram families expanded to ``_bucket``/``_sum``/``_count`` with
-    cumulative ``le`` buckets."""
+    ``MetricsRegistry``: ``# HELP`` / ``# TYPE`` headers per family
+    (HELP text escaped per the format spec, falling back to the family
+    name so every family is documented), unit-suffixed exposition names
+    (``exposition_name``), histogram families expanded to
+    ``_bucket``/``_sum``/``_count`` with cumulative ``le`` buckets."""
     lines = []
     for fam in registry.families():
-        if fam.help:
-            lines.append(f"# HELP {fam.name} {fam.help}")
-        lines.append(f"# TYPE {fam.name} {fam.kind}")
+        name = exposition_name(fam.name, fam.unit)
+        lines.append(f"# HELP {name} {_escape_help(fam.help or fam.name)}")
+        lines.append(f"# TYPE {name} {fam.kind}")
         for key, val in sorted(fam.series().items()):
             labels = dict(key)
             if fam.kind == "histogram":
@@ -55,21 +84,21 @@ def to_prometheus_text(registry) -> str:
                 for bound, n in zip(fam.buckets, val["counts"]):
                     cum += n
                     lines.append(
-                        f"{fam.name}_bucket"
+                        f"{name}_bucket"
                         f"{_fmt_labels(labels, {'le': _fmt_value(bound)})}"
                         f" {cum}")
                 cum += val["counts"][-1]
                 lines.append(
-                    f"{fam.name}_bucket{_fmt_labels(labels, {'le': '+Inf'})}"
+                    f"{name}_bucket{_fmt_labels(labels, {'le': '+Inf'})}"
                     f" {cum}")
                 lines.append(
-                    f"{fam.name}_sum{_fmt_labels(labels)}"
+                    f"{name}_sum{_fmt_labels(labels)}"
                     f" {_fmt_value(val['sum'])}")
                 lines.append(
-                    f"{fam.name}_count{_fmt_labels(labels)} {val['count']}")
+                    f"{name}_count{_fmt_labels(labels)} {val['count']}")
             else:
                 lines.append(
-                    f"{fam.name}{_fmt_labels(labels)} {_fmt_value(val)}")
+                    f"{name}{_fmt_labels(labels)} {_fmt_value(val)}")
     return "\n".join(lines) + "\n"
 
 

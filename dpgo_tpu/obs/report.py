@@ -4,6 +4,13 @@ Usage::
 
     python -m dpgo_tpu.obs.report <run_dir> [<run_dir>...] [--json]
     python -m dpgo_tpu.obs.report --compare <run_a> <run_b> [--json]
+    python -m dpgo_tpu.obs.report --live <host>:<port> [--json]
+
+``--live`` is the one mode that doesn't read artifacts: it scrapes a
+running serve sidecar's ``/statusz`` endpoint
+(``SolveServer(metrics_port=...)``) and renders queue depth, per-tenant
+in-flight vs. quota, cache compile/hit tallies, last-batch occupancy,
+and SLO burn rates while the server is still up.
 
 Reads the artifacts a ``TelemetryRun`` persisted (``events.jsonl``,
 ``metrics.json``) and prints the run's story: event volume, per-iteration
@@ -126,12 +133,19 @@ def serving_stats(events: list[dict]) -> dict | None:
     Per tenant: request count, QPS over the tenant's request window,
     queue-wait p50, and solve-latency p50/p99 (exact percentiles from the
     per-request events, not histogram-bucket approximations).  Fleet-wide:
-    batch count, mean batch occupancy/size, and shed tallies by tenant and
-    reason."""
+    batch count, mean batch occupancy/size, shed tallies by tenant and
+    reason, and SLO burn alerts (``slo_burn`` anomalies).
+
+    A run whose serve plane saw no completed request (server stood up,
+    everything shed or nothing arrived) reports ``no_traffic=True`` with
+    empty tenant stats — there is no submit->complete window to divide
+    by, and the report renders an explicit "no traffic" line instead of
+    exploding."""
     reqs = [ev for ev in events if ev.get("event") == "serve_request"]
     batches = [ev for ev in events if ev.get("event") == "serve_batch"]
     sheds = [ev for ev in events if ev.get("event") == "serve_shed"]
-    if not (reqs or batches or sheds):
+    serve_seen = any(ev.get("phase") == "serve" for ev in events)
+    if not (reqs or batches or sheds or serve_seen):
         return None
 
     def _pct(vals, q):
@@ -169,7 +183,29 @@ def serving_stats(events: list[dict]) -> dict | None:
              if isinstance(ev.get("size"), (int, float))]
     shed_tally = dict(_TallyCounter(
         (ev.get("tenant", "?"), ev.get("reason", "?")) for ev in sheds))
+    # SLO burn alerts: the serve plane's slo_burn anomalies + recoveries.
+    burns = [ev for ev in events if ev.get("event") == "anomaly"
+             and ev.get("kind") == "slo_burn"]
+    slo = None
+    if burns:
+        slo = {}
+        for ev in burns:
+            row = slo.setdefault(
+                ev.get("tenant", "?"),
+                {"alerts": 0, "max_burn": 0.0, "worst_severity": None,
+                 "slos": set()})
+            row["alerts"] += 1
+            rate = ev.get("burn_rate")
+            if isinstance(rate, (int, float)):
+                row["max_burn"] = max(row["max_burn"], float(rate))
+            if ev.get("severity") == "critical" or \
+                    row["worst_severity"] is None:
+                row["worst_severity"] = ev.get("severity")
+            row["slos"].add(ev.get("slo", "?"))
+        for row in slo.values():
+            row["slos"] = sorted(row["slos"])
     return {
+        "no_traffic": not reqs,
         "tenants": out_t,
         "batches": {
             "count": len(batches),
@@ -178,6 +214,7 @@ def serving_stats(events: list[dict]) -> dict | None:
         },
         "shed": [{"tenant": t, "reason": r, "count": n}
                  for (t, r), n in sorted(shed_tally.items())],
+        "slo": slo,
     }
 
 
@@ -186,6 +223,8 @@ def _serving_lines(stats: dict | None) -> list[str]:
     if not stats:
         return []
     lines = ["serving:"]
+    if stats.get("no_traffic"):
+        lines.append("  no completed requests (no traffic)")
     for tenant, row in stats["tenants"].items():
         parts = [f"{row['requests']} requests"]
         if row["qps"] is not None:
@@ -198,7 +237,7 @@ def _serving_lines(stats: dict | None) -> list[str]:
                             if row["latency_p99_s"] is not None else ""))
         lines.append(f"  tenant {tenant}: " + ", ".join(parts))
     b = stats["batches"]
-    if b["count"]:
+    if b["count"] and b["mean_occupancy"] is not None:
         lines.append(
             f"  batches: {b['count']} dispatched, mean occupancy "
             f"{b['mean_occupancy'] * 100:.0f}%, mean size "
@@ -206,7 +245,80 @@ def _serving_lines(stats: dict | None) -> list[str]:
     for s in stats["shed"]:
         lines.append(f"  shed: tenant {s['tenant']} x{s['count']} "
                      f"({s['reason']})")
+    for tenant, row in sorted((stats.get("slo") or {}).items()):
+        lines.append(
+            f"  slo burn: tenant {tenant} {row['alerts']} alert(s) "
+            f"[{row['worst_severity']}] on {'/'.join(row['slos'])}, "
+            f"max burn {row['max_burn']:.1f}x")
     return lines
+
+
+def render_statusz(status: dict) -> str:
+    """Human rendering of a live ``/statusz`` payload (the JSON
+    ``serve.statusz.MetricsSidecar`` serves and ``SolveServer.status()``
+    produces) — the ``--live`` mode's output."""
+    lines = ["== live server status =="]
+    lines.append(
+        f"uptime {status.get('uptime_s', 0.0):.1f}s"
+        + (", CLOSED" if status.get("closed") else ""))
+    lines.append(
+        f"queue: {status.get('queue_depth', 0)}/{status.get('max_queue', '?')}"
+        f" pending, max batch {status.get('max_batch', '?')}, "
+        f"quantum {status.get('quantum', '?')}")
+    lines.append(
+        f"lifetime: {status.get('requests_served', 0)} served / "
+        f"{status.get('requests_shed', 0)} shed over "
+        f"{status.get('batches_dispatched', 0)} batches")
+    for tenant, row in (status.get("tenants") or {}).items():
+        quota = row.get("quota")
+        lines.append(f"  tenant {tenant}: {row.get('in_flight', 0)} in flight"
+                     + (f" / quota {quota}" if quota is not None else ""))
+    lb = status.get("last_batch")
+    if lb:
+        lines.append(
+            f"last batch: {lb.get('size')}/{lb.get('batch')} slots "
+            f"({(lb.get('occupancy') or 0) * 100:.0f}% occupancy), "
+            f"{lb.get('rounds')} rounds in {lb.get('duration_s', 0):.3f}s")
+    cache = status.get("cache")
+    if cache:
+        lines.append(
+            f"executable cache: {cache.get('entries', 0)} entries, "
+            f"{cache.get('compiles', 0)} compiles, "
+            f"{cache.get('hits', 0)} hits")
+    for tenant, row in (status.get("slo") or {}).items():
+        level = row.get("level")
+        lines.append(
+            f"  slo {tenant}: latency burn {row.get('latency_burn', 0):.2f}x,"
+            f" shed burn {row.get('shed_burn', 0):.2f}x"
+            f" ({row.get('requests', 0)} req / {row.get('slow', 0)} slow / "
+            f"{row.get('shed', 0)} shed in {row.get('window_s', 0):.0f}s)"
+            + (f" ALERT {level}" if level else ""))
+    return "\n".join(lines)
+
+
+def live_report(target: str, json_out: bool = False, timeout: float = 5.0,
+                out=None) -> int:
+    """``--live HOST:PORT``: scrape a running server's ``/statusz``
+    sidecar and render it.  rc 0 on success, 2 on unreachable/garbage
+    (same contract as the run-dir error paths)."""
+    import urllib.error
+    import urllib.request
+
+    out = out or sys.stdout
+    if "://" not in target:
+        target = f"http://{target}"
+    url = target.rstrip("/") + "/statusz"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            status = json.load(resp)
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"cannot scrape {url}: {e}", file=sys.stderr)
+        return 2
+    if json_out:
+        print(json.dumps(status), file=out)
+    else:
+        print(render_statusz(status), file=out)
+    return 0
 
 
 def _fleet_lines(stats: dict | None) -> list[str]:
@@ -450,7 +562,12 @@ def main(argv: list[str] | None = None) -> int:
                          "noise band (default 0.05)")
     ap.add_argument("--allow-mismatch", action="store_true",
                     help="--compare: proceed despite fingerprint mismatches")
+    ap.add_argument("--live", metavar="HOST:PORT",
+                    help="scrape a running serve sidecar's /statusz "
+                         "(--metrics-port) and render the live status")
     args = ap.parse_args(argv)
+    if args.live:
+        return live_report(args.live, json_out=args.json)
     if args.compare:
         from .regress import run_compare
 

@@ -365,8 +365,9 @@ def fleet_timeline_stats(events: list[dict]) -> dict | None:
 # ---------------------------------------------------------------------------
 
 #: phase -> thread id inside each robot's process track.
-_PHASE_TID = {"compute": 0, "comms": 1, "solve": 2, "eval": 2}
-_TID_NAMES = {0: "compute", 1: "comms", 2: "solver", 3: "events"}
+_PHASE_TID = {"compute": 0, "comms": 1, "solve": 2, "eval": 2, "serve": 4}
+_TID_NAMES = {0: "compute", 1: "comms", 2: "solver", 3: "events",
+              4: "serving"}
 
 #: Events rendered as instants on the timeline.
 _INSTANT_EVENTS = ("peer_lost", "solve_start", "solve_end", "run_start",
@@ -374,10 +375,15 @@ _INSTANT_EVENTS = ("peer_lost", "solve_start", "solve_end", "run_start",
 
 
 def _pid(robot) -> int:
-    """Track id: 0 = host/driver, 1 = bus hub, 2+r = robot r."""
+    """Track id: 0 = host/driver, 1 = bus hub, 2+r = robot r.  The
+    serving-plane origin sentinels (<= -3, ``comms.protocol.ORIGIN_SERVE_*``)
+    map onto the host track — serve spans carry no robot, so their flow
+    arrows must start where the spans render."""
     if robot is None:
         return 0
     robot = int(robot)
+    if robot <= -3:
+        return 0
     return 1 if robot < 0 else 2 + robot
 
 

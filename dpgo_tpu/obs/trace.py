@@ -206,19 +206,28 @@ def span(name: str, phase: str | None = None, robot: int | None = None,
 
 def emit_span(run, name: str, t0_mono: float, t0_wall: float, dur_s: float,
               phase: str | None = None, robot: int | None = None,
-              link=None, **counters) -> None:
+              link=None, trace_id: int | None = None,
+              parent_id: int | None = None, **counters) -> None:
     """Emit a complete span from already-measured times — for hot paths
     (``PGOAgent.iterate``, the eval readback) that time themselves and
     must not pay a second clock read.  ``run`` is the caller's
-    already-resolved ambient run (the caller's guard IS the fence)."""
+    already-resolved ambient run (the caller's guard IS the fence).
+
+    ``trace_id``/``parent_id`` pin the span into an explicit trace instead
+    of the thread-local one — the serving plane's worker thread emits
+    per-request spans (queue wait, reply) into each request's trace this
+    way, because the request's trace lives on the submitter's thread, not
+    the worker's."""
     parent = current_span()
+    if trace_id is None:
+        trace_id = parent.trace_id if parent is not None else new_id()
+    if parent_id is None and parent is not None:
+        parent_id = parent.span_id
     fields = {"name": str(name), "t0_mono": float(t0_mono),
               "t0_wall": float(t0_wall), "dur_s": float(dur_s),
-              "span": _hex(new_id()),
-              "trace": _hex(parent.trace_id if parent is not None
-                            else new_id())}
-    if parent is not None:
-        fields["parent"] = _hex(parent.span_id)
+              "span": _hex(new_id()), "trace": _hex(trace_id)}
+    if parent_id:
+        fields["parent"] = _hex(parent_id)
     if robot is not None:
         fields["robot"] = int(robot)
     if link is not None:

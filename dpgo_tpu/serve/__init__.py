@@ -18,6 +18,12 @@ as a *shared backend* rather than a per-robot binary:
   ``dpgo_tpu.obs``.
 * ``frontend`` — the TCP front-end over ``comms.transport.TcpTransport``
   (length-prefixed packed frames; g2o problem upload, result download).
+* ``statusz`` — the live observability sidecar: ``/metrics`` (Prometheus
+  scrape of the run's registry), ``/healthz``, ``/statusz`` (queue /
+  tenant / cache / SLO-burn JSON, shared with ``report --live``);
+  requests are traced end to end (admission -> queue -> dispatch ->
+  reply spans with batch-mate flow arrows) and compiles profiled
+  (``obs.profile``) — all of it only when a telemetry run is live.
 
 Quickstart (in-process)::
 
@@ -34,7 +40,7 @@ TCP: ``python -m dpgo_tpu.serve --port 0`` then
 from .bucketing import BucketShape, bucket_shape_of, pad_problem
 from .cache import ExecutableCache, problem_fingerprint
 from .runner import run_bucket
-from .server import (OverCapacityError, SolveRequest, SolveServer,
+from .server import (OverCapacityError, ServeSLO, SolveRequest, SolveServer,
                      SolveTicket)
 
 __all__ = [
@@ -45,6 +51,7 @@ __all__ = [
     "problem_fingerprint",
     "run_bucket",
     "OverCapacityError",
+    "ServeSLO",
     "SolveRequest",
     "SolveServer",
     "SolveTicket",

@@ -21,7 +21,7 @@ import time
 
 from .. import obs
 from .frontend import ServeFrontend
-from .server import SolveServer
+from .server import ServeSLO, SolveServer
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -47,24 +47,49 @@ def main(argv: list[str] | None = None) -> int:
                     help="outgoing wire format (receives auto-detect)")
     ap.add_argument("--telemetry", metavar="DIR", default=None,
                     help="write a telemetry run (SLO metrics/events) here")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve live /metrics, /healthz, and /statusz on "
+                         "this port (0 = OS-assigned, printed once bound; "
+                         "requires --telemetry — there is no registry to "
+                         "scrape without a run)")
+    ap.add_argument("--slo-latency-s", type=float, default=None,
+                    help="per-request latency objective: enables burn-rate "
+                         "SLO alerting for every tenant")
+    ap.add_argument("--profile-dir", metavar="DIR", default=None,
+                    help="capture a jax.profiler trace of the first "
+                         "--profile-batches batched dispatches here")
+    ap.add_argument("--profile-batches", type=int, default=3)
     args = ap.parse_args(argv)
 
+    slo = ServeSLO(latency_s=args.slo_latency_s) \
+        if args.slo_latency_s is not None else None
     scope = obs.run_scope(args.telemetry) if args.telemetry else None
     run = scope.__enter__() if scope else None
     try:
         with SolveServer(max_batch=args.max_batch, max_queue=args.max_queue,
                          batch_window_s=args.batch_window_ms / 1e3,
                          tenant_quota=args.tenant_quota,
-                         quantum=args.quantum) as server:
+                         quantum=args.quantum, slo=slo,
+                         metrics_port=args.metrics_port,
+                         profile_dir=args.profile_dir,
+                         profile_batches=args.profile_batches) as server:
             with ServeFrontend(
                     server, host=args.host, port=args.port,
                     max_frame_bytes=int(args.max_frame_mb * 2 ** 20),
                     wire_format=args.wire) as fe:
                 print(f"listening on {fe.host}:{fe.port}", flush=True)
+                if server.sidecar is not None:
+                    print(f"metrics on {server.sidecar.host}:"
+                          f"{server.sidecar.port}", flush=True)
+                elif args.metrics_port is not None:
+                    print("metrics sidecar DISABLED (no --telemetry run "
+                          "to scrape)", flush=True)
                 if run is not None:
                     run.event("serve_listen", phase="serve", host=fe.host,
                               port=fe.port,
-                              max_frame_bytes=fe.max_frame_bytes)
+                              max_frame_bytes=fe.max_frame_bytes,
+                              metrics_port=server.sidecar.port
+                              if server.sidecar else None)
                 try:
                     while True:
                         time.sleep(1.0)

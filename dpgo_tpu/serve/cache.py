@@ -72,30 +72,69 @@ class ExecutableCache:
     ``get`` returns the cached executable for ``fp`` or invokes
     ``builder()`` exactly once and caches its result.  ``compiles`` counts
     builder invocations — the observable the bucketing tests pin: a stream
-    of identical-fingerprint requests must leave it flat."""
+    of identical-fingerprint requests must leave it flat.
+
+    Single-flight: concurrent ``get``\\ s on the same key run ONE builder;
+    the rest wait on its completion and count as hits.  Builds still run
+    outside the cache lock (builders trigger long XLA compiles, and two
+    different keys must compile concurrently); per-key in-flight events
+    provide the exclusion.  A builder that raises clears its in-flight
+    marker so waiters (and retries) attempt the build themselves.
+    """
 
     def __init__(self):
         self._lock = threading.Lock()
         self._entries: dict[str, object] = {}
+        self._building: dict[str, threading.Event] = {}
         self.compiles = 0
         self.hits = 0
 
     def get(self, fp: dict, builder):
         key = fingerprint_key(fp)
+        while True:
+            with self._lock:
+                if key in self._entries:
+                    self.hits += 1
+                    entry = self._entries[key]
+                    break
+                pending = self._building.get(key)
+                if pending is None:
+                    pending = self._building[key] = threading.Event()
+                    entry = None
+                    break
+            # Another thread is compiling this key: wait for it, then
+            # re-check (it may have failed, in which case we build).
+            pending.wait()
+        if entry is not None:
+            self._obs("hit")
+            return entry
+        try:
+            built = builder()
+        except BaseException:
+            with self._lock:
+                self._building.pop(key, None)
+            pending.set()
+            raise
         with self._lock:
-            if key in self._entries:
-                self.hits += 1
-                return self._entries[key]
-        # Build outside the lock (builders may themselves trigger long XLA
-        # compiles); a racing duplicate build is wasted work, not an error.
-        built = builder()
-        with self._lock:
-            if key not in self._entries:
-                self._entries[key] = built
-                self.compiles += 1
-            else:
-                self.hits += 1
-            return self._entries[key]
+            self._entries[key] = built
+            self.compiles += 1
+            self._building.pop(key, None)
+        pending.set()
+        self._obs("compile")
+        return built
+
+    def _obs(self, outcome: str) -> None:
+        """Mirror hit/compile tallies as Prometheus counters so the live
+        ``/metrics`` endpoint carries them (zero-overhead fence: resolved
+        per call, nothing constructed with telemetry off)."""
+        from .. import obs
+
+        run = obs.get_run()
+        if run is None:
+            return
+        run.counter("serve_cache_requests_total",
+                    "executable-cache lookups by outcome").inc(
+            outcome=outcome)
 
     def __len__(self) -> int:
         with self._lock:

@@ -21,10 +21,14 @@ import threading
 
 import numpy as np
 
-from ..comms.protocol import DEFAULT_MAX_FRAME_BYTES, ProtocolError
+from .. import obs
+from ..comms.protocol import (DEFAULT_MAX_FRAME_BYTES, ORIGIN_SERVE_CLIENT,
+                              ProtocolError, pack_trace_entries,
+                              unpack_trace_entries)
 from ..comms.transport import (TcpTransport, TransportClosed,
                                TransportTimeout, connect_tcp, listen_tcp)
 from ..config import AgentParams
+from ..obs import trace as obs_trace
 from ..utils.g2o import read_g2o
 from .server import OverCapacityError, SolveRequest, SolveServer
 
@@ -39,7 +43,29 @@ def _unpack_str(a) -> str:
 
 def handle_request(server: SolveServer, frame: dict) -> dict:
     """One request frame -> one reply frame (in-process; the wire layer
-    above is a pass-through)."""
+    above is a pass-through).
+
+    Pops the optional wire trace context the client stamped
+    (``comms.protocol.unpack_trace_entries`` — old/untraced clients simply
+    carry none) and, with telemetry on, wraps the request in a
+    ``frontend`` span on the client's trace; ``SolveServer.submit``'s
+    admission span then nests under it, so the Perfetto timeline runs
+    from TCP receive to reply on one trace id."""
+    ctx = unpack_trace_entries(frame)
+    run = obs.get_run()
+    if run is None:
+        return _handle_request(server, frame, None)
+    sp = obs_trace.Span(run, "frontend", phase="serve",
+                        trace_id=ctx[0] if ctx is not None else None,
+                        link=ctx)
+    with sp:
+        reply = _handle_request(server, frame, ctx)
+        if "ok" in reply:
+            sp.add(ok=int(np.asarray(reply["ok"])))
+        return reply
+
+
+def _handle_request(server: SolveServer, frame: dict, ctx) -> dict:
     op = _unpack_str(frame["op"]) if "op" in frame else "solve"
     if op == "ping":
         return {"ok": np.int8(1)}
@@ -63,6 +89,7 @@ def handle_request(server: SolveServer, frame: dict) -> dict:
             if "grad_norm_tol" in frame else 0.1,
             eval_every=int(np.asarray(frame["eval_every"]))
             if "eval_every" in frame else 1,
+            trace_ctx=ctx,
         )
         res = server.submit(req).result()
     except OverCapacityError as e:
@@ -189,6 +216,14 @@ def solve_g2o(host: str, port: int, g2o, num_robots: int,
         frame["max_iters"] = np.int32(max_iters)
     if deadline_s is not None:
         frame["deadline_s"] = np.float64(deadline_s)
+    # Request-scoped trace context: with telemetry on in the CLIENT
+    # process, the whole round-trip is one span and its ids ride the
+    # frame, so the server's spans join this trace (telemetry off:
+    # byte-identical frames, no span).
+    sp = obs_trace.start_span("solve_g2o", phase="serve")
+    if sp is not None:
+        frame.update(pack_trace_entries(sp.trace_id, sp.span_id,
+                                        ORIGIN_SERVE_CLIENT))
     sock = connect_tcp(host, port)
     tr = TcpTransport(sock, src="serve-client",
                       max_frame_bytes=max_frame_bytes,
@@ -198,6 +233,8 @@ def solve_g2o(host: str, port: int, g2o, num_robots: int,
         reply = tr.recv(timeout=timeout)
     finally:
         tr.close()
+        if sp is not None:
+            sp.end(host=host, port=int(port), tenant=tenant)
     out = {"ok": bool(int(np.asarray(reply["ok"])))}
     if out["ok"]:
         out["T"] = np.asarray(reply["T"])

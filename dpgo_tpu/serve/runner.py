@@ -15,7 +15,12 @@ occupancy instead of one per exact batch size.
 
 Executables come from the caller's ``ExecutableCache`` keyed by the
 config fingerprint (``cache.problem_fingerprint``): segment, metrics, and
-finalize programs are each cached independently.
+finalize programs are each cached independently.  With telemetry on, the
+cached entries are ``obs.profile.ProfiledExecutable``\\ s (AOT compile
+wall-time + XLA cost/memory analysis recorded per fingerprint key), each
+dispatch window times itself into ``serve_dispatch_device_seconds``, and
+the stack/dispatch/slice stages emit spans under the server's per-batch
+``dispatch`` span; with telemetry off none of that machinery exists.
 
 Termination mirrors ``run_rbcd``: per problem, the centralized gradient
 norm against ``grad_norm_tol`` or all-agents consensus; the batch keeps
@@ -27,15 +32,19 @@ eval.
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..config import RobustCostType
 from ..models import rbcd
+from ..obs.trace import span
 from ..ops import manifold, quadratic
 from .bucketing import PaddedProblem
-from .cache import ExecutableCache, problem_fingerprint
+from .cache import ExecutableCache, fingerprint_key, problem_fingerprint
 
 
 def _tree_stack(trees):
@@ -80,6 +89,24 @@ def _make_finalize_exec(meta: rbcd.GraphMeta, n_total: int, num_meas: int):
     return jax.jit(jax.vmap(one))
 
 
+def _cached_exec(cache: ExecutableCache, fp: dict, make,
+                 static_names: tuple = ()):
+    """Cache lookup with the compile-profiling wrap applied behind the
+    telemetry fence: with a run live, the cached entry is a
+    ``ProfiledExecutable`` (AOT compile + cost/memory analysis recorded
+    per fingerprint key); with telemetry off the bare jit wrapper is
+    stored and no profiling object ever exists."""
+    run = obs.get_run()
+    if run is None:
+        return cache.get(fp, make)
+    from ..obs.profile import ProfiledExecutable
+
+    return cache.get(fp, lambda: ProfiledExecutable(
+        make(), key=fingerprint_key(fp), label=fp.get("kind", "?"),
+        static_names=static_names,
+        bucket=fp.get("bucket_shape"), batch=fp.get("batch")))
+
+
 def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
                max_iters: int | None = None, grad_norm_tol: float = 0.1,
                eval_every: int = 1):
@@ -105,26 +132,28 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
 
     B_real = len(padded)
     B = _next_pow2(B_real)
-    states = [rbcd.init_state(p.graph, meta, p.X0, params=params)
-              for p in padded]
-    graphs = [p.graph for p in padded]
-    edges_g = [p.edges_g for p in padded]
-    while len(states) < B:  # replicate the tail to the pow2 width
-        states.append(states[B_real - 1])
-        graphs.append(graphs[B_real - 1])
-        edges_g.append(edges_g[B_real - 1])
-    state_b = _tree_stack(states)
-    graph_b = _tree_stack(graphs)
-    eg_b = _tree_stack(edges_g)
+    with span("stack", phase="serve", batch=B, size=B_real):
+        states = [rbcd.init_state(p.graph, meta, p.X0, params=params)
+                  for p in padded]
+        graphs = [p.graph for p in padded]
+        edges_g = [p.edges_g for p in padded]
+        while len(states) < B:  # replicate the tail to the pow2 width
+            states.append(states[B_real - 1])
+            graphs.append(graphs[B_real - 1])
+            edges_g.append(edges_g[B_real - 1])
+        state_b = _tree_stack(states)
+        graph_b = _tree_stack(graphs)
+        eg_b = _tree_stack(edges_g)
 
-    seg = cache.get(
-        problem_fingerprint(meta, params, dtype, shape, B, "segment"),
-        lambda: _make_segment_exec(meta, params))
-    met = cache.get(
-        problem_fingerprint(meta, params, dtype, shape, B, "metrics"),
+    seg = _cached_exec(
+        cache, problem_fingerprint(meta, params, dtype, shape, B, "segment"),
+        lambda: _make_segment_exec(meta, params),
+        static_names=("uw", "rs"))
+    met = _cached_exec(
+        cache, problem_fingerprint(meta, params, dtype, shape, B, "metrics"),
         lambda: _make_metrics_exec(meta, shape.n_total, shape.num_meas))
-    fin = cache.get(
-        problem_fingerprint(meta, params, dtype, shape, B, "finalize"),
+    fin = _cached_exec(
+        cache, problem_fingerprint(meta, params, dtype, shape, B, "finalize"),
         lambda: _make_finalize_exec(meta, shape.n_total, shape.num_meas))
 
     robust_on = params.robust.cost_type != RobustCostType.L2
@@ -138,17 +167,32 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
     gn_hist = [[] for _ in range(B_real)]
     term = ["max_iters"] * B_real
     iters = [max_iters] * B_real
+    run = obs.get_run()
     while it < max_iters and not all(done):
         target = min(((it // eval_every) + 1) * eval_every, max_iters)
-        while it < target:
-            uw, rs, end = rbcd.schedule_bounds(
-                it, nwu, max_iters=max_iters, eval_every=eval_every,
-                params=params, robust_on=robust_on, accel_on=accel_on)
-            nwu += int(uw)
-            state_b = seg(state_b, graph_b, end - it, uw=uw, rs=rs)
-            it = end
-        vec = np.asarray(met(state_b.X, state_b.weights, state_b.ready,
-                             graph_b, eg_b))
+        t_d0 = time.monotonic() if run is not None else 0.0
+        with span("device_dispatch", phase="serve", batch=B):
+            while it < target:
+                uw, rs, end = rbcd.schedule_bounds(
+                    it, nwu, max_iters=max_iters, eval_every=eval_every,
+                    params=params, robust_on=robust_on, accel_on=accel_on)
+                nwu += int(uw)
+                state_b = seg(state_b, graph_b, end - it, uw=uw, rs=rs)
+                it = end
+            # The metrics readback is the batch's existing sync point —
+            # timing to here measures dispatch -> materialized without
+            # adding a transfer or a block_until_ready.
+            vec = np.asarray(met(state_b.X, state_b.weights, state_b.ready,
+                                 graph_b, eg_b))
+        if run is not None:
+            dt = time.monotonic() - t_d0
+            run.gauge("serve_dispatch_device_seconds",
+                      "wall-clock of the last batched dispatch window "
+                      "(segment launches through metrics readback)",
+                      unit="s").set(dt)
+            run.counter("serve_device_time_seconds_total",
+                        "cumulative batched-dispatch wall-clock",
+                        unit="s").inc(dt)
         evals += 1
         for b in range(B_real):
             if done[b]:
@@ -161,10 +205,11 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
             elif consensus > 0:
                 done[b], term[b], iters[b] = True, "consensus", it
 
-    T_b, w_b = fin(state_b.X, state_b.weights, graph_b)
-    T_b = np.asarray(T_b)
-    w_b = np.asarray(w_b)
-    X_b = np.asarray(state_b.X)
+    with span("slice", phase="serve", batch=B):
+        T_b, w_b = fin(state_b.X, state_b.weights, graph_b)
+        T_b = np.asarray(T_b)
+        w_b = np.asarray(w_b)
+        X_b = np.asarray(state_b.X)
     results = []
     for b, p in enumerate(padded):
         results.append(rbcd.RBCDResult(

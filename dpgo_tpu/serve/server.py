@@ -21,9 +21,28 @@ Per-tenant SLO metrics ride the ambient telemetry run (``dpgo_tpu.obs``)
 when one is installed: ``serve_request`` / ``serve_batch`` /
 ``serve_shed`` events (the schema the report CLI's "serving" section and
 ``bench_serving.py`` share) plus queue-wait/latency histograms, an
-occupancy gauge, and request/shed counters.  With telemetry off the
-entire path constructs no obs objects — every metrics site sits behind
-``obs.get_run() is not None``, same fence as the solver core.
+occupancy gauge, and request/shed counters.  On top of that sit four
+operability layers, all telemetry-on only:
+
+* **request tracing** — every request runs on one trace: ``admission``
+  (submit), ``prepare``/``queue_wait`` (worker), a shared per-batch
+  ``dispatch`` span with ``batch_member`` flow links in and ``reply``
+  links out, and a reason-tagged ``shed`` span for requests that never
+  dispatch (see ``docs/ARCHITECTURE.md`` "Serving observability");
+* **live endpoints** — ``metrics_port`` starts the ``statusz`` sidecar
+  (``/metrics``, ``/healthz``, ``/statusz`` from ``status()``);
+* **SLO burn-rate alerting** — ``slo=ServeSLO(...)`` (or per-tenant
+  dict) evaluates rolling-window latency/shed burn rates, exporting
+  ``serve_slo_burn_rate`` gauges and emitting ``slo_burn`` anomalies
+  through ``obs.health`` on level transitions;
+* **profiling** — the executable cache wraps compiles with AOT
+  cost/memory analysis (``obs.profile``), and ``profile_dir`` opens a
+  ``jax.profiler`` window over the first ``profile_batches`` dispatches.
+
+With telemetry off the entire path constructs no obs objects — every
+metrics site sits behind ``obs.get_run() is not None``, same fence as
+the solver core — and no sidecar thread, profiler, or SLO tracker
+exists even when their knobs are set.
 """
 
 from __future__ import annotations
@@ -36,12 +55,93 @@ from collections import deque
 import jax.numpy as jnp
 
 from .. import obs
+from ..comms.protocol import ORIGIN_SERVE_SERVER
 from ..config import AgentParams
 from ..models.rbcd import prepare_problem
+from ..obs import trace as obs_trace
 from ..types import Measurements
 from .bucketing import bucket_shape_of, pad_problem
 from .cache import ExecutableCache, fingerprint_key, problem_fingerprint
 from .runner import run_bucket
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeSLO:
+    """Per-tenant service-level objectives, evaluated as burn rates.
+
+    A request is *good* when its submit->result latency is at most
+    ``latency_s``; the latency objective demands a ``latency_target``
+    fraction of good requests, leaving an error budget of
+    ``1 - latency_target``.  The burn rate is the observed bad fraction
+    over the rolling ``window_s`` window divided by that budget — 1.0
+    means exactly consuming budget, 10x means the budget burns in a tenth
+    of the window (the classic multi-window alerting vocabulary).  The
+    shed objective budgets the fraction of admissions-or-sheds that were
+    shed.  Crossing ``burn_warning``/``burn_critical`` emits one
+    structured ``slo_burn`` anomaly event per level transition through
+    ``obs.health``'s callback/policy machinery; recovery emits
+    ``slo_recovered``."""
+
+    latency_s: float = 1.0
+    latency_target: float = 0.99
+    shed_target: float = 0.01
+    window_s: float = 60.0
+    burn_warning: float = 1.0
+    burn_critical: float = 10.0
+
+
+class _SloTracker:
+    """Rolling-window burn-rate state for one tenant.
+
+    Pure host-side bookkeeping over event timestamps the serving metrics
+    already collect; constructed only behind the telemetry fence (the
+    zero-overhead boom test patches ``__init__``)."""
+
+    def __init__(self, slo: ServeSLO):
+        self.slo = slo
+        self._lat: deque = deque()    # (t_mono, was_slow)
+        self._shed: deque = deque()   # t_mono
+        self.level: dict[str, str | None] = {"latency": None, "shed": None}
+
+    def _trim(self, now: float) -> None:
+        cutoff = now - self.slo.window_s
+        for dq in (self._lat, self._shed):
+            while dq:
+                head = dq[0]
+                t = head[0] if isinstance(head, tuple) else head
+                if t >= cutoff:
+                    break
+                dq.popleft()
+
+    def observe_request(self, now: float, latency_s: float) -> None:
+        self._lat.append((now, latency_s > self.slo.latency_s))
+        self._trim(now)
+
+    def observe_shed(self, now: float) -> None:
+        self._shed.append(now)
+        self._trim(now)
+
+    def burn(self, now: float) -> dict:
+        """Current burn rates and window tallies."""
+        self._trim(now)
+        total = len(self._lat)
+        slow = sum(1 for _, bad in self._lat if bad)
+        shed = len(self._shed)
+        lat_budget = max(1e-9, 1.0 - self.slo.latency_target)
+        shed_budget = max(1e-9, self.slo.shed_target)
+        lat_burn = (slow / total) / lat_budget if total else 0.0
+        seen = total + shed
+        shed_burn = (shed / seen) / shed_budget if seen else 0.0
+        return {"latency_burn": lat_burn, "shed_burn": shed_burn,
+                "requests": total, "slow": slow, "shed": shed,
+                "window_s": self.slo.window_s}
+
+    def classify(self, burn: float) -> str | None:
+        if burn >= self.slo.burn_critical:
+            return "critical"
+        if burn >= self.slo.burn_warning:
+            return "warning"
+        return None
 
 
 class OverCapacityError(RuntimeError):
@@ -74,6 +174,12 @@ class SolveRequest:
     grad_norm_tol: float = 0.1
     eval_every: int = 1
     dtype: object = jnp.float64
+    #: Wire trace context ``(trace_id, span_id, origin, t_mono, t_wall)``
+    #: from ``comms.protocol.unpack_trace_entries`` — the front-end passes
+    #: the client's stamped context through so the request's server-side
+    #: spans join the client's trace.  None (default, and always with
+    #: telemetry off) starts a fresh trace per request.
+    trace_ctx: tuple | None = None
 
 
 class SolveTicket:
@@ -82,6 +188,7 @@ class SolveTicket:
     def __init__(self, request: SolveRequest):
         self.request = request
         self.t_submit = time.monotonic()
+        self.t_submit_wall = time.time()
         self.t_dispatch: float | None = None
         self.t_done: float | None = None
         self._event = threading.Event()
@@ -90,6 +197,9 @@ class SolveTicket:
         # worker-side scratch
         self._padded = None
         self._key: str | None = None
+        # tracing context (set by submit() only when telemetry is on)
+        self.trace_id: int | None = None
+        self.span_admission: int | None = None
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -128,7 +238,12 @@ class SolveServer:
     def __init__(self, max_batch: int = 8, max_queue: int = 64,
                  batch_window_s: float = 0.005,
                  tenant_quota: int | None = None, quantum: int = 32,
-                 init: str = "chordal"):
+                 init: str = "chordal",
+                 slo: "ServeSLO | dict[str, ServeSLO] | None" = None,
+                 metrics_port: int | None = None,
+                 metrics_host: str = "127.0.0.1",
+                 profile_dir: str | None = None,
+                 profile_batches: int = 3):
         if max_batch < 1 or max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
         self.max_batch = int(max_batch)
@@ -137,15 +252,40 @@ class SolveServer:
         self.tenant_quota = tenant_quota
         self.quantum = int(quantum)
         self.init = init
+        #: One ``ServeSLO`` for every tenant, or a per-tenant dict (the
+        #: ``"default"`` key, when present, covers unlisted tenants).
+        self.slo = slo
         self.cache = ExecutableCache()
         self._cond = threading.Condition()
         self._pending: deque[SolveTicket] = deque()
         self._inflight: dict[str, int] = {}
         self._closed = False
+        self._t0_mono = time.monotonic()
+        # Plain-int liveness tallies for /statusz (server state, not obs).
+        self._n_batches = 0
+        self._n_requests = 0
+        self._n_shed = 0
+        self._last_batch: dict | None = None
+        self._slo_state: dict[str, _SloTracker] = {}
+        self.sidecar = None
+        self._profiler = None
         run = obs.get_run()
         if run is not None:
             run.set_fingerprint(serve_max_batch=self.max_batch,
                                 serve_quantum=self.quantum)
+            # Live endpoints and the device profiler exist only on the
+            # telemetry-on path: with no run there is no registry to
+            # scrape and the fence demands zero extra threads.
+            if metrics_port is not None:
+                from .statusz import MetricsSidecar
+
+                self.sidecar = MetricsSidecar(self, run, host=metrics_host,
+                                              port=metrics_port)
+            if profile_dir is not None:
+                from ..obs.profile import ProfilerWindow
+
+                self._profiler = ProfilerWindow(profile_dir,
+                                                num_batches=profile_batches)
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="dpgo-serve-worker")
         self._worker.start()
@@ -155,26 +295,60 @@ class SolveServer:
     def submit(self, request: SolveRequest) -> SolveTicket:
         """Admit a request (or raise ``OverCapacityError``) and return its
         ticket.  Admission is synchronous and cheap; problem build happens
-        on the worker."""
+        on the worker.
+
+        With telemetry on, admission opens the request's root ``admission``
+        span: its trace id comes from the submitter's ambient span (the
+        front-end's per-connection ``frontend`` span) or the wire trace
+        context the client stamped (``request.trace_ctx``), so one trace
+        follows the request from TCP accept to reply.  A rejected request
+        closes the span tagged with the shed reason."""
         ticket = SolveTicket(request)
-        with self._cond:
-            if self._closed:
-                raise RuntimeError("server is closed")
-            if len(self._pending) >= self.max_queue:
-                self._obs_shed(request.tenant, "queue", 0.0)
-                raise OverCapacityError(
-                    f"queue full ({self.max_queue} requests pending)",
-                    reason="queue")
-            if self.tenant_quota is not None and \
-                    self._inflight.get(request.tenant, 0) >= self.tenant_quota:
-                self._obs_shed(request.tenant, "tenant_quota", 0.0)
-                raise OverCapacityError(
-                    f"tenant {request.tenant!r} at its in-flight quota "
-                    f"({self.tenant_quota})", reason="tenant_quota")
-            self._inflight[request.tenant] = \
-                self._inflight.get(request.tenant, 0) + 1
-            self._pending.append(ticket)
-            self._cond.notify_all()
+        run = obs.get_run()
+        sp = None
+        if run is not None:
+            ctx = request.trace_ctx
+            parent = obs_trace.current_span()
+            sp = obs_trace.Span(
+                run, "admission", phase="serve",
+                trace_id=(ctx[0] if ctx is not None and parent is None
+                          else None),
+                link=ctx if parent is None else None)
+            ticket.trace_id = sp.trace_id
+            ticket.span_admission = sp.span_id
+        try:
+            with self._cond:
+                if self._closed:
+                    raise RuntimeError("server is closed")
+                if len(self._pending) >= self.max_queue:
+                    self._obs_shed(request.tenant, "queue", 0.0)
+                    raise OverCapacityError(
+                        f"queue full ({self.max_queue} requests pending)",
+                        reason="queue")
+                if self.tenant_quota is not None and \
+                        self._inflight.get(request.tenant, 0) >= \
+                        self.tenant_quota:
+                    self._obs_shed(request.tenant, "tenant_quota", 0.0)
+                    raise OverCapacityError(
+                        f"tenant {request.tenant!r} at its in-flight quota "
+                        f"({self.tenant_quota})", reason="tenant_quota")
+                self._inflight[request.tenant] = \
+                    self._inflight.get(request.tenant, 0) + 1
+                self._pending.append(ticket)
+                queue_depth = len(self._pending)
+                self._cond.notify_all()
+        except OverCapacityError as e:
+            if sp is not None:
+                sp.end(tenant=request.tenant, outcome="rejected",
+                       reason=e.reason)
+            raise
+        except BaseException:
+            if sp is not None:
+                sp.end(tenant=request.tenant, outcome="error")
+            raise
+        if sp is not None:
+            sp.end(tenant=request.tenant, outcome="queued",
+                   queue_depth=queue_depth)
         return ticket
 
     def solve(self, request: SolveRequest, timeout: float | None = None):
@@ -209,6 +383,44 @@ class SolveServer:
             self._closed = True
             self._cond.notify_all()
         self._worker.join()
+        if self.sidecar is not None:
+            self.sidecar.close()
+        if self._profiler is not None:
+            self._profiler.close()
+
+    def status(self) -> dict:
+        """Live operational snapshot — the ``/statusz`` payload, shared
+        with ``python -m dpgo_tpu.obs.report --live``.  Plain server
+        state; safe to call with telemetry on or off."""
+        with self._cond:
+            queue_depth = len(self._pending)
+            inflight = dict(self._inflight)
+            closed = self._closed
+        tenants = {
+            t: {"in_flight": n, "quota": self.tenant_quota}
+            for t, n in sorted(inflight.items())
+        }
+        out = {
+            "uptime_s": time.monotonic() - self._t0_mono,
+            "closed": closed,
+            "queue_depth": queue_depth,
+            "max_queue": self.max_queue,
+            "max_batch": self.max_batch,
+            "quantum": self.quantum,
+            "tenants": tenants,
+            "requests_served": self._n_requests,
+            "batches_dispatched": self._n_batches,
+            "requests_shed": self._n_shed,
+            "last_batch": self._last_batch,
+            "cache": self.cache.stats(),
+        }
+        if self._slo_state:
+            now = time.monotonic()
+            out["slo"] = {t: {**trk.burn(now),
+                              "level": {k: v for k, v in trk.level.items()
+                                        if v is not None} or None}
+                          for t, trk in sorted(self._slo_state.items())}
+        return out
 
     def __enter__(self) -> "SolveServer":
         return self
@@ -253,7 +465,9 @@ class SolveServer:
             # Batching window: give concurrent submitters a moment to
             # coalesce before forming a batch (skip when already full).
             if n_pending < self.max_batch and self.batch_window_s > 0:
-                time.sleep(self.batch_window_s)
+                with obs_trace.span("coalesce", phase="serve",
+                                    pending=n_pending):
+                    time.sleep(self.batch_window_s)
             self._dispatch_once()
         for t in leftovers:
             t._finish(exception=OverCapacityError(
@@ -266,6 +480,7 @@ class SolveServer:
         if not snapshot:
             return
         now = time.monotonic()
+        run = obs.get_run()
         shed, failed = [], []
         for t in snapshot:
             dl = t.request.deadline_s
@@ -273,8 +488,14 @@ class SolveServer:
                 shed.append(t)
                 continue
             if t._padded is None:
+                sp = None
+                if run is not None and t.trace_id is not None:
+                    sp = obs_trace.Span(run, "prepare", phase="serve",
+                                        trace_id=t.trace_id,
+                                        parent_id=t.span_admission)
                 try:
-                    t._padded, t._key = self._prepare(t.request)
+                    with sp or obs_trace.NULL_SPAN:
+                        t._padded, t._key = self._prepare(t.request)
                 except Exception as e:  # bad request: report, don't die
                     t._finish(exception=e)
                     failed.append(t)
@@ -284,6 +505,14 @@ class SolveServer:
                 f"deadline ({t.request.deadline_s:.3f}s) expired after "
                 f"{waited:.3f}s in queue", reason="deadline"))
             self._obs_shed(t.request.tenant, "deadline", waited)
+            if run is not None and t.trace_id is not None:
+                # The request's trace closes with a reason-tagged span
+                # covering its whole queued life.
+                obs_trace.emit_span(
+                    run, "shed", t.t_submit, t.t_submit_wall, waited,
+                    phase="serve", trace_id=t.trace_id,
+                    parent_id=t.span_admission, reason="deadline",
+                    tenant=t.request.tenant)
         drop = set(shed) | set(failed)
         ready = [t for t in snapshot if t not in drop and t._padded is not None]
         batch = []
@@ -302,9 +531,37 @@ class SolveServer:
 
     def _run_batch(self, tickets: list[SolveTicket]) -> None:
         t0 = time.monotonic()
+        t0_wall = time.time()
         for t in tickets:
             t.t_dispatch = t0
         req0 = tickets[0].request
+        run = obs.get_run()
+        dsp = None
+        if run is not None:
+            # One shared dispatch span per batch; the runner's
+            # stack/device_dispatch/slice spans nest under it via the
+            # worker thread's span stack.  Each batch mate contributes a
+            # flow arrow: its queue-wait closes on its own trace, and a
+            # batch_member child span here links back to its admission
+            # span, so Perfetto draws N request lanes converging on the
+            # one batched executable.
+            dsp = obs_trace.Span(run, "dispatch", phase="serve")
+            dsp.add(size=len(tickets))
+            dsp.__enter__()
+            for t in tickets:
+                if t.trace_id is None:
+                    continue
+                obs_trace.emit_span(
+                    run, "queue_wait", t.t_submit, t.t_submit_wall,
+                    t0 - t.t_submit, phase="serve", trace_id=t.trace_id,
+                    parent_id=t.span_admission, tenant=t.request.tenant)
+                obs_trace.emit_span(
+                    run, "batch_member", t0, t0_wall, 0.0, phase="serve",
+                    tenant=t.request.tenant,
+                    link=(t.trace_id, t.span_admission,
+                          ORIGIN_SERVE_SERVER, t.t_submit, t.t_submit_wall))
+        if self._profiler is not None:
+            self._profiler.batch_begin()
         try:
             results, info = run_bucket(
                 [t._padded for t in tickets], self.cache,
@@ -314,16 +571,94 @@ class SolveServer:
             for t in tickets:
                 t._finish(exception=e)
             self._release(tickets)
+            if dsp is not None:
+                dsp.__exit__(type(e), e, None)
+            if self._profiler is not None:
+                self._profiler.batch_end()
             return
         for t, res in zip(tickets, results):
             t._finish(result=res)
         self._release(tickets)
-        self._obs_batch(tickets, results, info, time.monotonic() - t0)
+        if self._profiler is not None:
+            self._profiler.batch_end()
+        duration_s = time.monotonic() - t0
+        if dsp is not None:
+            dsp.add(rounds=info["rounds"], occupancy=info["occupancy"])
+            dsp.__exit__(None, None, None)
+            dispatch_ctx = (dsp.trace_id, dsp.span_id,
+                            ORIGIN_SERVE_SERVER, t0, t0_wall)
+            for t in tickets:
+                if t.trace_id is None:
+                    continue
+                # Reply span closes the request's trace, with a flow
+                # arrow in from the shared dispatch span.
+                obs_trace.emit_span(
+                    run, "reply", t.t_done, time.time(), 0.0,
+                    phase="serve", trace_id=t.trace_id,
+                    parent_id=t.span_admission, tenant=t.request.tenant,
+                    latency_s=t.latency_s, link=dispatch_ctx)
+        with self._cond:
+            self._n_batches += 1
+            self._n_requests += len(tickets)
+            self._last_batch = {"size": info["size"],
+                                "batch": info["batch"],
+                                "occupancy": info["occupancy"],
+                                "rounds": info["rounds"],
+                                "duration_s": duration_s}
+        self._obs_batch(tickets, results, info, duration_s)
 
     # -- telemetry (every site behind the zero-overhead fence) --------------
 
+    def _slo_for(self, tenant: str) -> "ServeSLO | None":
+        if self.slo is None:
+            return None
+        if isinstance(self.slo, ServeSLO):
+            return self.slo
+        return self.slo.get(tenant, self.slo.get("default"))
+
+    def _slo_tracker(self, tenant: str) -> "_SloTracker | None":
+        """The tenant's burn tracker (lazily created) — callers are
+        already behind the telemetry fence."""
+        slo = self._slo_for(tenant)
+        if slo is None:
+            return None
+        trk = self._slo_state.get(tenant)
+        if trk is None:
+            trk = self._slo_state[tenant] = _SloTracker(slo)
+        return trk
+
+    def _slo_evaluate(self, run, tenant: str, trk: "_SloTracker") -> None:
+        """Burn-rate gauges every evaluation; one ``slo_burn`` anomaly per
+        level transition (through ``obs.health``'s callback/abort/dump
+        machinery), one ``slo_recovered`` event on the way back down."""
+        now = time.monotonic()
+        burn = trk.burn(now)
+        g = run.gauge("serve_slo_burn_rate",
+                      "error-budget burn rate over the rolling SLO window "
+                      "(1.0 = consuming exactly the budget)")
+        for slo_kind, rate in (("latency", burn["latency_burn"]),
+                               ("shed", burn["shed_burn"])):
+            g.set(rate, tenant=tenant, slo=slo_kind)
+            level = trk.classify(rate)
+            prev = trk.level[slo_kind]
+            if level == prev:
+                continue
+            trk.level[slo_kind] = level
+            if level is not None:
+                obs.monitor_for(run).anomaly(
+                    "slo_burn", severity=level, tenant=tenant,
+                    slo=slo_kind, burn_rate=rate,
+                    window_s=trk.slo.window_s,
+                    requests=burn["requests"], slow=burn["slow"],
+                    shed=burn["shed"])
+            elif prev is not None:
+                run.event("slo_recovered", phase="serve", tenant=tenant,
+                          slo=slo_kind, burn_rate=rate)
+
     def _obs_shed(self, tenant: str, reason: str, waited_s: float) -> None:
         run = obs.get_run()
+        with self._cond:
+            self._n_shed += 1
         if run is None:
             return
         run.counter("serve_shed_total",
@@ -331,6 +666,10 @@ class SolveServer:
             tenant=tenant, reason=reason)
         run.event("serve_shed", phase="serve", tenant=tenant, reason=reason,
                   waited_s=waited_s)
+        trk = self._slo_tracker(tenant)
+        if trk is not None:
+            trk.observe_shed(time.monotonic())
+            self._slo_evaluate(run, tenant, trk)
 
     def _obs_batch(self, tickets, results, info, duration_s: float) -> None:
         run = obs.get_run()
@@ -362,3 +701,7 @@ class SolveServer:
                 cost=res.cost_history[-1] if res.cost_history else None,
                 grad_norm=res.grad_norm_history[-1]
                 if res.grad_norm_history else None)
+            trk = self._slo_tracker(tenant)
+            if trk is not None:
+                trk.observe_request(time.monotonic(), t.latency_s or 0.0)
+                self._slo_evaluate(run, tenant, trk)

@@ -47,6 +47,12 @@ def main(argv=None) -> int:
                     help="submit over the TCP front-end (g2o upload)")
     ap.add_argument("--max-frame-mb", type=float, default=64.0)
     ap.add_argument("--telemetry", metavar="DIR", default=None)
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="live /metrics,/healthz,/statusz sidecar "
+                         "(requires --telemetry); the example scrapes "
+                         "/statusz once and prints it")
+    ap.add_argument("--slo-latency-s", type=float, default=None,
+                    help="latency objective -> burn-rate SLO alerting")
     args = ap.parse_args(argv)
 
     problems = []
@@ -61,8 +67,12 @@ def main(argv=None) -> int:
     if scope:
         scope.__enter__()
     try:
-        with SolveServer(max_batch=8, batch_window_s=0.02,
-                         quantum=64) as srv:
+        from dpgo_tpu.serve import ServeSLO
+
+        with SolveServer(max_batch=8, batch_window_s=0.02, quantum=64,
+                         metrics_port=args.metrics_port,
+                         slo=ServeSLO(latency_s=args.slo_latency_s)
+                         if args.slo_latency_s is not None else None) as srv:
             if args.tcp:
                 with ServeFrontend(
                         srv,
@@ -101,6 +111,13 @@ def main(argv=None) -> int:
                           f"({res.iterations} rounds, {res.terminated_by}, "
                           f"waited {t.queue_wait_s * 1e3:.1f}ms)")
             print(f"executable cache: {srv.cache.stats()}")
+            if srv.sidecar is not None:
+                # The same JSON `report --live HOST:PORT` renders.
+                from dpgo_tpu.obs.report import render_statusz
+
+                print(f"live endpoints on {srv.sidecar.host}:"
+                      f"{srv.sidecar.port} (/metrics /healthz /statusz)")
+                print(render_statusz(srv.status()))
     finally:
         if scope:
             scope.__exit__(None, None, None)

@@ -301,15 +301,21 @@ def test_serving_slo_metrics_and_report_section(tmp_path):
     assert "serve_requests_total" in data["metrics"]
 
 
-def test_telemetry_off_serving_constructs_no_obs_objects(monkeypatch):
+def test_telemetry_off_serving_constructs_no_obs_objects(monkeypatch, tmp_path):
     """The zero-overhead acceptance gate, extended to the serve plane:
     with no ambient run, a full submit -> batch -> result cycle must
-    construct no obs objects and emit nothing."""
+    construct no obs objects and emit nothing — no spans, no HTTP
+    sidecar threads (even with metrics_port set), no device profiler
+    (even with profile_dir set), no SLO trackers, no AOT/cost_analysis
+    profiling wrappers."""
     import dpgo_tpu.obs.events as events_mod
     import dpgo_tpu.obs.health as health_mod
     import dpgo_tpu.obs.metrics as metrics_mod
+    import dpgo_tpu.obs.profile as profile_mod
     import dpgo_tpu.obs.run as run_mod
     import dpgo_tpu.obs.trace as trace_mod
+    import dpgo_tpu.serve.server as server_mod
+    import dpgo_tpu.serve.statusz as statusz_mod
 
     assert obs.get_run() is None
 
@@ -329,13 +335,25 @@ def test_telemetry_off_serving_constructs_no_obs_objects(monkeypatch):
     monkeypatch.setattr(trace_mod.Span, "__init__", boom)
     monkeypatch.setattr(trace_mod, "emit_span", boom)
     monkeypatch.setattr(health_mod.HealthMonitor, "__init__", boom)
+    monkeypatch.setattr(statusz_mod.MetricsSidecar, "__init__", boom)
+    monkeypatch.setattr(profile_mod.ProfiledExecutable, "__init__", boom)
+    monkeypatch.setattr(profile_mod.ProfilerWindow, "__init__", boom)
+    monkeypatch.setattr(profile_mod, "aot_compile_profile", boom)
+    monkeypatch.setattr(server_mod._SloTracker, "__init__", boom)
 
-    with SolveServer(max_batch=2, batch_window_s=0.005, quantum=64) as srv:
+    from dpgo_tpu.serve import ServeSLO
+
+    with SolveServer(max_batch=2, batch_window_s=0.005, quantum=64,
+                     metrics_port=0, profile_dir=str(tmp_path / "prof"),
+                     slo=ServeSLO(latency_s=1e-9)) as srv:
+        assert srv.sidecar is None
+        assert srv._profiler is None
         res = srv.solve(_request(_problem(n=24, seed=11)), timeout=300)
         # Shed paths are fenced too.
         t = srv.submit(_request(_problem(), deadline_s=0.0))
         with pytest.raises(OverCapacityError):
             t.result(timeout=30)
+        assert srv._slo_state == {}
     assert np.isfinite(res.cost_history[-1])
 
 
