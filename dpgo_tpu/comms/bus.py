@@ -231,14 +231,16 @@ class BusClient:
             channel.origin = self.robot_id  # clock-domain identity
         self.lost: set[int] = set()
         self.staleness = 0
+        # Overlap state is shared between the caller's compute thread and
+        # the exchange worker; everything below rides one condition.
         self._ov_cond = threading.Condition()
         self._ov_thread: threading.Thread | None = None
-        self._ov_queue: list[dict] = []
-        self._ov_merged: dict | None = None
-        self._ov_submitted = 0
-        self._ov_done = 0
-        self._ov_stop = False
-        self._ov_error: Exception | None = None
+        self._ov_queue: list[dict] = []                # guarded-by: _ov_cond
+        self._ov_merged: dict | None = None            # guarded-by: _ov_cond
+        self._ov_submitted = 0                         # guarded-by: _ov_cond
+        self._ov_done = 0                              # guarded-by: _ov_cond
+        self._ov_stop = False                          # guarded-by: _ov_cond
+        self._ov_error: Exception | None = None        # guarded-by: _ov_cond
 
     def hello(self, timeout: float | None = None) -> None:
         self.channel.send({"hello": np.asarray(self.robot_id, np.int64)},
@@ -325,7 +327,10 @@ class BusClient:
             # Staleness is a convergence-relevant knob: stamp it into the
             # fingerprint so --compare refuses lockstep-vs-overlap deltas.
             run.set_fingerprint(staleness=self.staleness)
-        self._ov_stop = False
+        with self._ov_cond:
+            # A previous worker may have died on an error mid-run; reset
+            # the shared flags under the lock it shares with exchange().
+            self._ov_stop = False
 
         def run():
             while True:
@@ -368,7 +373,8 @@ class BusClient:
         barrier at the end of an overlapped run); returns the last
         broadcast.  Raises the worker's pending error, if any."""
         if self._ov_thread is None:
-            return self._ov_merged
+            with self._ov_cond:
+                return self._ov_merged
         end = time.monotonic() + timeout
         with trace.span("drain", phase="comms", robot=self.robot_id):
             with self._ov_cond:

@@ -42,7 +42,7 @@ from ..config import SolverParams
 from ..types import EdgeSet, Measurements, edge_set_from_measurements
 from ..utils.lie import lifting_matrix
 from ..ops import manifold, quadratic, solver
-from .local_pgo import LocalSolveResult, make_problem, round_solution
+from .local_pgo import make_problem, round_solution
 
 
 # ---------------------------------------------------------------------------

@@ -31,12 +31,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import AgentParams, RobustCostType
-from ..types import Measurements, edge_set_from_measurements
+from ..types import edge_set_from_measurements
 from ..utils.lie import angular_to_chordal_so3
 from ..utils.partition import Partition
 from ..ops import averaging, chordal
 from .local_pgo import lift
-from .rbcd import GraphMeta, MultiAgentGraph, lifting_matrix, scatter_to_agents
+from .rbcd import GraphMeta, MultiAgentGraph, lifting_matrix
 
 
 def _se(R: np.ndarray, t: np.ndarray, d: int) -> np.ndarray:

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from ..config import SolverParams
 from ..types import EdgeSet, Measurements, edge_set_from_measurements
 from ..utils.lie import lifting_matrix, project_to_rotation
-from ..ops import chordal, manifold, quadratic, solver
+from ..ops import chordal, quadratic, solver
 
 
 def lift(T: jax.Array, ylift: jax.Array) -> jax.Array:

@@ -51,7 +51,7 @@ from ..ops import df32
 from ..ops.df32 import DF
 from ..types import EdgeSet
 from . import rbcd
-from .refine import RefineConstants, refine_round
+from .refine import RefineConstants
 
 
 class GlobalProblemDF(NamedTuple):

@@ -40,12 +40,12 @@ class _Family:
         self.name = name
         self.help = help
         self.unit = unit
-        self._series: dict[tuple, object] = {}
+        self._series: dict[tuple, object] = {}  # guarded-by: _lock
 
     def _zero(self):
         return 0.0
 
-    def _get(self, labels: dict):
+    def _get(self, labels: dict):  # holds: _lock
         key = _label_key(labels)
         series = self._series.get(key)
         if series is None:
@@ -161,7 +161,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._families: dict[str, _Family] = {}
+        self._families: dict[str, _Family] = {}  # guarded-by: _lock
 
     def _family(self, cls, name: str, help: str, unit: str, **kw) -> _Family:
         with self._lock:

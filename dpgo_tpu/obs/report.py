@@ -312,6 +312,11 @@ def live_report(target: str, json_out: bool = False, timeout: float = 5.0,
         with urllib.request.urlopen(url, timeout=timeout) as resp:
             status = json.load(resp)
     except (urllib.error.URLError, OSError, ValueError) as e:
+        # An HTTPError carries the open response body: close it on the
+        # error path too, the success path's `with` never ran
+        # (leakcheck-enforced contract).
+        if hasattr(e, "close"):
+            e.close()
         print(f"cannot scrape {url}: {e}", file=sys.stderr)
         return 2
     if json_out:

@@ -27,7 +27,6 @@ to XLA reduction order.
 
 from __future__ import annotations
 
-import dataclasses
 from functools import partial
 
 import jax
@@ -42,7 +41,7 @@ from ..utils.partition import Partition, partition_contiguous
 from ..utils.profiling import RoundTimer
 from ..models import rbcd
 from ..models.rbcd import (GraphMeta, MultiAgentGraph, RBCDState,
-                           centralized_chordal_init, init_state)
+                           init_state)
 
 AXIS = "agent"
 

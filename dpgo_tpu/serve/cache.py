@@ -84,10 +84,10 @@ class ExecutableCache:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._entries: dict[str, object] = {}
-        self._building: dict[str, threading.Event] = {}
-        self.compiles = 0
-        self.hits = 0
+        self._entries: dict[str, object] = {}           # guarded-by: _lock
+        self._building: dict[str, threading.Event] = {}  # guarded-by: _lock
+        self.compiles = 0                               # guarded-by: _lock
+        self.hits = 0                                   # guarded-by: _lock
 
     def get(self, fp: dict, builder):
         key = fingerprint_key(fp)

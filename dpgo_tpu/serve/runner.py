@@ -182,8 +182,9 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
             # The metrics readback is the batch's existing sync point —
             # timing to here measures dispatch -> materialized without
             # adding a transfer or a block_until_ready.
+            # dpgolint: disable=DPG003 -- sanctioned seam: the batch's one
             vec = np.asarray(met(state_b.X, state_b.weights, state_b.ready,
-                                 graph_b, eg_b))
+                                 graph_b, eg_b))  # metrics fetch per eval
         if run is not None:
             dt = time.monotonic() - t_d0
             run.gauge("serve_dispatch_device_seconds",

@@ -256,39 +256,51 @@ class SolveServer:
         #: ``"default"`` key, when present, covers unlisted tenants).
         self.slo = slo
         self.cache = ExecutableCache()
+        # One condition serializes ALL cross-thread server state: client
+        # threads (submit/status/sidecar scrapes), the worker, and close.
         self._cond = threading.Condition()
-        self._pending: deque[SolveTicket] = deque()
-        self._inflight: dict[str, int] = {}
-        self._closed = False
+        self._pending: deque[SolveTicket] = deque()   # guarded-by: _cond
+        self._inflight: dict[str, int] = {}           # guarded-by: _cond
+        self._closed = False                          # guarded-by: _cond
         self._t0_mono = time.monotonic()
         # Plain-int liveness tallies for /statusz (server state, not obs).
-        self._n_batches = 0
-        self._n_requests = 0
-        self._n_shed = 0
-        self._last_batch: dict | None = None
-        self._slo_state: dict[str, _SloTracker] = {}
+        self._n_batches = 0                           # guarded-by: _cond
+        self._n_requests = 0                          # guarded-by: _cond
+        self._n_shed = 0                              # guarded-by: _cond
+        self._last_batch: dict | None = None          # guarded-by: _cond
+        self._slo_state: dict[str, _SloTracker] = {}  # guarded-by: _cond
         self.sidecar = None
         self._profiler = None
         run = obs.get_run()
-        if run is not None:
-            run.set_fingerprint(serve_max_batch=self.max_batch,
-                                serve_quantum=self.quantum)
-            # Live endpoints and the device profiler exist only on the
-            # telemetry-on path: with no run there is no registry to
-            # scrape and the fence demands zero extra threads.
-            if metrics_port is not None:
-                from .statusz import MetricsSidecar
+        try:
+            if run is not None:
+                run.set_fingerprint(serve_max_batch=self.max_batch,
+                                    serve_quantum=self.quantum)
+                # Live endpoints and the device profiler exist only on the
+                # telemetry-on path: with no run there is no registry to
+                # scrape and the fence demands zero extra threads.
+                if metrics_port is not None:
+                    from .statusz import MetricsSidecar
 
-                self.sidecar = MetricsSidecar(self, run, host=metrics_host,
-                                              port=metrics_port)
-            if profile_dir is not None:
-                from ..obs.profile import ProfilerWindow
+                    self.sidecar = MetricsSidecar(self, run,
+                                                  host=metrics_host,
+                                                  port=metrics_port)
+                if profile_dir is not None:
+                    from ..obs.profile import ProfilerWindow
 
-                self._profiler = ProfilerWindow(profile_dir,
-                                                num_batches=profile_batches)
-        self._worker = threading.Thread(target=self._loop, daemon=True,
-                                        name="dpgo-serve-worker")
-        self._worker.start()
+                    self._profiler = ProfilerWindow(
+                        profile_dir, num_batches=profile_batches)
+            self._worker = threading.Thread(target=self._loop, daemon=True,
+                                            name="dpgo-serve-worker")
+            self._worker.start()
+        except BaseException:
+            # A half-constructed server must not strand the sidecar's
+            # HTTP thread + bound socket (leakcheck-enforced contract).
+            if self.sidecar is not None:
+                self.sidecar.close()
+            if self._profiler is not None:
+                self._profiler.close()
+            raise
 
     # -- client API ---------------------------------------------------------
 
@@ -396,6 +408,19 @@ class SolveServer:
             queue_depth = len(self._pending)
             inflight = dict(self._inflight)
             closed = self._closed
+            n_requests = self._n_requests
+            n_batches = self._n_batches
+            n_shed = self._n_shed
+            last_batch = dict(self._last_batch) if self._last_batch else None
+            slo = None
+            if self._slo_state:
+                # Burn computation trims the trackers' rolling windows —
+                # a mutation, so it stays under the lock with the rest.
+                now = time.monotonic()
+                slo = {t: {**trk.burn(now),
+                           "level": {k: v for k, v in trk.level.items()
+                                     if v is not None} or None}
+                       for t, trk in sorted(self._slo_state.items())}
         tenants = {
             t: {"in_flight": n, "quota": self.tenant_quota}
             for t, n in sorted(inflight.items())
@@ -408,18 +433,14 @@ class SolveServer:
             "max_batch": self.max_batch,
             "quantum": self.quantum,
             "tenants": tenants,
-            "requests_served": self._n_requests,
-            "batches_dispatched": self._n_batches,
-            "requests_shed": self._n_shed,
-            "last_batch": self._last_batch,
+            "requests_served": n_requests,
+            "batches_dispatched": n_batches,
+            "requests_shed": n_shed,
+            "last_batch": last_batch,
             "cache": self.cache.stats(),
         }
-        if self._slo_state:
-            now = time.monotonic()
-            out["slo"] = {t: {**trk.burn(now),
-                              "level": {k: v for k, v in trk.level.items()
-                                        if v is not None} or None}
-                          for t, trk in sorted(self._slo_state.items())}
+        if slo is not None:
+            out["slo"] = slo
         return out
 
     def __enter__(self) -> "SolveServer":
@@ -622,9 +643,10 @@ class SolveServer:
         slo = self._slo_for(tenant)
         if slo is None:
             return None
-        trk = self._slo_state.get(tenant)
-        if trk is None:
-            trk = self._slo_state[tenant] = _SloTracker(slo)
+        with self._cond:
+            trk = self._slo_state.get(tenant)
+            if trk is None:
+                trk = self._slo_state[tenant] = _SloTracker(slo)
         return trk
 
     def _slo_evaluate(self, run, tenant: str, trk: "_SloTracker") -> None:
@@ -632,28 +654,34 @@ class SolveServer:
         level transition (through ``obs.health``'s callback/abort/dump
         machinery), one ``slo_recovered`` event on the way back down."""
         now = time.monotonic()
-        burn = trk.burn(now)
-        g = run.gauge("serve_slo_burn_rate",
-                      "error-budget burn rate over the rolling SLO window "
-                      "(1.0 = consuming exactly the budget)")
-        for slo_kind, rate in (("latency", burn["latency_burn"]),
-                               ("shed", burn["shed_burn"])):
-            g.set(rate, tenant=tenant, slo=slo_kind)
-            level = trk.classify(rate)
-            prev = trk.level[slo_kind]
-            if level == prev:
-                continue
-            trk.level[slo_kind] = level
-            if level is not None:
-                obs.monitor_for(run).anomaly(
-                    "slo_burn", severity=level, tenant=tenant,
-                    slo=slo_kind, burn_rate=rate,
-                    window_s=trk.slo.window_s,
-                    requests=burn["requests"], slow=burn["slow"],
-                    shed=burn["shed"])
-            elif prev is not None:
-                run.event("slo_recovered", phase="serve", tenant=tenant,
-                          slo=slo_kind, burn_rate=rate)
+        # Trackers are touched by client threads (shed at admission) and
+        # the worker (request completions): burn/level transitions happen
+        # under the server lock so a transition is decided exactly once.
+        # self._cond is reentrant (threading.Condition wraps an RLock) and
+        # the registry/event locks nest strictly inside it — one order.
+        with self._cond:
+            burn = trk.burn(now)
+            g = run.gauge("serve_slo_burn_rate",
+                          "error-budget burn rate over the rolling SLO "
+                          "window (1.0 = consuming exactly the budget)")
+            for slo_kind, rate in (("latency", burn["latency_burn"]),
+                                   ("shed", burn["shed_burn"])):
+                g.set(rate, tenant=tenant, slo=slo_kind)
+                level = trk.classify(rate)
+                prev = trk.level[slo_kind]
+                if level == prev:
+                    continue
+                trk.level[slo_kind] = level
+                if level is not None:
+                    obs.monitor_for(run).anomaly(
+                        "slo_burn", severity=level, tenant=tenant,
+                        slo=slo_kind, burn_rate=rate,
+                        window_s=trk.slo.window_s,
+                        requests=burn["requests"], slow=burn["slow"],
+                        shed=burn["shed"])
+                elif prev is not None:
+                    run.event("slo_recovered", phase="serve", tenant=tenant,
+                              slo=slo_kind, burn_rate=rate)
 
     def _obs_shed(self, tenant: str, reason: str, waited_s: float) -> None:
         run = obs.get_run()
@@ -668,7 +696,8 @@ class SolveServer:
                   waited_s=waited_s)
         trk = self._slo_tracker(tenant)
         if trk is not None:
-            trk.observe_shed(time.monotonic())
+            with self._cond:  # tracker windows are shared mutable state
+                trk.observe_shed(time.monotonic())
             self._slo_evaluate(run, tenant, trk)
 
     def _obs_batch(self, tickets, results, info, duration_s: float) -> None:
@@ -703,5 +732,7 @@ class SolveServer:
                 if res.grad_norm_history else None)
             trk = self._slo_tracker(tenant)
             if trk is not None:
-                trk.observe_request(time.monotonic(), t.latency_s or 0.0)
+                with self._cond:  # tracker windows are shared mutable state
+                    trk.observe_request(time.monotonic(),
+                                        t.latency_s or 0.0)
                 self._slo_evaluate(run, tenant, trk)

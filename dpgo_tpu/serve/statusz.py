@@ -63,10 +63,14 @@ class MetricsSidecar:
                         ctype = PROMETHEUS_CONTENT_TYPE
                         code = 200
                     elif path == "/healthz":
-                        closed = sidecar.server._closed
+                        # status() reads the lifecycle flag under the
+                        # server lock — no bare cross-thread attribute
+                        # peeking from the scrape threads.
+                        st = sidecar.server.status()
+                        closed = st["closed"]
                         body = json.dumps(
                             {"ok": not closed,
-                             "uptime_s": sidecar.server.status()["uptime_s"],
+                             "uptime_s": st["uptime_s"],
                              "run": sidecar.run.run_id}).encode("utf-8")
                         ctype = "application/json"
                         code = 200 if not closed else 503
@@ -94,17 +98,27 @@ class MetricsSidecar:
                 self.wfile.write(body)
 
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
-        self._httpd.daemon_threads = True
-        self.host, self.port = self._httpd.server_address[:2]
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True,
-                                        name="dpgo-serve-metrics")
-        self._thread.start()
+        try:
+            self._httpd.daemon_threads = True
+            self.host, self.port = self._httpd.server_address[:2]
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True,
+                name="dpgo-serve-metrics")
+            self._thread.start()
+        except BaseException:
+            # Never strand the bound listening socket on a failed start
+            # (leakcheck-enforced contract).
+            self._httpd.server_close()
+            raise
 
     def close(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        self._thread.join(timeout=5.0)
+        try:
+            self._httpd.shutdown()
+        finally:
+            # The socket must die even when shutdown() fails — a wedged
+            # serve thread should not keep the port bound.
+            self._httpd.server_close()
+            self._thread.join(timeout=5.0)
 
     def __enter__(self) -> "MetricsSidecar":
         return self

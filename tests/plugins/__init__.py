@@ -1,0 +1,2 @@
+"""pytest plugins for the test suite (loaded via ``-p``, e.g.
+``-p tests.plugins.leakcheck``)."""

@@ -1,0 +1,156 @@
+"""Runtime leak detector: no test leaves threads or sockets behind.
+
+The serving plane, comms bus, overlap workers, and HTTP sidecar all
+spawn background machinery; a test that forgets to close them poisons
+every later test in the process (ports stay bound, worker threads keep
+polling dead queues, and the failure shows up three files away).  This
+plugin makes the leak fail the *offending* test:
+
+* **threads** — any live **non-daemon** thread that appeared during the
+  test and survives a short grace period;
+* **sockets** — any ``socket.socket`` constructed during the test that
+  is still open (``fileno() != -1``) after teardown and garbage
+  collection (sockets are tracked via a constructor shim installed at
+  ``pytest_configure``; closing in a ``finally``/``close()`` path — the
+  contract this enforces — passes).
+
+Scope: non-``slow`` tests only (the tier-1 set; slow/deployment tests
+spawn real multi-process fleets with their own teardown story), and a
+test may opt out explicitly with ``@pytest.mark.allow_leaks`` plus a
+reason in the marker args.
+
+Activate with ``-p tests.plugins.leakcheck`` (the tier-1 CI command
+does).
+"""
+
+from __future__ import annotations
+
+import gc
+import socket
+import threading
+import time
+import weakref
+
+import pytest
+
+_GRACE_S = 1.5          # wind-down allowance for naturally-exiting threads
+_POLL_S = 0.05
+
+_tracked_sockets: "weakref.WeakSet[socket.socket]" = weakref.WeakSet()
+_orig_socket_init = socket.socket.__init__
+
+
+def _tracking_init(self, *args, **kwargs):
+    _orig_socket_init(self, *args, **kwargs)
+    try:
+        _tracked_sockets.add(self)
+    except TypeError:  # exotic subclasses without weakref support
+        pass
+
+
+class LeakError(AssertionError):
+    """A test left live threads or open sockets behind."""
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "allow_leaks(reason): exempt this test from the leakcheck "
+        "thread/socket assertions (say why)")
+    socket.socket.__init__ = _tracking_init
+
+
+def pytest_unconfigure(config):
+    socket.socket.__init__ = _orig_socket_init
+
+
+def _open_sockets() -> set:
+    out = set()
+    for s in list(_tracked_sockets):
+        try:
+            if s.fileno() != -1:
+                out.add(s)
+        except Exception:
+            pass
+    return out
+
+
+def _live_nondaemon_threads() -> set:
+    return {t for t in threading.enumerate()
+            if t.is_alive() and not t.daemon}
+
+
+def _sock_desc(s: socket.socket) -> str:
+    try:
+        laddr = s.getsockname()
+    except Exception:
+        laddr = "?"
+    return f"fd={s.fileno()} laddr={laddr}"
+
+
+def _enforced(item) -> bool:
+    if item.get_closest_marker("slow") is not None:
+        return False
+    if item.get_closest_marker("allow_leaks") is not None:
+        return False
+    return True
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    """Snapshot before setup, verify after teardown — fixtures get their
+    full teardown window to close what they opened."""
+    if not _enforced(item):
+        return (yield)
+    threads_before = _live_nondaemon_threads()
+    socks_before = _open_sockets()
+    result = yield
+
+    # Grace: a cleanly-stopping thread may still be mid-exit, and a
+    # dropped-reference socket may await collection.
+    deadline = time.monotonic() + _GRACE_S
+    leaked_threads = leaked_socks = None
+    while time.monotonic() < deadline:
+        gc.collect()
+        leaked_threads = _live_nondaemon_threads() - threads_before
+        leaked_socks = _open_sockets() - socks_before
+        if not leaked_threads and not leaked_socks:
+            break
+        time.sleep(_POLL_S)
+
+    if leaked_threads or leaked_socks:
+        parts = []
+        if leaked_threads:
+            parts.append("non-daemon threads still alive: " + ", ".join(
+                sorted(t.name for t in leaked_threads)))
+        if leaked_socks:
+            parts.append("sockets still open: " + "; ".join(
+                sorted(_sock_desc(s) for s in leaked_socks)))
+        msg = (f"leakcheck: {item.nodeid} leaked {' | '.join(parts)} — "
+               "close servers/transports/sidecars in a finally/with, or "
+               "mark the test @pytest.mark.allow_leaks(reason=...)")
+        item.ihook.pytest_runtest_logreport(report=_leak_report(item, msg))
+        # Leave the tracked sets clean for the NEXT test: what leaked here
+        # must not be double-reported downstream.
+        return result
+    return result
+
+
+def _leak_report(item, msg: str):
+    """An extra failed report for the leaking test, attributed to a
+    dedicated 'leakcheck' phase so it cannot be mistaken for the test's
+    own assertion."""
+    from _pytest.reports import TestReport
+
+    return TestReport(
+        nodeid=item.nodeid,
+        location=item.location,
+        keywords={k: 1 for k in item.keywords},
+        outcome="failed",
+        longrepr=msg,
+        when="teardown",
+        sections=[],
+        duration=0.0,
+        start=time.time(),
+        stop=time.time(),
+    )

@@ -14,7 +14,7 @@ import time
 import numpy as np
 import pytest
 
-from dpgo_tpu.agent import AgentState, PGOAgent, PGOAgentStatus
+from dpgo_tpu.agent import AgentState, PGOAgent
 from dpgo_tpu.config import AgentParams, RobustCostParams, RobustCostType
 from dpgo_tpu.utils.partition import agent_measurements, partition_contiguous
 from dpgo_tpu.utils.synthetic import make_measurements

@@ -7,14 +7,13 @@ critical point)."""
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from dpgo_tpu.config import SolverParams
 from dpgo_tpu.models import certify, local_pgo
-from dpgo_tpu.ops import manifold, quadratic, solver
+from dpgo_tpu.ops import solver
 from dpgo_tpu.types import Measurements, edge_set_from_measurements
-from synthetic import make_measurements, trajectory_error
+from synthetic import make_measurements
 
 
 def dense_certificate(X, edges):

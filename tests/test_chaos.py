@@ -16,7 +16,7 @@ import pytest
 
 from dpgo_tpu import obs
 from dpgo_tpu.agent import AgentState, PGOAgent
-from dpgo_tpu.comms import (FaultInjector, FaultSpec, ReliableChannel,
+from dpgo_tpu.comms import (FaultInjector, FaultSpec,
                             RetryPolicy, apply_peer_frame, loopback_fleet,
                             pack_agent_frame)
 from dpgo_tpu.config import AgentParams

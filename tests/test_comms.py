@@ -6,16 +6,15 @@ and the obs instrumentation incl. the zero-overhead telemetry-off fence."""
 
 import socket
 import struct
-import threading
 import time
 
 import numpy as np
 import pytest
 
 from dpgo_tpu import obs
-from dpgo_tpu.comms import (BusClient, FaultInjector, FaultSpec,
+from dpgo_tpu.comms import (FaultInjector, FaultSpec,
                             LoopbackTransport, ProtocolError,
-                            ReliableChannel, RetryPolicy, RoundBus,
+                            ReliableChannel, RetryPolicy,
                             TcpTransport, Transport, TransportClosed,
                             TransportTimeout, loopback_fleet)
 from dpgo_tpu.comms.protocol import (HEADER, FrameAssembler, decode_payload,

@@ -7,14 +7,13 @@ the iterated-GNC pipeline must still reject them on a small problem.
 
 import numpy as np
 import jax.numpy as jnp
-import pytest
 
 from dpgo_tpu.config import AgentParams, RobustCostParams, RobustCostType
 from dpgo_tpu.models import rbcd
 from dpgo_tpu.types import loop_closure_mask
 from dpgo_tpu.utils.synthetic import (corrupt_loop_closures_correlated,
                                       integrate_odometry_np,
-                                      make_measurements, rejection_scores)
+                                      rejection_scores)
 from synthetic import make_measurements as make_meas_test
 
 

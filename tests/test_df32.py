@@ -8,10 +8,7 @@ loudly.  The on-device recenter (``models.refine_fused``) is built on
 exactly these guarantees.
 """
 import numpy as np
-import pytest
 
-import jax
-import jax.numpy as jnp
 
 from dpgo_tpu.ops import df32
 

@@ -6,10 +6,8 @@ S = Q - Lambda computed with every agent holding only its own edges, via
 psum'd Gram matrices and a distributed block LOBPCG.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from dpgo_tpu.config import AgentParams
 from dpgo_tpu.models import certify, rbcd

@@ -2,7 +2,6 @@
 abort/callback policy, the instrumented solver path, per-agent sentinels,
 and the fleet-wide health gossip riding the comms bus."""
 
-import math
 import os
 
 import jax.numpy as jnp

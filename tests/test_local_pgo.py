@@ -2,7 +2,6 @@
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from dpgo_tpu.models import local_pgo
 from dpgo_tpu.ops import quadratic

@@ -7,7 +7,6 @@ load for warm restart via ``PGOLogger.cpp:83-225``).
 import numpy as np
 import pytest
 
-from dpgo_tpu.types import Measurements
 from dpgo_tpu.utils import logger
 from dpgo_tpu.utils.lie import rotation2d
 from dpgo_tpu.utils.synthetic import make_measurements

@@ -17,7 +17,6 @@ from dpgo_tpu.obs.exporters import (to_prometheus_text,
                                     write_tensorboard_scalars)
 from dpgo_tpu.obs.metrics import MetricsRegistry
 from dpgo_tpu.obs.report import main as report_main
-from dpgo_tpu.obs.report import render_report
 
 
 @pytest.fixture(autouse=True)

@@ -118,7 +118,6 @@ def test_masked_edges_contribute_nothing(rng, small_problem):
     X = random_X(rng, n, 5, meas.d)
     f0 = float(quadratic.cost(X, edges))
     # Append garbage padding edges with mask 0.
-    import dataclasses
     pad = edges._replace(
         i=jnp.concatenate([edges.i, jnp.array([0, 1], jnp.int32)]),
         j=jnp.concatenate([edges.j, jnp.array([2, 3], jnp.int32)]),

@@ -3,10 +3,8 @@ df32 recenter must reproduce the host f64 recenter, and the fused
 pipeline must reach a HOST-VERIFIED 1e-6 gap with no mid-pipeline sync.
 """
 
-import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from dpgo_tpu.config import AgentParams, SolverParams
 from dpgo_tpu.models import rbcd, refine, refine_fused

@@ -11,7 +11,7 @@ import numpy as np
 import pytest
 
 from dpgo_tpu import obs
-from dpgo_tpu.obs.regress import compare_runs, render_compare, tail_band
+from dpgo_tpu.obs.regress import compare_runs, tail_band
 from dpgo_tpu.obs.report import main as report_main
 
 

@@ -2,7 +2,6 @@
 CPU mesh (SURVEY.md section 4 item (e) — multi-device tests the reference
 never had)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

@@ -1,6 +1,5 @@
 """Closed-form small-matrix kernels vs LAPACK references."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

@@ -3,7 +3,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from dpgo_tpu.config import SolverParams
 from dpgo_tpu.models.local_pgo import lift, make_problem, round_solution

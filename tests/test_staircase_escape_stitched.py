@@ -5,7 +5,6 @@ demo (experiments/staircase_escape_100k.py, VERDICT r4 item 2).
 
 import numpy as np
 import jax.numpy as jnp
-import pytest
 
 from dpgo_tpu.models import certify, rbcd
 from dpgo_tpu.parallel import certify as dcert

@@ -10,7 +10,6 @@ import subprocess
 import sys
 
 import numpy as np
-import pytest
 
 EXAMPLE = os.path.join(os.path.dirname(__file__), "..", "examples",
                        "tcp_deployment_example.py")

@@ -13,14 +13,14 @@ import pytest
 from dpgo_tpu import obs
 from dpgo_tpu.agent import AgentState, PGOAgent
 from dpgo_tpu.comms import (BF16_REL_ERR, PACKED_MAGIC, LoopbackTransport,
-                            ProtocolError, ReliableChannel, RetryPolicy,
+                            ProtocolError, RetryPolicy,
                             bf16_decode, bf16_encode, loopback_fleet,
                             pack_agent_frame, apply_peer_frame)
-from dpgo_tpu.comms.protocol import (HEADER, decode_payload,
+from dpgo_tpu.comms.protocol import (decode_payload,
                                      decode_payload_packed, encode_payload,
-                                     pack_pose_arrays, pack_pose_dict,
+                                     pack_pose_dict,
                                      pack_pose_set, pose_payload_nbytes,
-                                     unpack_pose_arrays, unpack_pose_dict,
+                                     unpack_pose_arrays,
                                      unpack_pose_set)
 from dpgo_tpu.config import AgentParams
 from dpgo_tpu.utils.partition import agent_measurements, partition_contiguous

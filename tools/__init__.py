@@ -1,0 +1,2 @@
+"""Developer tooling for the dpgo_tpu repository (not shipped with the
+package).  ``tools.dpgolint`` is the project-invariant static analyzer."""

@@ -1,0 +1,35 @@
+"""dpgolint — project-invariant static analysis for dpgo_tpu.
+
+Five AST passes encode the invariants the hand-written boom tests only
+spot-check (see docs/ARCHITECTURE.md, "Static analysis & invariants"):
+
+* **DPG001 jit-purity** — no clocks/RNGs/prints/host-syncs/global
+  mutation in code reachable from jit entry points.
+* **DPG002 telemetry-fence** — obs-owned constructors dominated by a
+  telemetry-enabled guard.
+* **DPG003 host-sync-hazard** — no implicit device->host transfers in
+  hot-path loops outside the sanctioned readback seams.
+* **DPG004 lock-discipline** — ``# guarded-by:`` attributes touched only
+  under their lock, ``# holds:`` helpers called only under it,
+  consistent lock order.
+* **DPG005 wire-schema-symmetry** — packed and unpacked frame keys
+  match in both codecs.
+
+Usage: ``python -m tools.dpgolint [paths...] [--format json]``; library
+entry point ``run_lint(paths, config)``.
+"""
+
+from . import rules  # noqa: F401  (importing registers every pass)
+from .config import Config, project_config
+from .core import REGISTRY, Finding, Module, Rule, register, run_lint
+
+__all__ = [
+    "Config",
+    "Finding",
+    "Module",
+    "REGISTRY",
+    "Rule",
+    "project_config",
+    "register",
+    "run_lint",
+]

@@ -1,0 +1,102 @@
+"""CLI: ``python -m tools.dpgolint [paths...]``.
+
+Exit codes: 0 clean (or every finding accepted by the baseline), 1 new
+findings, 2 usage/configuration error.  ``--format json`` emits one
+machine-readable object (the CI ``static-analysis`` job's interface);
+the default text format is ``path:line:col: RULE message`` per finding.
+
+The baseline (``tools/dpgolint/baseline.json``, committed EMPTY) exists
+so the gate can be landed together with any accepted debt explicit and
+reviewable; ``--write-baseline`` regenerates it from the current tree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import rules  # noqa: F401  (register passes)
+from .config import project_config
+from .core import (REGISTRY, load_baseline, render_text, run_lint,
+                   split_by_baseline)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.dpgolint",
+        description="project-invariant static analysis for dpgo_tpu")
+    ap.add_argument("paths", nargs="*", default=["dpgo_tpu", "tools"],
+                    help="files/directories to lint "
+                         "(default: dpgo_tpu tools)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--rules", metavar="IDS",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="accepted-findings file (default: the committed "
+                         "empty baseline)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline; any finding fails")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept the current findings into --baseline")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(REGISTRY):
+            r = REGISTRY[rid]
+            print(f"{rid} {r.name}: {r.invariant}")
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip().upper() for r in args.rules.split(",")]
+        unknown = [r for r in rule_ids if r not in REGISTRY]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)} "
+                  f"(have: {', '.join(sorted(REGISTRY))})", file=sys.stderr)
+            return 2
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"no such path(s): {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    findings = run_lint(args.paths, project_config(), rules=rule_ids)
+
+    if args.write_baseline:
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            json.dump([f.as_dict() for f in findings], fh, indent=1)
+            fh.write("\n")
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    baseline = [] if args.no_baseline else load_baseline(args.baseline)
+    new, known, stale = split_by_baseline(findings, baseline)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.as_dict() for f in new],
+            "baselined": [f.as_dict() for f in known],
+            "stale_baseline": stale,
+            "count": len(new),
+        }, indent=1))
+    else:
+        if new:
+            print(render_text(new))
+        if known:
+            print(f"({len(known)} baselined finding(s) suppressed)",
+                  file=sys.stderr)
+        if stale:
+            print(f"({len(stale)} stale baseline entr(ies) — clean them "
+                  "up)", file=sys.stderr)
+        if not new:
+            print(f"dpgolint: clean ({len(REGISTRY)} rules, "
+                  f"{', '.join(args.paths)})")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,150 @@
+"""dpgolint configuration: which rules look where, and what they trust.
+
+``Config`` carries two maps:
+
+* ``files`` — rule id -> list of path globs (lint-root-relative, forward
+  slashes) the rule runs on.  ``None``/missing = every file.  This is how
+  each invariant stays scoped to the layer that owes it (DPG001 to the
+  jit hot paths, DPG005 to the wire modules) instead of pattern-matching
+  the whole tree.
+* ``options`` — rule id -> rule-specific settings dict.  Per-file
+  settings nest one level deeper keyed by path glob (see
+  ``Config.file_options``).
+
+``project_config()`` is the checked-in project policy — the single place
+the sanctioned constructor seams, hot-path function lists, and codec
+pairs are declared.  Tests build ad-hoc ``Config``\\ s pointing rules at
+fixture files instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .core import glob_match
+
+
+@dataclasses.dataclass
+class Config:
+    files: dict = dataclasses.field(default_factory=dict)
+    options: dict = dataclasses.field(default_factory=dict)
+
+    def applies(self, rule_id: str, relpath: str) -> bool:
+        globs = self.files.get(rule_id)
+        if globs is None:
+            return True
+        return glob_match(relpath, globs)
+
+    def rule_options(self, rule_id: str) -> dict:
+        return self.options.get(rule_id, {})
+
+    def file_options(self, rule_id: str, relpath: str) -> dict:
+        """The per-file settings block for ``relpath``: the value under the
+        first glob key in ``options[rule_id]["per_file"]`` that matches."""
+        per_file = self.rule_options(rule_id).get("per_file", {})
+        for pat, opts in per_file.items():
+            if glob_match(relpath, [pat]):
+                return opts
+        return {}
+
+
+def project_config() -> Config:
+    """The dpgo_tpu project policy (see docs/ARCHITECTURE.md, "Static
+    analysis & invariants")."""
+    return Config(
+        files={
+            # DPG001: functions reachable from jax.jit/vmap/fused-segment
+            # entry points must be pure — these are the modules that build
+            # the compiled solver/serving programs.
+            "DPG001": [
+                "dpgo_tpu/models/rbcd.py",
+                "dpgo_tpu/serve/runner.py",
+                "dpgo_tpu/parallel/sharded.py",
+            ],
+            # DPG002: obs-owned constructions anywhere in the package must
+            # sit behind the telemetry fence; the obs internals that ARE
+            # the fence (run/trace/health/recorder construct their own
+            # objects behind documented contracts + boom tests) are the
+            # sanctioned seams.
+            "DPG002": ["dpgo_tpu/*", "dpgo_tpu/*/*"],
+            # DPG003: host-sync hazards in the solver/serving hot loops.
+            "DPG003": [
+                "dpgo_tpu/models/rbcd.py",
+                "dpgo_tpu/serve/runner.py",
+            ],
+            # DPG004 is annotation-driven (# guarded-by) — run everywhere;
+            # files without annotations produce nothing.
+            "DPG004": None,
+            # DPG005: the wire vocabulary modules.
+            "DPG005": [
+                "dpgo_tpu/comms/protocol.py",
+                "dpgo_tpu/comms/reliable.py",
+                "dpgo_tpu/comms/bus.py",
+            ],
+        },
+        options={
+            "DPG001": {
+                # Fused-segment entry points that are jitted indirectly
+                # (module-level jax.jit(...) wrappers already detect most).
+                "extra_entries": ["_rbcd_segment", "_rbcd_round",
+                                  "_rbcd_rounds"],
+            },
+            "DPG002": {
+                "constructors": ["TelemetryRun", "HealthMonitor",
+                                 "FlightRecorder", "MetricsSidecar",
+                                 "ProfiledExecutable", "ProfilerWindow",
+                                 "Span"],
+                # Obs-owned modules where construction IS the sanctioned
+                # implementation of the fence (each carries its own boom
+                # test): start_run/run_scope, span()/start_span(),
+                # monitor_for, FlightRecorder.attach + the replay CLI.
+                "allowed_files": [
+                    "dpgo_tpu/obs/run.py",
+                    "dpgo_tpu/obs/trace.py",
+                    "dpgo_tpu/obs/health.py",
+                    "dpgo_tpu/obs/recorder.py",
+                ],
+            },
+            "DPG003": {
+                "per_file": {
+                    "dpgo_tpu/models/rbcd.py": {
+                        "hot_functions": ["run_rbcd", "dispatch_prepared",
+                                          "solve_rbcd"],
+                    },
+                    "dpgo_tpu/serve/runner.py": {
+                        "hot_functions": ["run_bucket"],
+                    },
+                },
+            },
+            "DPG005": {
+                "per_file": {
+                    "dpgo_tpu/comms/protocol.py": {
+                        "pack_functions": ["pack_pose_dict",
+                                           "pack_pose_arrays",
+                                           "pack_trace_entries"],
+                        "unpack_functions": ["unpack_pose_dict",
+                                             "unpack_pose_arrays",
+                                             "unpack_trace_entries"],
+                    },
+                    "dpgo_tpu/comms/reliable.py": {
+                        "pack_functions": ["send"],
+                        "unpack_functions": ["_recv"],
+                        # Imported from protocol.py; pinned so the clock
+                        # stamp participates in the symmetry check.
+                        "constants": {"CLOCK_KEY": "_ts"},
+                    },
+                    "dpgo_tpu/comms/bus.py": {
+                        "pack_functions": ["pack_agent_frame", "hello",
+                                           "round"],
+                        "unpack_functions": ["apply_peer_frame",
+                                             "_apply_peer_frame",
+                                             "collect", "accept_robots",
+                                             "_gather_one"],
+                        # The hub namespaces rebroadcast keys r{id}|...;
+                        # receivers split the prefix off before parsing.
+                        "strip_prefixes": ["r*|"],
+                    },
+                },
+            },
+        },
+    )

@@ -1,0 +1,276 @@
+"""dpgolint core: the rule framework.
+
+The project's load-bearing invariants — the zero-overhead telemetry
+fence, pure jit-reachable code, no host syncs in hot loops, lock-guarded
+shared state, symmetric wire codecs — are each one rule here.  A rule is
+a class with an ``id`` (``DPGnnn``), registered in ``REGISTRY``, whose
+``check(module, config)`` returns ``Finding``\\ s.  The framework owns
+everything rule-independent: file walking, AST parsing with parent
+links, inline ``# dpgolint: disable=RULE`` suppressions, the committed
+baseline, and text/JSON rendering (``python -m tools.dpgolint``).
+
+Suppressions
+------------
+
+``# dpgolint: disable=DPG003 -- <reason>`` on (or immediately above) the
+offending line suppresses that rule there; a reason after ``--`` is
+convention, not syntax.  ``# dpgolint: disable-file=DPG004`` anywhere in
+a file suppresses the rule for the whole file.  Suppressions are the
+reviewed escape hatch for sanctioned sites (e.g. the two readback seams
+DPG003 allowlists); new code should satisfy the rule instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import json
+import os
+import re
+
+_SUPPRESS_RE = re.compile(
+    r"#.*?\bdpgolint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str       # lint-root-relative, forward slashes
+    line: int
+    col: int
+    message: str
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message}
+
+    @property
+    def baseline_key(self) -> str:
+        """Line numbers churn on unrelated edits; the baseline keys on
+        (rule, file, message) so accepted debt survives reflows."""
+        return f"{self.rule}|{self.path}|{self.message}"
+
+
+class Module:
+    """One parsed source file: AST with parent links, source lines,
+    suppression table."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                child._dpgolint_parent = parent  # type: ignore[attr-defined]
+        self._line_suppress: dict[int, set[str]] = {}
+        self._file_suppress: set[str] = set()
+        for lineno, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip().upper() for r in m.group("rules").split(",")}
+            if m.group("file"):
+                self._file_suppress |= rules
+                continue
+            self._line_suppress.setdefault(lineno, set()).update(rules)
+            # A comment-only line covers the statement below it; a
+            # trailing comment covers only its own line.
+            if text.lstrip().startswith("#"):
+                self._line_suppress.setdefault(lineno + 1,
+                                               set()).update(rules)
+
+    # -- tree helpers -------------------------------------------------------
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return getattr(node, "_dpgolint_parent", None)
+
+    def ancestors(self, node: ast.AST):
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_function(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return anc
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> ast.ClassDef | None:
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+        return None
+
+    # -- suppressions -------------------------------------------------------
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self._file_suppress:
+            return True
+        return rule in self._line_suppress.get(line, ())
+
+
+def dotted_name(expr: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None (calls, subscripts
+    and anything dynamic break the chain)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = dotted_name(expr.value)
+        return None if base is None else f"{base}.{expr.attr}"
+    return None
+
+
+def walk_skipping_functions(node: ast.AST, *, skip_root_check: bool = True):
+    """Yield ``node``'s descendants without descending into nested
+    function/lambda bodies — the unit rules reason about is ONE function's
+    own statements (nested defs are separate call-graph nodes)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+class Rule:
+    """Base class; subclasses set ``id``/``name``/``invariant`` and
+    implement ``check``."""
+
+    id = "DPG000"
+    name = "unnamed"
+    invariant = ""
+
+    def check(self, module: Module, config) -> list[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: Module, node: ast.AST | None, message: str,
+                line: int | None = None) -> Finding:
+        return Finding(
+            rule=self.id, path=module.relpath,
+            line=line if line is not None else getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0), message=message)
+
+
+REGISTRY: dict[str, Rule] = {}
+
+
+def register(rule_cls):
+    """Class decorator adding a rule to the global registry."""
+    REGISTRY[rule_cls.id] = rule_cls()
+    return rule_cls
+
+
+# ---------------------------------------------------------------------------
+# Running
+# ---------------------------------------------------------------------------
+
+def _relpath(abspath: str, base: str) -> str:
+    """Repo-relative when under the working directory (what the config
+    globs are written against — ``dpgo_tpu/...``), else relative to the
+    lint root's parent (fixture trees in tmp dirs)."""
+    rel = os.path.relpath(abspath, os.getcwd())
+    if rel.startswith(".."):
+        rel = os.path.relpath(abspath, base)
+    return rel
+
+
+def _iter_py_files(paths: list[str]) -> list[tuple[str, str]]:
+    out = []
+    for root in paths:
+        root = os.path.normpath(root)
+        base = os.path.dirname(os.path.abspath(root))
+        if os.path.isfile(root):
+            p = os.path.abspath(root)
+            out.append((p, _relpath(p, base)))
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    p = os.path.abspath(os.path.join(dirpath, fn))
+                    out.append((p, _relpath(p, base)))
+    return out
+
+
+def run_lint(paths: list[str], config, rules: list[str] | None = None
+             ) -> list[Finding]:
+    """Lint every .py file under ``paths`` with the registered rules
+    (optionally restricted to ``rules`` ids); returns suppression-filtered
+    findings sorted by location."""
+    active = {rid: rule for rid, rule in REGISTRY.items()
+              if rules is None or rid in rules}
+    findings: list[Finding] = []
+    for path, relpath in _iter_py_files(paths):
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            module = Module(path, relpath, source)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="DPG000", path=relpath.replace(os.sep, "/"),
+                line=e.lineno or 0, col=e.offset or 0,
+                message=f"syntax error: {e.msg}"))
+            continue
+        for rid in sorted(active):
+            rule = active[rid]
+            if not config.applies(rid, module.relpath):
+                continue
+            for f in rule.check(module, config):
+                if not module.is_suppressed(f.rule, f.line):
+                    findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, list):
+        raise ValueError(f"baseline {path}: expected a JSON list")
+    return data
+
+
+def split_by_baseline(findings: list[Finding], baseline: list[dict]
+                      ) -> tuple[list[Finding], list[Finding], list[dict]]:
+    """(new, known, stale): findings not in the baseline, findings the
+    baseline accepts, and baseline entries nothing matched (candidates for
+    deletion)."""
+    keys = {f"{b['rule']}|{b['path']}|{b['message']}" for b in baseline}
+    new = [f for f in findings if f.baseline_key not in keys]
+    known = [f for f in findings if f.baseline_key in keys]
+    seen = {f.baseline_key for f in findings}
+    stale = [b for b in baseline
+             if f"{b['rule']}|{b['path']}|{b['message']}" not in seen]
+    return new, known, stale
+
+
+def render_text(findings: list[Finding]) -> str:
+    lines = []
+    for f in findings:
+        lines.append(f"{f.location}:{f.col}: {f.rule} {f.message}")
+    return "\n".join(lines)
+
+
+def glob_match(relpath: str, patterns) -> bool:
+    return any(fnmatch.fnmatchcase(relpath, pat) for pat in patterns)

@@ -1,0 +1,9 @@
+"""Rule plugins — importing this package registers every pass."""
+
+from . import (  # noqa: F401
+    host_sync,
+    jit_purity,
+    lock_discipline,
+    telemetry_fence,
+    wire_schema,
+)

@@ -1,0 +1,230 @@
+"""DPG001: functions reachable from jit entry points must be pure.
+
+The fused RBCD segments are replayed bit-for-bit by the flight recorder
+and cached as batched executables by the serving plane — both break the
+moment traced code consults the host (wall clocks, Python RNGs, prints,
+``.item()``/``float()`` materializations) or mutates state outside its
+arguments.  jax would catch *some* of these at trace time with a
+``TracerError``; this pass catches all of them at review time, including
+the ones jit silently constant-folds (``time.time()`` evaluated once at
+trace time is the classic silent version skew).
+
+Entry points are discovered, not declared: any function passed to
+``jax.jit``/``jax.vmap``/``jax.pmap`` (as a call argument, through
+``functools.partial``, or as a decorator) plus the configured
+``extra_entries``.  Reachability follows same-module calls by name —
+cross-module purity is each callee module's own lint run.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import (Module, Rule, dotted_name, register,
+                    walk_skipping_functions)
+
+_JIT_WRAPPERS = {"jit", "vmap", "pmap", "shard_map", "grad", "value_and_grad",
+                 "checkpoint", "remat", "custom_jvp", "custom_vjp"}
+
+
+def _import_table(tree: ast.AST) -> dict[str, str]:
+    """local alias -> imported module/object full name."""
+    table: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                table[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                table[a.asname or a.name] = f"{node.module}.{a.name}"
+    return table
+
+
+def _is_jit_wrapper(call: ast.Call, imports: dict[str, str]) -> bool:
+    name = dotted_name(call.func)
+    if name is None:
+        return False
+    parts = name.split(".")
+    head = imports.get(parts[0], parts[0])
+    full = ".".join([head] + parts[1:])
+    last = full.split(".")[-1]
+    return last in _JIT_WRAPPERS and ("jax" in full or full == last)
+
+
+def _collect_entry_names(tree: ast.AST, imports: dict[str, str]) -> set[str]:
+    entries: set[str] = set()
+
+    def harvest(expr: ast.AST) -> None:
+        """Function references inside a jit-wrapper call's arguments."""
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            name = dotted_name(expr)
+            if name:
+                entries.add(name.split(".")[-1])
+        elif isinstance(expr, ast.Call):
+            # jax.jit(jax.vmap(f)) / partial(jax.jit, ...)(f): recurse.
+            for a in expr.args:
+                harvest(a)
+        elif isinstance(expr, ast.Lambda):
+            entries.add(f"<lambda:{expr.lineno}>")
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_wrapper(node, imports):
+            for a in node.args:
+                harvest(a)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec
+                if isinstance(dec, ast.Call):  # @partial(jax.jit, ...)
+                    inner = [a for a in dec.args
+                             if isinstance(a, (ast.Name, ast.Attribute))]
+                    fname = dotted_name(dec.func) or ""
+                    if fname.split(".")[-1] == "partial" and inner:
+                        target = inner[0]
+                    else:
+                        target = dec.func
+                name = dotted_name(target)
+                if name is None:
+                    continue
+                parts = name.split(".")
+                head = imports.get(parts[0], parts[0])
+                full = ".".join([head] + parts[1:])
+                if full.split(".")[-1] in _JIT_WRAPPERS and "jax" in full:
+                    entries.add(node.name)
+    return entries
+
+
+def _function_defs(tree: ast.AST) -> dict[str, list[ast.AST]]:
+    """Every def/assigned-lambda in the module by simple name (nested
+    included — the call graph resolves by name, shadowing be damned; a
+    false edge only widens the checked set)."""
+    defs: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+        elif isinstance(node, ast.Assign) and isinstance(node.value,
+                                                         ast.Lambda):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    defs.setdefault(t.id, []).append(node.value)
+        elif isinstance(node, ast.Lambda):
+            defs.setdefault(f"<lambda:{node.lineno}>", []).append(node)
+    return defs
+
+
+def _called_names(fn: ast.AST) -> set[str]:
+    names: set[str] = set()
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name:
+                    names.add(name.split(".")[-1])
+            elif isinstance(node, (ast.Name, ast.Attribute)):
+                # Functions passed by reference (e.g. to lax.scan/vmap
+                # inside the entry) count as potential callees.
+                name = dotted_name(node)
+                if name:
+                    names.add(name.split(".")[-1])
+    return names
+
+
+@register
+class JitPurityRule(Rule):
+    id = "DPG001"
+    name = "jit-purity"
+    invariant = ("code reachable from jax.jit/vmap/fused-segment entry "
+                 "points performs no host I/O, clock/RNG reads, host "
+                 "syncs, or global/closure mutation")
+
+    def check(self, module: Module, config) -> list:
+        opts = config.rule_options(self.id)
+        imports = _import_table(module.tree)
+        entries = _collect_entry_names(module.tree, imports)
+        entries |= set(opts.get("extra_entries", []))
+        defs = _function_defs(module.tree)
+
+        # Reachability: BFS over same-module calls by simple name.
+        reach: dict[str, str] = {}  # def name -> entry that reaches it
+        queue = [(e, e) for e in sorted(entries) if e in defs]
+        while queue:
+            name, entry = queue.pop()
+            if name in reach:
+                continue
+            reach[name] = entry
+            for fn in defs[name]:
+                for callee in sorted(_called_names(fn)):
+                    if callee in defs and callee not in reach:
+                        queue.append((callee, entry))
+
+        findings = []
+        checked: set[int] = set()
+        for name, entry in sorted(reach.items()):
+            for fn in defs[name]:
+                if id(fn) in checked:
+                    continue
+                checked.add(id(fn))
+                findings.extend(
+                    self._check_body(module, fn, name, entry, imports))
+        return findings
+
+    def _check_body(self, module: Module, fn: ast.AST, name: str,
+                    entry: str, imports: dict[str, str]) -> list:
+        out = []
+        where = (f"in jit-reachable function {name!r} "
+                 f"(reached from entry {entry!r})")
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in (stmt, *walk_skipping_functions(stmt)):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    kind = ("global" if isinstance(node, ast.Global)
+                            else "nonlocal")
+                    out.append(self.finding(
+                        module, node,
+                        f"{kind} mutation of {', '.join(node.names)} "
+                        f"{where} — jit-traced code must be pure"))
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = dotted_name(node.func)
+                if cname is None:
+                    if isinstance(node.func, ast.Attribute) and \
+                            node.func.attr == "item":
+                        out.append(self.finding(
+                            module, node,
+                            f".item() host sync {where}"))
+                    continue
+                parts = cname.split(".")
+                root = imports.get(parts[0], parts[0])
+                full = ".".join([root] + parts[1:])
+                if full.split(".")[0] == "time" and len(parts) > 1:
+                    out.append(self.finding(
+                        module, node,
+                        f"wall-clock read {cname}() {where} — jit "
+                        "constant-folds it at trace time"))
+                elif full.split(".")[0] == "random" and len(parts) > 1:
+                    out.append(self.finding(
+                        module, node,
+                        f"Python RNG {cname}() {where} — use jax.random "
+                        "with a threaded key"))
+                elif (full.startswith("numpy.random")
+                      or ".random." in full and full.startswith("numpy")):
+                    out.append(self.finding(
+                        module, node,
+                        f"numpy RNG {cname}() {where} — use jax.random "
+                        "with a threaded key"))
+                elif cname == "print":
+                    out.append(self.finding(
+                        module, node,
+                        f"print() {where} — host I/O inside traced code "
+                        "(use jax.debug.print for debugging)"))
+                elif parts[-1] == "item" and len(parts) > 1:
+                    out.append(self.finding(
+                        module, node, f".item() host sync {where}"))
+                elif cname == "float" and node.args and not isinstance(
+                        node.args[0], ast.Constant):
+                    out.append(self.finding(
+                        module, node,
+                        f"float() materialization {where} — forces a "
+                        "device->host sync under trace"))
+        return out

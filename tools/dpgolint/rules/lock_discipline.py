@@ -1,0 +1,186 @@
+"""DPG004: annotated lock-guarded attributes are only touched under
+their lock, and locks nest in one consistent order.
+
+The serving plane, comms bus, and metrics registry are multi-threaded
+(client threads, the serve worker, overlap workers, transport threads,
+the HTTP sidecar).  Attributes that need a lock declare it where they are
+initialized:
+
+    self._pending: deque = deque()   # guarded-by: _cond
+
+and helper methods that REQUIRE the lock already held declare that on
+their ``def`` line:
+
+    def _get(self, labels):   # holds: _lock
+
+The pass then enforces, lexically, per class:
+
+* every other load/store of ``self.<attr>`` sits inside a
+  ``with self.<lock>:`` block (the declaring method — normally
+  ``__init__``, where the object is not yet published — is exempt, as
+  are ``holds:``-annotated methods);
+* every call to a ``holds:``-annotated method is itself made under the
+  lock (or from another method holding it);
+* across the module, nested ``with self.<lockA>: ... with self.<lockB>:``
+  acquisitions never appear in both orders (lock-order consistency by
+  attribute name — the cheap static form of deadlock freedom).
+
+``threading.Condition`` counts as a lock (its default lock is an RLock,
+so re-acquiring under the same name is fine and not modeled).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Module, Rule, register
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+_HOLDS_RE = re.compile(r"#\s*holds:\s*([A-Za-z_]\w*)")
+
+
+def _line_annotation(module: Module, lineno: int, rx: re.Pattern
+                     ) -> str | None:
+    if 1 <= lineno <= len(module.lines):
+        m = rx.search(module.lines[lineno - 1])
+        if m:
+            return m.group(1)
+    return None
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _with_locks(module: Module, node: ast.AST) -> set[str]:
+    """Lock attribute names held (lexically) at ``node``: every ancestor
+    ``with self.<name>:`` (including ``.acquire()``-less Condition use)."""
+    held: set[str] = set()
+    for anc in module.ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None:
+                    held.add(attr)
+    return held
+
+
+@register
+class LockDisciplineRule(Rule):
+    id = "DPG004"
+    name = "lock-discipline"
+    invariant = ("attributes declared `# guarded-by: <lock>` are only "
+                 "accessed under `with self.<lock>`, helper methods "
+                 "declared `# holds: <lock>` are only called under it, "
+                 "and lock acquisition order is consistent")
+
+    def check(self, module: Module, config) -> list:
+        findings = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(module, node))
+        findings.extend(self._check_lock_order(module))
+        return findings
+
+    # -- guarded attributes -------------------------------------------------
+
+    def _check_class(self, module: Module, cls: ast.ClassDef) -> list:
+        guarded: dict[str, str] = {}       # attr -> lock name
+        declared_in: dict[str, ast.AST] = {}  # attr -> declaring method
+        holds: dict[str, str] = {}         # method name -> held lock
+
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                lock = _line_annotation(module, node.lineno, _HOLDS_RE)
+                if lock:
+                    holds[node.name] = lock
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                lock = _line_annotation(module, node.lineno, _GUARDED_RE)
+                if lock is None:
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        guarded[attr] = lock
+                        declared_in[attr] = module.enclosing_function(node)
+        if not guarded and not holds:
+            return []
+
+        findings = []
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Attribute):
+                attr = _self_attr(node)
+                if attr is None or attr not in guarded:
+                    continue
+                lock = guarded[attr]
+                fn = module.enclosing_function(node)
+                # Non-lambda enclosing method (nested defs — worker
+                # closures — still belong to their method lexically, but
+                # run on other threads, so they must lock like anyone).
+                meth = fn
+                while isinstance(meth, ast.Lambda):
+                    meth = module.enclosing_function(meth)
+                if meth is declared_in.get(attr):
+                    continue  # construction happens-before publication
+                if meth is not None and holds.get(meth.name) == lock:
+                    continue  # caller-holds contract, checked at call sites
+                if lock in _with_locks(module, node):
+                    continue
+                ctx = "store to" if isinstance(
+                    node.ctx, (ast.Store, ast.Del)) else "read of"
+                findings.append(self.finding(
+                    module, node,
+                    f"{ctx} self.{attr} outside `with self.{lock}` "
+                    f"(declared `# guarded-by: {lock}`"
+                    + (f" in {cls.name}" if cls.name else "") + ")"))
+            elif isinstance(node, ast.Call):
+                # Calls to holds:-annotated helpers must hold the lock.
+                attr = _self_attr(node.func)
+                if attr is None or attr not in holds:
+                    continue
+                lock = holds[attr]
+                meth = module.enclosing_function(node)
+                while isinstance(meth, ast.Lambda):
+                    meth = module.enclosing_function(meth)
+                if meth is not None and holds.get(meth.name) == lock:
+                    continue
+                if lock in _with_locks(module, node):
+                    continue
+                findings.append(self.finding(
+                    module, node,
+                    f"call to self.{attr}() outside `with self.{lock}` "
+                    f"(declared `# holds: {lock}`)"))
+        return findings
+
+    # -- lock-order consistency --------------------------------------------
+
+    def _check_lock_order(self, module: Module) -> list:
+        edges: dict[tuple[str, str], ast.AST] = {}
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.With):
+                continue
+            inner = {a for item in node.items
+                     if (a := _self_attr(item.context_expr)) is not None}
+            if not inner:
+                continue
+            outer = _with_locks(module, node)
+            for o in outer:
+                for i in inner:
+                    if o != i:
+                        edges.setdefault((o, i), node)
+        findings = []
+        for (a, b), node in sorted(edges.items()):
+            if (b, a) in edges and a < b:
+                other = edges[(b, a)]
+                findings.append(self.finding(
+                    module, node,
+                    f"inconsistent lock order: self.{a} -> self.{b} here "
+                    f"but self.{b} -> self.{a} at line {other.lineno} — "
+                    "pick one global order"))
+        return findings

@@ -1,0 +1,197 @@
+"""DPG002: obs-owned objects are only constructed behind the telemetry
+fence.
+
+The zero-overhead contract says a telemetry-off process constructs NO
+observability machinery: no ``TelemetryRun``, ``HealthMonitor``,
+``FlightRecorder``, ``MetricsSidecar``, ``ProfiledExecutable``,
+``ProfilerWindow``, and no raw ``Span``.  The boom-patch tests prove it
+for the call sites they drive; this pass proves it for every call site:
+each configured constructor call must be *dominated* by a
+telemetry-enabled guard —
+
+* lexically inside the taken branch of ``if run is not None:`` /
+  ``if obs.get_run() is not None:`` / ``if telemetry:`` (or the else
+  branch of the negated test), where the guard variable was assigned
+  from ``get_run()`` (or from ``<run> is not None``), or
+* preceded, in an enclosing block, by an early exit
+  ``if run is None: return/raise/continue``.
+
+The analysis is lexical dominance, not dataflow — a guard stashed in a
+helper doesn't count.  Sites where the fence is upheld by a documented
+contract (obs internals whose public wrappers do the guarding) live in
+``allowed_files``; anything else needs a reviewed
+``# dpgolint: disable=DPG002`` with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Module, Rule, dotted_name, glob_match, register
+
+DEFAULT_CONSTRUCTORS = ["TelemetryRun", "HealthMonitor", "FlightRecorder",
+                        "MetricsSidecar", "ProfiledExecutable",
+                        "ProfilerWindow", "Span"]
+
+
+def _guard_vars(fn: ast.AST) -> set[str]:
+    """Names in ``fn`` that hold the fence state: assigned from
+    ``*.get_run()`` or from ``<guard> is not None``."""
+    guards: set[str] = set()
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    changed = True
+    while changed:  # two-level chains: run = get_run(); on = run is not None
+        changed = False
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Assign):
+                    continue
+                targets = [t.id for t in node.targets
+                           if isinstance(t, ast.Name)]
+                if not targets:
+                    continue
+                if _is_get_run(node.value) or \
+                        _is_not_none_of(node.value, guards):
+                    for t in targets:
+                        if t not in guards:
+                            guards.add(t)
+                            changed = True
+    return guards
+
+
+def _is_get_run(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Call):
+        name = dotted_name(expr.func)
+        return name is not None and name.split(".")[-1] == "get_run"
+    return False
+
+
+def _is_guard_expr(expr: ast.AST, guards: set[str]) -> bool:
+    return (_is_get_run(expr)
+            or (isinstance(expr, ast.Name) and expr.id in guards))
+
+
+def _is_not_none_of(expr: ast.AST, guards: set[str]) -> bool:
+    """``<guard> is not None``"""
+    return (isinstance(expr, ast.Compare) and len(expr.ops) == 1
+            and isinstance(expr.ops[0], ast.IsNot)
+            and isinstance(expr.comparators[0], ast.Constant)
+            and expr.comparators[0].value is None
+            and _is_guard_expr(expr.left, guards))
+
+
+def _is_none_of(expr: ast.AST, guards: set[str]) -> bool:
+    """``<guard> is None`` or ``not <guard>``"""
+    if isinstance(expr, ast.Compare) and len(expr.ops) == 1 \
+            and isinstance(expr.ops[0], ast.Is) \
+            and isinstance(expr.comparators[0], ast.Constant) \
+            and expr.comparators[0].value is None:
+        return _is_guard_expr(expr.left, guards)
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+        return _is_guard_expr(expr.operand, guards)
+    return False
+
+
+def _test_is_on(expr: ast.AST, guards: set[str]) -> bool:
+    """A test that is true only with telemetry on."""
+    if _is_not_none_of(expr, guards) or _is_guard_expr(expr, guards):
+        return True
+    if isinstance(expr, ast.BoolOp) and isinstance(expr.op, ast.And):
+        return any(_test_is_on(v, guards) for v in expr.values)
+    return False
+
+
+def _exits(block: list[ast.stmt]) -> bool:
+    return bool(block) and isinstance(
+        block[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def _is_dominated(module: Module, node: ast.AST, guards: set[str]) -> bool:
+    """True when every path to ``node`` passes a telemetry-on guard."""
+    child = node
+    for anc in module.ancestors(node):
+        if isinstance(anc, ast.If):
+            in_body = any(child is s or _contains(s, child)
+                          for s in anc.body)
+            in_orelse = any(child is s or _contains(s, child)
+                            for s in anc.orelse)
+            if in_body and _test_is_on(anc.test, guards):
+                return True
+            if in_orelse and _is_none_of(anc.test, guards):
+                return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Module)):
+            break
+        # A Lambda defers execution but the construction still happens
+        # inside the guarded dynamic extent (the cache-builder pattern):
+        # keep walking outward through it.
+        # Early-exit dominance: a preceding sibling `if guard is None:
+        # return` in any block on the ancestor chain.
+        for field in ("body", "orelse", "finalbody"):
+            block = getattr(anc, field, None)
+            if not isinstance(block, list):
+                continue
+            for i, stmt in enumerate(block):
+                if stmt is child or _contains(stmt, child):
+                    if _block_establishes_guard(block[:i], guards):
+                        return True
+                    break
+        child = anc
+    # Top-level statements of the enclosing (non-lambda) function.
+    fn = module.enclosing_function(node)
+    while isinstance(fn, ast.Lambda):
+        fn = module.enclosing_function(fn)
+    if fn is not None and isinstance(fn.body, list):
+        for i, stmt in enumerate(fn.body):
+            if stmt is node or _contains(stmt, node):
+                return _block_establishes_guard(fn.body[:i], guards)
+    return False
+
+
+def _contains(tree: ast.AST, node: ast.AST) -> bool:
+    return any(n is node for n in ast.walk(tree))
+
+
+def _block_establishes_guard(prefix: list[ast.stmt],
+                             guards: set[str]) -> bool:
+    for stmt in prefix:
+        if isinstance(stmt, ast.If) and _is_none_of(stmt.test, guards) \
+                and _exits(stmt.body):
+            return True
+        if isinstance(stmt, ast.Assert) and _test_is_on(stmt.test, guards):
+            return True
+    return False
+
+
+@register
+class TelemetryFenceRule(Rule):
+    id = "DPG002"
+    name = "telemetry-fence"
+    invariant = ("obs-owned constructors are dominated by a "
+                 "telemetry-enabled guard (get_run() is not None)")
+
+    def check(self, module: Module, config) -> list:
+        opts = config.rule_options(self.id)
+        constructors = set(opts.get("constructors", DEFAULT_CONSTRUCTORS))
+        allowed = opts.get("allowed_files", [])
+        if allowed and glob_match(module.relpath, allowed):
+            return []
+        findings = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None or name.split(".")[-1] not in constructors:
+                continue
+            fn = module.enclosing_function(node)
+            while isinstance(fn, ast.Lambda):
+                fn = module.enclosing_function(fn)
+            guards = _guard_vars(fn) if fn is not None else set()
+            if _is_dominated(module, node, guards):
+                continue
+            findings.append(self.finding(
+                module, node,
+                f"obs-owned construction {name}() is not dominated by a "
+                "telemetry-enabled guard — telemetry-off must construct "
+                "no obs objects (zero-overhead fence)"))
+        return findings

@@ -1,0 +1,195 @@
+"""DPG005: every wire-frame key packed has a matching unpack, and vice
+versa.
+
+The frame vocabulary (pose columns, trace context, clock stamps, agent
+gossip) is an implicit schema spread across pack-side and unpack-side
+functions; a key packed that nothing unpacks is dead wire bytes, and a
+key unpacked that nothing packs is a silent ``None``/KeyError path that
+only fires against a newer peer.  Rolling upgrades work precisely
+because both codecs stay symmetric.
+
+Per configured module, the pass collects
+
+* **packed keys** — string keys of dict literals / dict comprehensions
+  and ``frame[K] = ...`` subscript stores inside the configured
+  ``pack_functions``;
+* **unpacked keys** — ``frame[K]`` loads, ``.get(K)``/``.pop(K)`` calls
+  (bare ``get``/``pop`` aliases included — the ``pop``-or-``get``
+  dispatch idiom), ``K in frame`` tests, and ``.startswith(prefix)``
+  prefix matches inside the configured ``unpack_functions``;
+
+resolving module-level string constants (``TRACE_IDS_KEY``) and
+normalizing f-strings to glob patterns (``f"{prefix}:r"`` -> ``*:r``).
+Keys reduced to a bare ``*`` (fully dynamic) are ignored.  Configured
+``strip_prefixes`` model re-namespacing hubs (``r{id}|...``).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+
+from ..core import Module, Rule, dotted_name, register
+
+_GET_NAMES = {"get", "pop"}
+
+
+def _module_str_constants(tree: ast.AST) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = node.value.value
+    return out
+
+
+def _key_pattern(expr: ast.AST, consts: dict[str, str]) -> str | None:
+    """A glob pattern for a key expression, or None when it is not
+    string-like.  Dynamic parts become ``*``."""
+    if isinstance(expr, ast.Constant):
+        return expr.value if isinstance(expr.value, str) else None
+    if isinstance(expr, ast.Name):
+        return consts.get(expr.id, "*")
+    if isinstance(expr, ast.JoinedStr):
+        parts = []
+        for v in expr.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("*")
+        return "".join(parts)
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        left = _key_pattern(expr.left, consts)
+        right = _key_pattern(expr.right, consts)
+        if left is None or right is None:
+            return None
+        return left + right
+    return None
+
+
+def _functions_by_name(tree: ast.AST, names: set[str]) -> list[ast.AST]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name in names]
+
+
+def _collect_packed(fns, consts) -> dict[str, ast.AST]:
+    keys: dict[str, ast.AST] = {}
+
+    def add(pat, node):
+        if pat and set(pat) != {"*"}:
+            keys.setdefault(pat, node)
+
+    for fn in fns:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Dict):
+                for k in node.keys:
+                    if k is not None:
+                        add(_key_pattern(k, consts), k)
+            elif isinstance(node, ast.DictComp):
+                add(_key_pattern(node.key, consts), node.key)
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Store):
+                add(_key_pattern(node.slice, consts), node)
+    return keys
+
+
+def _collect_unpacked(fns, consts) -> dict[str, ast.AST]:
+    keys: dict[str, ast.AST] = {}
+
+    def add(pat, node, prefix=False):
+        if pat is None:
+            return
+        if prefix:
+            pat = pat + "*"
+        if set(pat) != {"*"}:
+            keys.setdefault(pat, node)
+
+    for fn in fns:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load):
+                add(_key_pattern(node.slice, consts), node)
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                last = name.split(".")[-1] if name else (
+                    node.func.attr if isinstance(node.func, ast.Attribute)
+                    else None)
+                if last in _GET_NAMES and node.args:
+                    add(_key_pattern(node.args[0], consts), node)
+                elif last == "startswith" and node.args:
+                    add(_key_pattern(node.args[0], consts), node,
+                        prefix=True)
+            elif isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                    isinstance(node.ops[0], (ast.In, ast.NotIn)):
+                add(_key_pattern(node.left, consts), node)
+    return keys
+
+
+def _strip(pat: str, prefixes: list[str]) -> str:
+    for pre in prefixes:
+        # ``r*|_pseq`` with strip prefix ``r*|`` -> ``_pseq``; match the
+        # literal tail after the last glob char of the prefix.
+        tail = pre.rstrip("*")
+        if "*" in pre:
+            lit = pre.split("*")[-1]
+            if lit and lit in pat:
+                head, _, rest = pat.partition(lit)
+                if fnmatch.fnmatchcase(head + lit, pre):
+                    return rest
+        elif pat.startswith(tail):
+            return pat[len(tail):]
+    return pat
+
+
+def _matches(a: str, b: str) -> bool:
+    return (a == b or fnmatch.fnmatchcase(a, b)
+            or fnmatch.fnmatchcase(b, a))
+
+
+@register
+class WireSchemaRule(Rule):
+    id = "DPG005"
+    name = "wire-schema-symmetry"
+    invariant = ("every frame key packed is unpacked somewhere (and vice "
+                 "versa) so the wire vocabulary stays symmetric across "
+                 "codecs")
+
+    def check(self, module: Module, config) -> list:
+        fopts = config.file_options(self.id, module.relpath)
+        pack_names = set(fopts.get("pack_functions", []))
+        unpack_names = set(fopts.get("unpack_functions", []))
+        if not pack_names or not unpack_names:
+            return []
+        strip_prefixes = fopts.get("strip_prefixes", [])
+        consts = _module_str_constants(module.tree)
+        # Constants imported from sibling modules can't be resolved from
+        # this module's AST alone; the config pins their values.
+        consts.update(fopts.get("constants", {}))
+        packed = _collect_packed(
+            _functions_by_name(module.tree, pack_names), consts)
+        unpacked = _collect_unpacked(
+            _functions_by_name(module.tree, unpack_names), consts)
+        packed = {_strip(k, strip_prefixes): v for k, v in packed.items()
+                  if set(_strip(k, strip_prefixes)) != {"*"}
+                  and _strip(k, strip_prefixes)}
+
+        findings = []
+        for key, node in sorted(packed.items()):
+            if not any(_matches(key, u) for u in unpacked):
+                findings.append(self.finding(
+                    module, node,
+                    f"wire key {key!r} is packed but never unpacked by "
+                    f"{'/'.join(sorted(unpack_names))} — dead wire bytes "
+                    "or a missing decoder"))
+        for key, node in sorted(unpacked.items()):
+            if not any(_matches(key, p) for p in packed):
+                findings.append(self.finding(
+                    module, node,
+                    f"wire key {key!r} is unpacked but never packed by "
+                    f"{'/'.join(sorted(pack_names))} — silent None/"
+                    "KeyError path against a current peer"))
+        return findings
