@@ -416,8 +416,14 @@ class PGOAgent:
 
         n, s = self.n, len(self._slot_pose)
         e = int(self._edges.i.shape[0])
-        T, nt = _edge_tile_shape(n, s, e)
         bf16 = resolved_sel_mode(self.params) != "f32"
+        # Wide-tile parity with the batched core (``build_graph``): bf16
+        # selection modes stream T=256 tiles up to ~3000-pose buffers
+        # (half-size one-hot transients) — the deployment surface
+        # previously always took the narrow adaptive tile, so a per-robot
+        # ``iterate()`` ran measurably narrower dots than ``solve_rbcd``
+        # on the identical problem (the round-5 packed+wide promotion).
+        T, nt = _edge_tile_shape(n, s, e, wide=bf16)
         if not pallas_vmem_ok(n, s, self.params.r, self.d, T, nt, bf16):
             if forced:
                 # Same no-silent-downgrade contract as the batched core
@@ -429,7 +435,7 @@ class PGOAgent:
             return None
         eidx_i, eidx_j, rot_t, trn_t = agent_edge_tiles(
             self._edges.i, self._edges.j, self._edges.R, self._edges.t,
-            n, s)
+            n, s, wide=bf16)
         interpret = jax.default_backend() != "tpu"
         return (eidx_i, eidx_j, rot_t, trn_t, interpret)
 
@@ -1128,8 +1134,17 @@ class PGOAgent:
                     X_new, _gn, rel_dev = self._step_fn(
                         self._X_device(), z, self._weights_device())
                     self.X = X_new
-                    rel = float(rel_dev)
                     stepped = True
+                    fetch_k = max(int(params.status_fetch_every), 1)
+                    if run is not None or fetch_k == 1 or \
+                            self._status.iteration_number % fetch_k == 0:
+                        rel = float(rel_dev)
+                    else:
+                        # Verdict-cadence discipline (status_fetch_every):
+                        # the scalar stays device-latched; the gossiped
+                        # status reuses the last fetched value, so this
+                        # iterate performs ZERO device->host transfers.
+                        rel = self._status.relative_change
             self._status.relative_change = rel
             ready = stepped and rel <= params.rel_change_tol
             if robust_on and params.robust.cost_type == RobustCostType.GNC_TLS:

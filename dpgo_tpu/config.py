@@ -162,6 +162,15 @@ class AgentParams:
     # Termination
     max_num_iters: int = 500
     rel_change_tol: float = 5e-3
+    # Deployment-plane verdict cadence (beyond-reference): PGOAgent's
+    # iterate() materializes its one status scalar (the relative change)
+    # only every this-many iterates, leaving it device-latched in
+    # between — the per-robot analog of the solver core's K-round
+    # verdict-word readback.  The gossiped termination status then lags
+    # the iterate by at most this many rounds.  1 (default) fetches every
+    # iterate (the exact pre-verdict behavior); telemetry-on runs always
+    # fetch per iterate regardless (the events carry the scalar).
+    status_fetch_every: int = 1
     # Schedule for the TPU step function
     schedule: Schedule = Schedule.JACOBI
     # Probability that an agent fires in a given ASYNC round (Poisson-clock

@@ -546,21 +546,22 @@ def pallas_vmem_ok(n_max: int, s_max: int, rank: int, d: int, T: int,
         <= PALLAS_TCG_VMEM_BUDGET_BYTES
 
 
-def agent_edge_tiles(i, j, R, t, n: int, s: int):
+def agent_edge_tiles(i, j, R, t, n: int, s: int, wide: bool = False):
     """Tile-major edge arrays for ONE agent's buffer-indexed edge list —
     the single-agent equivalent of ``build_graph``'s batched Pallas layout
     (``eidx_i/eidx_j [nt, 1, T]``, ``rot_t [nt, d*d, T]``,
     ``trn_t [nt, d, T]``; padding gets index ``n + s``, which one-hots to
     all-zero in both the local and neighbor ranges).  Used by the
     deployment surface (``agent.PGOAgent``) so per-robot iterates run the
-    same VMEM kernel as the batched core."""
+    same VMEM kernel as the batched core.  ``wide`` mirrors
+    ``build_graph``'s bf16-selection-mode tile widening."""
     i = np.asarray(i, np.int32)
     j = np.asarray(j, np.int32)
     R = np.asarray(R, np.float32)
     t = np.asarray(t, np.float32)
     e = i.shape[0]
     d = R.shape[-1]
-    T, nt = _edge_tile_shape(n, s, e)
+    T, nt = _edge_tile_shape(n, s, e, wide=wide)
     Ep = nt * T
     pad = n + s
     ii = np.full((Ep,), pad, np.int32)
@@ -963,8 +964,16 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
         return args, kw
 
     schedule = params.schedule
-    split = jax.vmap(lambda k: jax.random.split(k, 2))(state.key)  # [A, 2, 2]
-    key, sub = split[:, 0], split[:, 1]
+    if schedule == Schedule.ASYNC:
+        # Only the ASYNC Bernoulli clocks consume randomness; the other
+        # schedules previously paid a vmapped threefry split every round
+        # for keys nothing read.  The carried key is left untouched on
+        # those schedules (trajectories are unchanged — the key never
+        # feeds their math).
+        split = jax.vmap(lambda k: jax.random.split(k, 2))(state.key)
+        key, sub = split[:, 0], split[:, 1]  # [A, 2, 2] -> two [A, 2]
+    else:
+        key, sub = state.key, None
     if schedule == Schedule.GREEDY:
         # One agent fires per round (the reference driver's argmax-gradnorm
         # selection, ``MultiRobotExample.cpp:242-256``).  Solving every
@@ -1001,7 +1010,7 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
             *args, *kw.values())
 
     if schedule == Schedule.JACOBI:
-        fired = jnp.ones((A_loc,), bool)
+        fired = None  # every agent fires: the select masks below drop out
     elif schedule == Schedule.GREEDY:
         fired = agent_ids == sel
     elif schedule == Schedule.ASYNC:
@@ -1019,16 +1028,16 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
         fired = graph.color == (state.iteration % meta.num_colors)
     else:
         raise ValueError(f"unknown schedule {schedule}")
-    fired_b = fired[:, None, None, None]
+    fired_b = None if fired is None else fired[:, None, None, None]
 
     if accel and not restart:
         # Non-fired agents take the momentum point (updateX(false, true):
         # X = Y, PGOAgent.cpp:1094-1098); V advances for everyone.
-        X_next = jnp.where(fired_b, X_upd, Ynes)
+        X_next = X_upd if fired_b is None else jnp.where(fired_b, X_upd, Ynes)
         g = gamma[:, None, None, None]
         V = manifold.project(V + g * (X_next - Ynes))
     else:
-        X_next = jnp.where(fired_b, X_upd, X)
+        X_next = X_upd if fired_b is None else jnp.where(fired_b, X_upd, X)
         if accel:  # restart round: collapse the aux sequences
             V = X_next
             gamma = jnp.zeros_like(gamma)
@@ -1046,8 +1055,11 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
     ratio = _converged_weight_ratio(edges, params)
     if ratio is not None:
         ready_new &= ratio >= params.robust_opt_min_convergence_ratio
-    rel = jnp.where(fired, rel_new, state.rel_change)
-    ready = jnp.where(fired, ready_new, state.ready)
+    if fired is None:
+        rel, ready = rel_new, ready_new
+    else:
+        rel = jnp.where(fired, rel_new, state.rel_change)
+        ready = jnp.where(fired, ready_new, state.ready)
 
     return RBCDState(X=X_next, weights=weights,
                      iteration=state.iteration + 1, key=key,
@@ -1336,16 +1348,16 @@ def schedule_bounds(n_done: int, nwu: int, *, max_iters: int,
     return uw, rs, end
 
 
-def _make_central_metrics(graph: MultiAgentGraph, edges_g: EdgeSet,
+def _central_metrics_body(graph: MultiAgentGraph, edges_g: EdgeSet,
                           n_total: int, num_meas: int, telemetry: bool):
-    """The jitted per-eval readback program of ``run_rbcd`` — one stacked
-    output = ONE device->host transfer per eval (each separate scalar
-    fetch costs a full round-trip on a tunneled TPU).  Factored out so the
-    flight recorder's replay evaluates the recorded trajectory through the
-    byte-identical XLA program (bit-for-bit reproduction requires the same
-    compiled reduction order, not merely the same math)."""
+    """The (unjitted) stacked-eval computation shared by
+    ``_make_central_metrics`` and the fused verdict program
+    (``make_verdict_program``): both trace the *same* Python body, so the
+    per-eval rows the verdict program stores in its device-side history
+    are bit-identical to what the standalone metrics program fetches —
+    the flight-recorder replay contract extends across the verdict seam
+    (pinned by ``tests/test_recorder.py``)."""
 
-    @jax.jit
     def central_metrics(Xa, weights, ready, mu, rel_change):
         Xg = gather_to_global(Xa, graph, n_total)
         eg = edges_g._replace(weight=global_weights(weights, graph, num_meas))
@@ -1364,6 +1376,248 @@ def _make_central_metrics(graph: MultiAgentGraph, edges_g: EdgeSet,
         return jnp.stack(vals)
 
     return central_metrics
+
+
+def _make_central_metrics(graph: MultiAgentGraph, edges_g: EdgeSet,
+                          n_total: int, num_meas: int, telemetry: bool):
+    """The jitted per-eval readback program of ``run_rbcd`` — one stacked
+    output = ONE device->host transfer per eval (each separate scalar
+    fetch costs a full round-trip on a tunneled TPU).  Factored out so the
+    flight recorder's replay evaluates the recorded trajectory through the
+    byte-identical XLA program (bit-for-bit reproduction requires the same
+    compiled reduction order, not merely the same math)."""
+    return jax.jit(_central_metrics_body(graph, edges_g, n_total, num_meas,
+                                         telemetry))
+
+
+# ---------------------------------------------------------------------------
+# Device-resident verdict loop
+# ---------------------------------------------------------------------------
+#
+# The verdict word is one packed int32 the host reads back every K rounds in
+# place of the full per-eval scalar stack:
+#
+#   bits 0-2   status        0 RUNNING | 1 GRAD_NORM | 2 CONSENSUS
+#   bits 3-5   anomaly class 0 none | 1 cost_spike | 2 stall
+#                            | 3 grad_explosion | 4 non_finite
+#                            (highest-severity class seen so far, latched)
+#   bits 6+    GNC stage index (robust.gnc_stage_index, 0 when not robust)
+#
+# Termination latches ON DEVICE at the first eval whose gradient norm
+# clears the tolerance (or whose agents reach consensus); the host only
+# learns about it at the next K-round fetch, so the returned iterate may
+# carry up to K - eval_every extra polish rounds past the terminal eval —
+# histories, telemetry, and ``iterations`` are truncated at the latched
+# terminal eval, so the *reported* trajectory is identical to the
+# per-eval path's.
+
+VERDICT_RUNNING = 0
+VERDICT_GRAD_NORM = 1
+VERDICT_CONSENSUS = 2
+_VERDICT_STATUS = {VERDICT_RUNNING: "running",
+                   VERDICT_GRAD_NORM: "grad_norm",
+                   VERDICT_CONSENSUS: "consensus"}
+
+ANOMALY_NONE = 0
+ANOMALY_COST_SPIKE = 1
+ANOMALY_STALL = 2
+ANOMALY_GRAD_EXPLOSION = 3
+ANOMALY_NON_FINITE = 4
+_VERDICT_ANOMALY = {ANOMALY_NONE: None, ANOMALY_COST_SPIKE: "cost_spike",
+                    ANOMALY_STALL: "stall",
+                    ANOMALY_GRAD_EXPLOSION: "grad_explosion",
+                    ANOMALY_NON_FINITE: "non_finite"}
+
+
+def pack_verdict(status: int, anomaly: int = 0, stage: int = 0) -> int:
+    """Host-side packer (tests / documentation of the word layout)."""
+    return int(status) | (int(anomaly) << 3) | (int(stage) << 6)
+
+
+def unpack_verdict(word: int) -> dict:
+    """Decode a fetched verdict word into named fields."""
+    word = int(word)
+    return {"status": _VERDICT_STATUS.get(word & 7, "?"),
+            "anomaly": _VERDICT_ANOMALY.get((word >> 3) & 7),
+            "stage": word >> 6}
+
+
+def _host_fetch(x):
+    """THE device->host transfer seam of the driver loops.
+
+    Every sanctioned readback in ``run_rbcd`` (and the serving plane's
+    ``run_bucket``) goes through this one function so benchmarks and
+    tests can count host syncs by patching it (``bench.py``'s
+    ``host_syncs_per_100_rounds`` shim — the same technique as the
+    zero-overhead telemetry smoke).  Semantically just ``np.asarray``."""
+    return np.asarray(x)
+
+
+class VerdictState(NamedTuple):
+    """Device-resident control/health state carried across evals.
+
+    ``hist`` accumulates the exact per-eval stacked-metrics rows
+    (``_central_metrics_body`` output) so the full scalar stack can be
+    fetched lazily — once per verdict fetch with telemetry on, once at
+    termination with telemetry off — instead of per eval."""
+
+    word: jax.Array        # int32 packed verdict (see module constants)
+    eval_idx: jax.Array    # int32 number of eval rows recorded
+    term_eval: jax.Array   # int32 eval index of the terminal eval (-1)
+    term_it: jax.Array     # int32 iteration of the terminal eval (-1)
+    best_cost: jax.Array   # stage-best cost (cost_spike baseline)
+    min_gn: jax.Array      # stage-min gradient norm (explosion baseline)
+    stage: jax.Array       # int32 GNC stage index
+    stall_anchor: jax.Array  # cost at the stall window anchor
+    stall_len: jax.Array     # int32 evals since the anchor
+    stall_fired: jax.Array   # bool, once per stage
+    hist: jax.Array        # [max_evals, W] per-eval metric rows
+
+
+def init_verdict_state(max_evals: int, num_robots: int, dtype,
+                       telemetry: bool) -> VerdictState:
+    """Fresh verdict state sized for ``max_evals`` eval boundaries.  Row
+    width matches ``_central_metrics_body``: 3 scalars, +3 GNC scalars and
+    the per-agent relative change with telemetry on."""
+    dt = jnp.dtype(dtype)
+    W = (6 + num_robots) if telemetry else 3
+    inf = jnp.asarray(jnp.inf, dt)
+    z32 = jnp.zeros((), jnp.int32)
+    return VerdictState(
+        word=z32, eval_idx=z32,
+        term_eval=jnp.full((), -1, jnp.int32),
+        term_it=jnp.full((), -1, jnp.int32),
+        best_cost=inf, min_gn=inf, stage=z32,
+        stall_anchor=inf, stall_len=z32,
+        stall_fired=jnp.zeros((), bool),
+        hist=jnp.zeros((max_evals, W), dt))
+
+
+def _device_gnc_stage(mu, mu0: float, step: float, kmax: int):
+    """Device twin of ``robust.gnc_stage_index`` (same clamp semantics);
+    ``mu0``/``step``/``kmax`` are static host floats resolved by the
+    program builder."""
+    if mu0 <= 0 or step <= 1.0:
+        return jnp.zeros((), jnp.int32)
+    k = jnp.round(jnp.log(jnp.maximum(mu, mu0) / mu0) / np.log(step))
+    return jnp.clip(k.astype(jnp.int32), 0, kmax)
+
+
+def make_verdict_program(graph: MultiAgentGraph, edges_g: EdgeSet,
+                         n_total: int, num_meas: int, telemetry: bool, *,
+                         grad_norm_tol: float,
+                         robust_params: RobustCostParams | None,
+                         max_evals: int, health_cfg=None):
+    """The fused per-eval program of the device-resident loop: evaluates
+    the central metrics (the byte-identical ``_central_metrics_body``
+    subcomputation), appends the row to the device-side history, folds the
+    convergence test and the health predicates of ``obs.health`` into the
+    packed verdict word, and latches the first terminal eval.
+
+    The on-device predicates mirror ``HealthMonitor.observe_solver``'s
+    per-stage baselines (non-finite sentinel, cost spike vs stage best,
+    gradient explosion vs stage min, stall over a cost window) with one
+    documented simplification: the stall window is block-aligned (anchor
+    cost refreshed every ``stall_window`` evals) instead of sliding.  The
+    word's anomaly class is the in-band signal; with telemetry on the
+    host-side monitor re-judges the fetched rows and remains the single
+    authority for anomaly *events* and abort policy, so the emitted event
+    stream is identical to the per-eval path's.
+
+    ``max_evals`` bounds the history; the driver never records more rows
+    than eval boundaries in ``max_iters``.  ``health_cfg`` duck-types
+    ``obs.health.HealthConfig`` (defaults used when None)."""
+    if health_cfg is None:
+        from ..obs.health import HealthConfig
+
+        health_cfg = HealthConfig()
+    body = _central_metrics_body(graph, edges_g, n_total, num_meas,
+                                 telemetry)
+    spike_rtol = float(health_cfg.cost_spike_rtol)
+    spike_atol = float(health_cfg.cost_spike_atol)
+    expl_factor = float(health_cfg.grad_explosion_factor)
+    gn_floor = float(health_cfg.grad_floor)
+    stall_window = int(health_cfg.stall_window)
+    stall_rtol = float(health_cfg.stall_rtol)
+    del max_evals  # sized into the VerdictState by init_verdict_state
+    if robust_params is not None:
+        gnc_mu0 = float(robust_params.gnc_init_mu)
+        gnc_step = float(robust_params.gnc_mu_step)
+        gnc_kmax = int(robust_params.gnc_max_iters)
+
+    @jax.jit
+    def verdict_step(Xa, weights, ready, mu, rel_change, iteration,
+                     vs: VerdictState) -> VerdictState:
+        vec = body(Xa, weights, ready, mu, rel_change)
+        f, gn, consensus = vec[0], vec[1], vec[2]
+        if robust_params is not None:
+            stage = _device_gnc_stage(mu, gnc_mu0, gnc_step, gnc_kmax)
+        else:
+            stage = jnp.zeros((), jnp.int32)
+
+        # Per-stage baselines reset on stage transitions (the monitor's
+        # _new_stage); the stall anchor additionally seeds itself on the
+        # first finite cost.
+        fresh = stage != vs.stage
+        inf = jnp.asarray(jnp.inf, vec.dtype)
+        best = jnp.where(fresh, inf, vs.best_cost)
+        ming = jnp.where(fresh, inf, vs.min_gn)
+        seed = fresh | ~jnp.isfinite(vs.stall_anchor)
+        anchor = jnp.where(seed, f, vs.stall_anchor)
+        slen = jnp.where(seed, 0, vs.stall_len)
+        sfired = jnp.where(fresh, False, vs.stall_fired)
+
+        finite = jnp.isfinite(f) & jnp.isfinite(gn) \
+            & jnp.all(jnp.isfinite(rel_change))
+        # Judge against the PRE-update baselines (monitor order), and only
+        # on finite evals (the monitor early-returns on non-finite).
+        spike = finite & jnp.isfinite(best) \
+            & (f > best * (1.0 + spike_rtol) + spike_atol)
+        expl = finite & jnp.isfinite(ming) \
+            & (gn > expl_factor * jnp.maximum(ming, gn_floor))
+        if stall_window > 1:
+            slen = slen + 1
+            full = slen >= stall_window
+            stalled = finite & full & ~sfired \
+                & (anchor - f <= stall_rtol * jnp.abs(anchor))
+            sfired = sfired | stalled
+            anchor = jnp.where(full, f, anchor)
+            slen = jnp.where(full, 0, slen)
+        else:
+            stalled = jnp.zeros((), bool)
+
+        code = jnp.zeros((), jnp.int32)
+        code = jnp.maximum(code, jnp.where(spike, ANOMALY_COST_SPIKE, 0))
+        code = jnp.maximum(code, jnp.where(stalled, ANOMALY_STALL, 0))
+        code = jnp.maximum(code,
+                           jnp.where(expl, ANOMALY_GRAD_EXPLOSION, 0))
+        code = jnp.maximum(code,
+                           jnp.where(~finite, ANOMALY_NON_FINITE, 0))
+        anom = jnp.maximum((vs.word >> 3) & 7, code)
+
+        status_now = jnp.where(
+            gn < grad_norm_tol, VERDICT_GRAD_NORM,
+            jnp.where(consensus > 0, VERDICT_CONSENSUS,
+                      VERDICT_RUNNING)).astype(jnp.int32)
+        status = jnp.where(vs.term_eval >= 0, vs.word & 7, status_now)
+        first_term = (vs.term_eval < 0) & (status != VERDICT_RUNNING)
+        term_eval = jnp.where(first_term, vs.eval_idx, vs.term_eval)
+        term_it = jnp.where(first_term, iteration.astype(jnp.int32),
+                            vs.term_it)
+
+        best = jnp.where(finite, jnp.minimum(best, f), best)
+        ming = jnp.where(finite, jnp.minimum(ming, gn), ming)
+        hist = jax.lax.dynamic_update_slice(
+            vs.hist, vec[None, :].astype(vs.hist.dtype),
+            (vs.eval_idx, jnp.zeros((), vs.eval_idx.dtype)))
+        word = (status | (anom << 3) | (stage << 6)).astype(jnp.int32)
+        return VerdictState(word=word, eval_idx=vs.eval_idx + 1,
+                            term_eval=term_eval, term_it=term_it,
+                            best_cost=best, min_gn=ming, stage=stage,
+                            stall_anchor=anchor, stall_len=slen,
+                            stall_fired=sfired, hist=hist)
+
+    return verdict_step
 
 
 def _package_version() -> str:
@@ -1404,6 +1658,7 @@ def run_rbcd(
     params: AgentParams | None = None,
     multi_step=None,
     segment=None,
+    verdict_every: int | None = None,
 ) -> RBCDResult:
     """The driver loop shared by the single-device and mesh-sharded solvers —
     the analog of the ``multi-robot-example`` loop
@@ -1430,6 +1685,22 @@ def run_rbcd(
     equivalent), so flagged rounds stop costing their own round-trips.
     The GNC weight freeze runs on-device either way (see ``_rbcd_round``),
     so no path reads weights back between evals.
+
+    ``verdict_every`` (K, a positive multiple of ``eval_every``) switches
+    the driver to the DEVICE-RESIDENT verdict loop: the centralized
+    metrics, the convergence test, and the health predicates run in the
+    fused verdict program at every eval boundary (``make_verdict_program``
+    — requires ``segment``), termination latches on device, and the host
+    reads back ONE packed verdict word per K rounds instead of the full
+    scalar stack per eval.  With telemetry on, the device-side eval
+    history is fetched lazily at each verdict boundary and replayed
+    through the same gauges/events/health-monitor/flight-recorder calls,
+    so the emitted event stream is identical to the per-eval path's (with
+    at most K rounds of latency); with telemetry off, only the word and a
+    terminal history fetch ever cross the link.  Because the host learns
+    of termination at the next boundary, the returned iterate may carry
+    up to ``K - eval_every`` extra polish rounds; reported histories and
+    ``iterations`` are truncated at the latched terminal eval.
     """
     n_total = part.meas_global.num_poses
     num_meas = len(part.meas_global)
@@ -1532,6 +1803,66 @@ def run_rbcd(
             g_inl = obs_run.gauge("gnc_inlier_fraction",
                                   "fraction of updatable LC edges at w>0.5")
 
+    host_fetches = 0  # sanctioned device->host syncs inside the loop
+
+    def _emit_eval(it_ev, vec, rounds, per_round, state=None, nwu=0):
+        """One eval's telemetry — gauges, metric events, flight-recorder
+        ring, health verdict — shared verbatim by the per-eval path and
+        the verdict path (which feeds it lazily-fetched history rows), so
+        both emit the identical event stream.  ``vec`` is a host-side
+        telemetry-width metrics row; ``state`` is passed only when an
+        exact snapshot is available at this eval (the per-eval path)."""
+        f, gn = float(vec[0]), float(vec[1])
+        mu_v, inl, mean_w = (float(x) for x in vec[3:6])
+        rel = vec[6:]
+        g_cost.set(f)
+        g_gn.set(gn)
+        c_rounds.inc(rounds)
+        c_evals.inc()
+        h_round.observe(per_round)
+        for a in range(rel.shape[0]):
+            g_agent_lat.set(per_round, agent=a)
+            g_agent_rel.set(float(rel[a]), agent=a)
+        ev = {"iteration": it_ev, "round_latency_s": per_round,
+              # rel is a host-side row of an already-materialized
+              # vector; .max() is numpy. dpgolint: disable=DPG003
+              "rel_change_max": float(rel.max()) if rel.size else None}
+        obs_run.metric("solver_cost", f, phase="eval", **ev)
+        obs_run.metric("solver_grad_norm", gn, phase="eval", **ev)
+        if robust_on:
+            g_mu.set(mu_v)
+            g_inl.set(inl)
+            obs_run.metric("gnc_mu", mu_v, phase="eval", iteration=it_ev)
+            obs_run.metric("gnc_inlier_fraction", inl, phase="eval",
+                           iteration=it_ev, mean_weight=mean_w)
+        # Flight recorder first (so an anomaly dump includes this
+        # eval), then the health verdict — which may dump and, per
+        # the abort policy, raise SolverHealthError.
+        if flight_rec is not None:
+            flight_rec.record_eval(
+                it_ev, {"cost": f, "grad_norm": gn,
+                        "mu": mu_v, "inlier_frac": inl,
+                        "rel_change": rel},
+                state=state, num_weight_updates=nwu)
+        if health_mon is not None:
+            health_mon.observe_solver(
+                it_ev, f, gn,
+                mu=mu_v if robust_on else None,
+                inlier_frac=inl if robust_on else None,
+                rel_change=rel,
+                stage=robust.gnc_stage_index(mu_v, params.robust)
+                if robust_on else None)
+
+    if verdict_every is not None:
+        return _run_verdict_loop(
+            state, graph, meta, segment, max_iters=max_iters,
+            grad_norm_tol=grad_norm_tol, eval_every=eval_every,
+            verdict_every=verdict_every, dtype=dtype, params=params,
+            edges_g=edges_g, n_total=n_total, num_meas=num_meas,
+            telemetry=telemetry, obs_run=obs_run, health_mon=health_mon,
+            flight_rec=flight_rec, emit_eval=_emit_eval,
+            bounds=_bounds, robust_on=robust_on)
+
     # Pipelined driver: advance to each eval boundary, ENQUEUE the metrics
     # program, dispatch one speculative segment past the boundary, and only
     # then fetch the metrics — the device works through the speculation
@@ -1565,7 +1896,8 @@ def run_rbcd(
                 t_rb_m, t_rb_w = time.monotonic(), time.time()
             # THE sanctioned readback seam: the one stacked device->host
             # fetch per eval.  dpgolint: disable=DPG003 -- sanctioned seam
-            vec = np.asarray(fut)
+            vec = _host_fetch(fut)
+            host_fetches += 1
             if telemetry:
                 # The eval readback span: the device->host fetch the pipelined
                 # driver hides behind the speculative segment — its duration on
@@ -1583,46 +1915,8 @@ def run_rbcd(
                 dt, t_window = now - t_window, now
                 rounds = max(it - it_window, 1)
                 it_window = it
-                per_round = dt / rounds
-                mu_v, inl, mean_w = (float(x) for x in vec[3:6])
-                rel = vec[6:]
-                g_cost.set(float(f))
-                g_gn.set(float(gn))
-                c_rounds.inc(rounds)
-                c_evals.inc()
-                h_round.observe(per_round)
-                for a in range(rel.shape[0]):
-                    g_agent_lat.set(per_round, agent=a)
-                    g_agent_rel.set(float(rel[a]), agent=a)
-                ev = {"iteration": it, "round_latency_s": per_round,
-                      # rel is a host-side row of the already-materialized
-                      # vec; .max() is numpy. dpgolint: disable=DPG003
-                      "rel_change_max": float(rel.max()) if rel.size else None}
-                obs_run.metric("solver_cost", float(f), phase="eval", **ev)
-                obs_run.metric("solver_grad_norm", float(gn), phase="eval", **ev)
-                if robust_on:
-                    g_mu.set(mu_v)
-                    g_inl.set(inl)
-                    obs_run.metric("gnc_mu", mu_v, phase="eval", iteration=it)
-                    obs_run.metric("gnc_inlier_fraction", inl, phase="eval",
-                                   iteration=it, mean_weight=mean_w)
-                # Flight recorder first (so an anomaly dump includes this
-                # eval), then the health verdict — which may dump and, per
-                # the abort policy, raise SolverHealthError.
-                if flight_rec is not None:
-                    flight_rec.record_eval(
-                        it, {"cost": float(f), "grad_norm": float(gn),
-                             "mu": mu_v, "inlier_frac": inl,
-                             "rel_change": rel},
-                        state=state, num_weight_updates=num_weight_updates)
-                if health_mon is not None:
-                    health_mon.observe_solver(
-                        it, float(f), float(gn),
-                        mu=mu_v if robust_on else None,
-                        inlier_frac=inl if robust_on else None,
-                        rel_change=rel,
-                        stage=robust.gnc_stage_index(mu_v, params.robust)
-                        if robust_on else None)
+                _emit_eval(it, vec, rounds, dt / rounds, state=state,
+                           nwu=num_weight_updates)
             if float(gn) < grad_norm_tol:
                 terminated_by = "grad_norm"
                 break
@@ -1640,6 +1934,7 @@ def run_rbcd(
 
     T, w_glob = _finalize(state.X, state.weights)
     if telemetry:
+        _emit_sync_rate(obs_run, host_fetches, it)
         obs_run.event(
             "solve_end", phase="solve", iterations=it,
             terminated_by=terminated_by,
@@ -1649,6 +1944,162 @@ def run_rbcd(
             num_weight_updates=num_weight_updates)
     return RBCDResult(T=T, X=state.X, cost_history=cost_hist,
                       grad_norm_history=gn_hist, iterations=it,
+                      terminated_by=terminated_by, weights=w_glob)
+
+
+def _emit_sync_rate(obs_run, fetches: int, rounds: int) -> None:
+    """Record the measured in-loop host-sync rate: the readback-kill
+    metric (``host_syncs_per_100_rounds``; lower is better, gated by
+    ``obs.regress``).  Counts only the driver-loop fetches through the
+    ``_host_fetch`` seam — the terminal finalize transfer is excluded, as
+    it is paid once per solve regardless of loop design."""
+    rate = 100.0 * fetches / max(rounds, 1)
+    obs_run.gauge("host_syncs_per_100_rounds",
+                  "driver-loop device->host fetches per 100 RBCD rounds"
+                  ).set(rate)
+    obs_run.metric("host_syncs_per_100_rounds", rate, phase="solve",
+                   fetches=fetches, rounds=rounds)
+
+
+def _run_verdict_loop(state, graph, meta, segment, *, max_iters,
+                      grad_norm_tol, eval_every, verdict_every, dtype,
+                      params, edges_g, n_total, num_meas, telemetry,
+                      obs_run, health_mon, flight_rec, emit_eval, bounds,
+                      robust_on):
+    """Body of ``run_rbcd``'s device-resident mode (see its docstring).
+
+    Per verdict boundary (every K rounds): dispatch the schedule segments
+    and the fused verdict evals, ENQUEUE the next boundary's work (depth-1
+    speculation, so the word fetch's round-trip hides behind device
+    execution), then fetch ONE packed int32.  The full per-eval history is
+    fetched lazily — per boundary with telemetry on (feeding the identical
+    gauge/event/health/recorder calls as the per-eval path), once at
+    termination otherwise."""
+    if verdict_every <= 0 or verdict_every % eval_every != 0:
+        raise ValueError(
+            f"verdict_every={verdict_every} must be a positive multiple "
+            f"of eval_every={eval_every}")
+    max_evals = -(-max_iters // eval_every)
+    verdict_step = make_verdict_program(
+        graph, edges_g, n_total, num_meas, telemetry,
+        grad_norm_tol=grad_norm_tol,
+        robust_params=params.robust if robust_on else None,
+        max_evals=max_evals,
+        health_cfg=health_mon.config if health_mon is not None else None)
+    vs0 = init_verdict_state(max_evals, meta.num_robots, dtype, telemetry)
+
+    eval_its: list[int] = []
+    fetches = 0
+
+    def advance(st, it, nwu, vs, target):
+        """Enqueue segments + fused verdict evals up to ``target`` (no
+        host synchronization — everything stays in flight)."""
+        while it < target:
+            ev_t = min(((it // eval_every) + 1) * eval_every, target)
+            while it < ev_t:
+                uw, rs, end = bounds(it, nwu)
+                nwu += int(uw)
+                st = segment(st, end - it, uw, rs)
+                it = end
+            vs = verdict_step(st.X, st.weights, st.ready, st.mu,
+                              st.rel_change, st.iteration, vs)
+            eval_its.append(it)
+        return st, it, nwu, vs
+
+    t_solve0 = t_window = time.perf_counter()
+    it_window = fed = 0
+    hist_rows = None
+    terminated_by = "max_iters"
+    n_keep = it_final = 0
+    with _crash_dump_scope(flight_rec):
+        it, nwu, vs = 0, 0, vs0
+        bound = lambda i: min(((i // verdict_every) + 1) * verdict_every,
+                              max_iters)
+        state, it, nwu, vs = advance(state, it, nwu, vs, bound(0))
+        n_pre = len(eval_its)
+        while True:
+            state_pre, it_pre, nwu_pre, vs_pre = state, it, nwu, vs
+            if it < max_iters:
+                # Depth-1 speculation: the NEXT boundary's segments and
+                # verdict evals execute while the word fetch below blocks
+                # the host for a tunnel round-trip; each loop iteration
+                # fetches exactly one boundary's word.
+                state, it, nwu, vs = advance(state, it, nwu, vs, bound(it))
+            # THE verdict readback: one packed int32 per K rounds (from
+            # the pre-speculation state, so it never waits on the
+            # speculative work).
+            # dpgolint: disable=DPG003 -- sanctioned verdict-word fetch
+            word = int(_host_fetch(vs_pre.word))
+            fetches += 1
+            status = word & 7
+            terminal = status != VERDICT_RUNNING or it_pre >= max_iters
+            if telemetry or terminal:
+                # Lazy full-stack fetch: the per-eval scalar rows the
+                # telemetry/health/recorder consumers see.  Recurring
+                # (counted) with telemetry on; with telemetry off it
+                # happens once, at termination — epilogue, like
+                # ``_finalize``, and excluded from the sync-rate metric.
+                # dpgolint: disable=DPG003 -- sanctioned lazy history fetch
+                hist_rows = _host_fetch(vs_pre.hist)
+                fetches += int(telemetry)
+            if terminal:
+                # dpgolint: disable=DPG003 -- terminal verdict bookkeeping
+                tail = _host_fetch(jnp.stack([vs_pre.term_eval,
+                                              vs_pre.term_it]))
+                term_eval, term_it = int(tail[0]), int(tail[1])
+                if term_eval >= 0:
+                    n_keep, it_final = term_eval + 1, term_it
+                    terminated_by = _VERDICT_STATUS.get(status, "max_iters")
+                else:
+                    n_keep, it_final = n_pre, it_pre
+                    terminated_by = "max_iters"
+            feed_to = min(n_pre, n_keep) if terminal else n_pre
+            if telemetry and feed_to > fed:
+                now = time.perf_counter()
+                dt, t_window = now - t_window, now
+                rounds_w = max(it_pre - it_window, 1)
+                it_window = it_pre
+                per_round = dt / rounds_w
+                for r in range(fed, feed_to):
+                    rounds_r = eval_its[r] - (eval_its[r - 1] if r else 0)
+                    emit_eval(eval_its[r], hist_rows[r], max(rounds_r, 1),
+                              per_round)
+                fed = feed_to
+                if flight_rec is not None and not terminal:
+                    # Exact-state snapshot at the verdict boundary (the
+                    # K-cadence analog of record_eval's snapshot path).
+                    # hist_rows is already host-side (the lazy fetch).
+                    rows_finite = np.isfinite(hist_rows[:feed_to]).all()
+                    flight_rec.snapshot_state(
+                        it_pre, state_pre, nwu_pre,
+                        healthy=bool(rows_finite))
+            if terminal:
+                state = state_pre
+                break
+            n_pre = len(eval_its)
+
+    cost_hist = [float(hist_rows[r, 0]) for r in range(n_keep)]
+    gn_hist = [float(hist_rows[r, 1]) for r in range(n_keep)]
+
+    @jax.jit
+    def _finalize(Xa, weights):
+        Xg = gather_to_global(Xa, graph, n_total)
+        return (round_global(Xg, lifting_matrix(meta, Xg.dtype)),
+                global_weights(weights, graph, num_meas))
+
+    T, w_glob = _finalize(state.X, state.weights)
+    if telemetry:
+        _emit_sync_rate(obs_run, fetches, max(it_pre, 1))
+        obs_run.event(
+            "solve_end", phase="solve", iterations=it_final,
+            terminated_by=terminated_by,
+            duration_s=time.perf_counter() - t_solve0,
+            cost=cost_hist[-1] if cost_hist else None,
+            grad_norm=gn_hist[-1] if gn_hist else None,
+            num_weight_updates=nwu_pre,
+            verdict_every=verdict_every, verdict=unpack_verdict(word))
+    return RBCDResult(T=T, X=state.X, cost_history=cost_hist,
+                      grad_norm_history=gn_hist, iterations=it_final,
                       terminated_by=terminated_by, weights=w_glob)
 
 
@@ -1734,10 +2185,13 @@ def dispatch_prepared(
     grad_norm_tol: float = 0.1,
     eval_every: int = 1,
     state: RBCDState | None = None,
+    verdict_every: int | None = None,
 ) -> RBCDResult:
     """Solve dispatch for a prepared problem: build the step closures and
     run the shared driver loop (``run_rbcd``).  ``state`` overrides the
-    fresh ``init_state`` — e.g. to resume from a snapshot."""
+    fresh ``init_state`` — e.g. to resume from a snapshot.
+    ``verdict_every`` opts into the device-resident verdict loop (one
+    packed-word readback per K rounds — see ``run_rbcd``)."""
     params = prob.params
     max_iters = params.max_num_iters if max_iters is None else max_iters
     if state is None:
@@ -1755,7 +2209,8 @@ def dispatch_prepared(
                                             first_restart=rs)
     return run_rbcd(state, graph, meta, step, prob.part, max_iters,
                     grad_norm_tol, eval_every, prob.dtype, params=params,
-                    multi_step=multi, segment=seg)
+                    multi_step=multi, segment=seg,
+                    verdict_every=verdict_every)
 
 
 def solve_rbcd(
@@ -1768,6 +2223,7 @@ def solve_rbcd(
     dtype=jnp.float64,
     part: Partition | None = None,
     init: str = "chordal",
+    verdict_every: int | None = None,
 ) -> RBCDResult:
     """Distributed solve on one device with centralized monitoring —
     ``prepare_problem`` + ``dispatch_prepared`` in one call."""
@@ -1775,7 +2231,8 @@ def solve_rbcd(
                            part=part, init=init)
     return dispatch_prepared(prob, max_iters=max_iters,
                              grad_norm_tol=grad_norm_tol,
-                             eval_every=eval_every)
+                             eval_every=eval_every,
+                             verdict_every=verdict_every)
 
 
 def solve_rbcd_robust_iterated(

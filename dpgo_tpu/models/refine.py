@@ -40,6 +40,7 @@ never materializing ``X`` in f32.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import NamedTuple
 
 import jax
@@ -986,3 +987,208 @@ def solve_refine(Xg64: np.ndarray, graph, meta, params: AgentParams,
     # (its `continue` consumed the final iteration): the safeguard only
     # fires with a recorded best, so return it.
     return best[1], best[0], max_cycles, history
+
+
+# ---------------------------------------------------------------------------
+# Gauss-Newton-CG centralized tail (the BCD-stall breaker)
+# ---------------------------------------------------------------------------
+#
+# BCD (and the momentum polish above) are first-order in the coupling
+# between blocks: on ill-conditioned graphs (ais2klinik's long chain, the
+# noisy-100k synthetic) the centralized gradient norm floors orders of
+# magnitude above the absolute gate while per-block solves keep
+# converging (docs/NEXT.md).  The lifted PGO cost is QUADRATIC in X, so
+# its Riemannian Hessian at X is the certificate operator S = Q - Lambda
+# that ``certify.sparse_certificate`` already assembles on the host in
+# f64 — one sparse matrix gives both the exact gradient (X S, since
+# Lambda IS the tangent-projection multiplier) and the exact
+# Gauss-Newton/Newton model.  A preconditioned CG solve of
+# P (V S) = -grad on the tangent space, followed by a projective
+# retraction with a backtracking step, is a full second-order step at
+# O(E) memory — the polish stage that breaks the block-coordinate floor.
+
+
+@dataclasses.dataclass(frozen=True)
+class GNTailConfig:
+    """Knobs of the Gauss-Newton-CG tail (``gn_tail``)."""
+
+    max_outer: int = 20          # outer GN steps
+    grad_norm_tol: float = 0.1   # stop below this centralized grad norm
+    cg_max_iters: int = 400      # CG iterations per outer step
+    cg_rtol: float = 0.05        # relative residual target per CG solve
+    damping: float = 0.0         # Levenberg-style shift added to S
+    precond_shift: float = 0.1   # block-Jacobi factorization shift
+    step_shrink: float = 0.25    # backtracking factor
+    max_backtracks: int = 8
+
+
+@dataclasses.dataclass
+class GNTailResult:
+    X: np.ndarray                # [n, r, d+1] f64 polished iterate
+    cost_history: list
+    grad_norm_history: list      # per outer step, INCLUDING the final point
+    outer_iterations: int
+    cg_iterations: int
+    converged: bool
+    terminated_by: str           # grad_norm | max_outer | no_decrease
+
+
+def _gn_diag_blocks(S, n: int, dh: int, shift: float) -> np.ndarray:
+    """Per-pose (d+1)x(d+1) diagonal blocks of the sparse certificate
+    operator, plus a Tikhonov shift — the block-Jacobi preconditioner of
+    the tail's CG (the same Q + shift I recipe as the RBCD block solves).
+    Vectorized COO filter + scatter-add: no per-pose Python loop."""
+    C = S.tocoo()
+    m = (C.row // dh) == (C.col // dh)
+    blocks = np.zeros((n, dh, dh))
+    np.add.at(blocks, (C.row[m] // dh, C.row[m] % dh, C.col[m] % dh),
+              C.data[m])
+    blocks += shift * np.eye(dh)
+    return blocks
+
+
+def _gn_tangent(X: np.ndarray, V: np.ndarray, d: int) -> np.ndarray:
+    """Tangent projection at X (numpy twin of ``manifold.tangent_project``):
+    rotation columns lose their Y sym(Y^T W) component, translations pass."""
+    Y = X[..., :d]
+    W = V[..., :d]
+    YtW = np.einsum("nrd,nre->nde", Y, W)
+    sym = 0.5 * (YtW + np.swapaxes(YtW, -1, -2))
+    out = V.copy()
+    out[..., :d] = W - np.einsum("nrd,nde->nre", Y, sym)
+    return out
+
+
+def gn_tail(X64: np.ndarray, edges_global,
+            cfg: GNTailConfig | None = None, log=None) -> GNTailResult:
+    """Preconditioned Gauss-Newton-CG polish of a lifted global iterate
+    (host f64).  Opt-in: run it after the BCD/momentum stages stall
+    (``stall_handoff``) when an absolute gradient-norm gate matters.
+
+    Per outer step: assemble ``S = Q - Lambda(X)`` via
+    ``certify.sparse_certificate`` (the Riemannian gradient is exactly
+    ``X S`` and the Riemannian Hessian-vector ``P(V S)``), solve the
+    Newton system with block-Jacobi-preconditioned CG on the tangent
+    space (negative-curvature guard for indefinite saddles), and take a
+    backtracking projective retraction accepted only on true f64 cost
+    decrease.  Every quantity matches the driver's centralized oracle:
+    the reported gradient norm is the same ``manifold.norm(rgrad)`` the
+    ``run_rbcd`` gate reads."""
+    from .certify import sparse_certificate
+
+    cfg = cfg or GNTailConfig()
+    X = np.asarray(X64, np.float64).copy()
+    n, r, dh = X.shape
+    d = dh - 1
+    cost = global_cost(X, edges_global)
+    cost_hist = [cost]
+    gn_hist: list = []
+    cg_total = 0
+    terminated_by = "max_outer"
+    outer_done = 0
+
+    for outer in range(int(cfg.max_outer)):
+        S = sparse_certificate(X, edges_global)
+        Xf = X.transpose(1, 0, 2).reshape(r, n * dh)
+        grad = (Xf @ S).reshape(r, n, dh).transpose(1, 0, 2)
+        # X S is already tangent (Lambda is the projection multiplier);
+        # re-project for numerical hygiene before measuring the gate.
+        grad = _gn_tangent(X, grad, d)
+        gn = float(np.sqrt(np.sum(grad * grad)))
+        gn_hist.append(gn)
+        if log is not None:
+            log(f"  gn_tail outer {outer}: cost {cost:.9g} gn {gn:.4g}")
+        if gn < cfg.grad_norm_tol:
+            terminated_by = "grad_norm"
+            break
+        outer_done = outer + 1
+
+        blocks = _gn_diag_blocks(S, n, dh, cfg.precond_shift)
+
+        def A(V):
+            Vf = V.transpose(1, 0, 2).reshape(r, n * dh)
+            W = (Vf @ S).reshape(r, n, dh).transpose(1, 0, 2)
+            if cfg.damping:
+                W = W + cfg.damping * V
+            return _gn_tangent(X, W, d)
+
+        def Minv(V):
+            W = np.linalg.solve(blocks, V.transpose(0, 2, 1))
+            return _gn_tangent(X, W.transpose(0, 2, 1), d)
+
+        # Preconditioned CG on the tangent space, Steihaug-style negative
+        # curvature exit (fall back to the accumulated step, or steepest
+        # descent on the very first iteration).
+        b = -grad
+        v = np.zeros_like(b)
+        res = b.copy()
+        z = Minv(res)
+        p = z.copy()
+        rz = float(np.sum(res * z))
+        b_norm = float(np.sqrt(np.sum(b * b)))
+        for k in range(int(cfg.cg_max_iters)):
+            Ap = A(p)
+            pAp = float(np.sum(p * Ap))
+            cg_total += 1
+            if pAp <= 0:
+                if k == 0:
+                    v = b.copy()  # gradient direction
+                break
+            alpha = rz / pAp
+            v = v + alpha * p
+            res = res - alpha * Ap
+            if float(np.sqrt(np.sum(res * res))) <= cfg.cg_rtol * b_norm:
+                break
+            z = Minv(res)
+            rz_new = float(np.sum(res * z))
+            p = z + (rz_new / rz) * p
+            rz = rz_new
+
+        # Backtracking projective retraction on true f64 cost.
+        step = 1.0
+        accepted = False
+        for _ in range(int(cfg.max_backtracks)):
+            Xc = X + step * v
+            Xc = _np_project_manifold(Xc, d)
+            c_new = global_cost(Xc, edges_global)
+            if np.isfinite(c_new) and c_new < cost:
+                X, cost = Xc, c_new
+                accepted = True
+                break
+            step *= cfg.step_shrink
+        cost_hist.append(cost)
+        if not accepted:
+            terminated_by = "no_decrease"
+            break
+    else:
+        # max_outer exhausted: measure the final point's gate value.
+        S = sparse_certificate(X, edges_global)
+        Xf = X.transpose(1, 0, 2).reshape(r, n * dh)
+        grad = _gn_tangent(
+            X, (Xf @ S).reshape(r, n, dh).transpose(1, 0, 2), d)
+        gn_hist.append(float(np.sqrt(np.sum(grad * grad))))
+
+    return GNTailResult(
+        X=X, cost_history=cost_hist, grad_norm_history=gn_hist,
+        outer_iterations=outer_done, cg_iterations=cg_total,
+        converged=terminated_by == "grad_norm",
+        terminated_by=terminated_by)
+
+
+def stall_handoff(gn_history, window: int = 8, rtol: float = 1e-2,
+                  grad_norm_tol: float = 0.1) -> bool:
+    """The GN-tail trigger: True when the BCD gradient-norm trajectory
+    has plateaued ABOVE the absolute gate — no relative improvement over
+    the trailing ``window`` evals.  Mirrors the health layer's stall
+    detector semantics on the gradient norm instead of the cost, so the
+    driver can hand the iterate to ``gn_tail`` exactly when more BCD
+    rounds stopped paying."""
+    hist = [float(g) for g in gn_history]
+    if len(hist) < window:
+        return False
+    if hist[-1] < grad_norm_tol:
+        return False  # already through the gate — nothing to break
+    first, last = hist[-window], hist[-1]
+    if not (np.isfinite(first) and np.isfinite(last)):
+        return False
+    return first - last <= rtol * abs(first)

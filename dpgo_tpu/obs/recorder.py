@@ -201,6 +201,18 @@ class FlightRecorder:
         else:
             self._evals_since_snap += 1
 
+    def snapshot_state(self, iteration: int, state, num_weight_updates: int,
+                       healthy: bool = True) -> None:
+        """Take one exact-state snapshot outside ``record_eval``'s
+        cadence — the verdict-loop driver (``models.rbcd``'s
+        ``verdict_every`` mode) snapshots at its K-round fetch boundaries,
+        where the live state is on hand, while the per-eval scalar rows
+        arrive separately through ``record_eval(state=None)`` from the
+        lazily-fetched device history.  ``iteration`` must be an eval
+        boundary present in the ring for the replay to align."""
+        self._snapshot(iteration, state, num_weight_updates, bool(healthy))
+        self._evals_since_snap = 0
+
     def _snapshot(self, iteration: int, state, num_weight_updates: int,
                   healthy: bool) -> None:
         arrays = {}

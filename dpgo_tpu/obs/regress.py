@@ -37,8 +37,12 @@ import sys
 from .events import read_events_meta
 from .run import EVENTS_FILE, META_FILE
 
-#: Gated metrics and their improvement direction.
-GATED_METRICS = {"solver_cost": "lower", "solver_grad_norm": "lower"}
+#: Gated metrics and their improvement direction.  The host-sync rate is
+#: the readback-kill gate (ISSUE 9): a change that silently reintroduces
+#: per-eval device->host fetches into the driver loop regresses here even
+#: when the convergence numbers are untouched.
+GATED_METRICS = {"solver_cost": "lower", "solver_grad_norm": "lower",
+                 "host_syncs_per_100_rounds": "lower"}
 #: Fingerprint keys that never gate (recorded for the report only).
 NON_GATING_KEYS = {"version"}
 

@@ -387,10 +387,24 @@ def render_report(run_dir: str) -> str:
 
         for ev in events:
             if ev.get("event") == "solve_end":
+                verdict = ""
+                if ev.get("verdict_every"):
+                    v = ev.get("verdict") or {}
+                    verdict = (f" [verdict loop K={ev['verdict_every']}"
+                               + (f", anomaly={v['anomaly']}"
+                                  if v.get("anomaly") else "") + "]")
                 lines.append(
                     f"solve: {ev.get('iterations')} iterations, "
                     f"terminated by {ev.get('terminated_by')} "
-                    f"in {_fmt(ev.get('duration_s'))}s")
+                    f"in {_fmt(ev.get('duration_s'))}s" + verdict)
+        # The readback-kill measurement (one metric event per solve).
+        for ev in events:
+            if ev.get("event") == "metric" \
+                    and ev.get("metric") == "host_syncs_per_100_rounds":
+                lines.append(
+                    f"host syncs: {_fmt(ev.get('value'))} per 100 rounds "
+                    f"({ev.get('fetches')} fetches / "
+                    f"{ev.get('rounds')} rounds)")
 
         lines.append("trajectories:")
         metric_names = sorted({ev.get("metric") for ev in events

@@ -75,26 +75,33 @@ def _ab_gates() -> SimpleNamespace:
     rather than import time, so they are toggleable per-process and
     testable (a test can set the env var, rebuild a kernel, and unset it —
     no interpreter restart).  experiments/kernel_breakdown.py A/Bs these
-    at the 100k shape — see BASELINE.md round-5 VPU entry.
+    at the 100k shape — see BASELINE.md round-5 VPU entry and the round-6
+    promotion record.
 
-    * ``PALLAS_UNROLL_TILES`` — static-unroll the edge-tile loop
-      (measured dead end at 100k: VMEM overflow; default off).
     * ``PALLAS_NS_SWEEPS`` — Newton-Schulz sweeps in the retraction.
-    * ``PALLAS_SEL_PACKED`` — packed selection is the production DEFAULT
-      (round-5 A/B at 100k/64: bf16x3 33.8 -> 50.1 rounds/s from this
-      alone — the kernel is dot-ISSUE-bound there, and packing the split
-      passes into one row-stacked dot cuts issues 3x at identical MACs).
-      f32 mode is unaffected (no split passes).  "0" restores per-pass
-      dots.
+      DECIDED (round 5, reaffirmed round 6): the default stays 24 — ns8's
+      ~5-7% is not worth its 7e-4..2.6e-3 trajectory drift.  The gate is
+      the one remaining live A/B, kept so the tradeoff stays re-measurable
+      as shapes change.
+
+    Gates RETIRED in round 6 (decisions recorded in BASELINE.md):
+
+    * ``PALLAS_SEL_PACKED`` — the measured winner at every shape tested
+      (bf16x3 100k/64: 36.7 unpacked -> 57.6 packed in the defaults-
+      relative ablation; exact — identical MACs, 1/passes the dot
+      issues).  Packed selection is now UNCONDITIONAL; the unpacked
+      per-pass code path is deleted.
+    * ``PALLAS_UNROLL_TILES`` — measured dead end: Mosaic keeps every
+      unrolled tile's transient one-hots live concurrently, overflowing
+      scoped VMEM (16.55M > 16M at T=128 bf16x3) at exactly the shapes
+      that needed the pipelining.  Deleted.
 
     NOTE: jit/pallas caches key on shapes and function identity, not on
     these env vars — toggling a gate affects kernels built AFTER the
     toggle, not already-compiled ones.
     """
     return SimpleNamespace(
-        unroll_tiles=os.environ.get("PALLAS_UNROLL_TILES", "0") == "1",
-        ns_sweeps=int(os.environ.get("PALLAS_NS_SWEEPS", "24")),
-        sel_packed=os.environ.get("PALLAS_SEL_PACKED", "1") == "1")
+        ns_sweeps=int(os.environ.get("PALLAS_NS_SWEEPS", "24")))
 
 
 def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
@@ -169,27 +176,21 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         # operands and no precision, Mosaic resolves contract precision to
         # fp32 and rejects the matmul ("Bad lhs type").
         parts = _split(V, sel_passes)
-        if gates.sel_packed:
-            # PACKED: one dot on the row-stacked splits instead of
-            # ``sel_passes`` separate dots.  At the 100k shape the kernel
-            # is dot-ISSUE-bound, not MAC-bound (round-5 breakdown) —
-            # identical MXU work, 1/passes the issues.  The contraction
-            # axis is the same for every split (dims contracts V's axis
-            # ``cdim`` with Sel), so stacking rides the output row axis.
-            stacked = jnp.concatenate(parts, axis=0)
-            t = jax.lax.dot_general(stacked, Sel, dims,
-                                    precision=jax.lax.Precision.DEFAULT,
-                                    preferred_element_type=f32)
-            rows_out = t.shape[0] // sel_passes
-            return sum(t[p * rows_out:(p + 1) * rows_out]
-                       for p in range(sel_passes))
-        acc = None
-        for part in parts:
-            t = jax.lax.dot_general(part, Sel, dims,
-                                    precision=jax.lax.Precision.DEFAULT,
-                                    preferred_element_type=f32)
-            acc = t if acc is None else acc + t
-        return acc
+        # PACKED selection (unconditional since round 6 — the measured
+        # winner at every shape tested): one dot on the row-stacked
+        # splits instead of ``sel_passes`` separate dots.  At the 100k
+        # shape the kernel is dot-ISSUE-bound, not MAC-bound (round-5
+        # breakdown) — identical MXU work, 1/passes the issues.  The
+        # contraction axis is the same for every split (dims contracts
+        # V's axis ``cdim`` with Sel), so stacking rides the output row
+        # axis.
+        stacked = jnp.concatenate(parts, axis=0)
+        t = jax.lax.dot_general(stacked, Sel, dims,
+                                precision=jax.lax.Precision.DEFAULT,
+                                preferred_element_type=f32)
+        rows_out = t.shape[0] // sel_passes
+        return sum(t[p * rows_out:(p + 1) * rows_out]
+                   for p in range(sel_passes))
 
     def onehot2(ii, jj, m, base):
         """[m, 2T] PAIRED one-hot: columns [:T] select the i endpoints,
@@ -229,15 +230,10 @@ def _build_math(idx_i_ref, idx_j_ref, rot_ref, trn_ref, wk_ref, wt_ref,
         local_sel2 = lambda ti: onehot2(idx_i_ref[ti], idx_j_ref[ti], n, 0)
 
     def tile_loop(tile_fn, init):
-        if gates.unroll_tiles:
-            # Static unroll: nt is compile-time, so the Python loop frees
-            # Mosaic to software-pipeline each tile's MXU dots against the
-            # previous tile's VPU edge math (the fori_loop body is a
-            # scheduling barrier per tile).
-            acc = init
-            for ti in range(nt):
-                acc = tile_fn(ti, acc)
-            return acc
+        # Always the loop-carried fori_loop: static unroll (the retired
+        # PALLAS_UNROLL_TILES experiment) made Mosaic keep every tile's
+        # transient one-hots live concurrently — scoped-VMEM overflow at
+        # exactly the shapes that wanted the pipelining (BASELINE.md).
         return jax.lax.fori_loop(0, nt, tile_fn, init)
 
     Xr = rows(X)

@@ -80,6 +80,45 @@ def _make_metrics_exec(meta: rbcd.GraphMeta, n_total: int, num_meas: int):
     return jax.jit(jax.vmap(one))
 
 
+def _make_verdict_exec(meta: rbcd.GraphMeta, n_total: int, num_meas: int,
+                       grad_norm_tol: float):
+    """Batched fused-eval program of the verdict mode: per problem, the
+    centralized metrics, the convergence test, and a non-finite sentinel
+    fold into a packed per-problem verdict word (``rbcd``'s word layout),
+    the terminal eval latches on device, and the metric row appends to a
+    device-side history — so the host reads back ONE ``[B]`` int32 vector
+    per K rounds instead of the ``[B, 3]`` float stack per eval."""
+
+    def one(Xa, weights, ready, graph, eg, iteration,
+            word, term_eval, term_it, eval_idx, hist):
+        Xg = rbcd.gather_to_global(Xa, graph, n_total)
+        egw = eg._replace(
+            weight=rbcd.global_weights(weights, graph, num_meas))
+        f = quadratic.cost(Xg, egw)
+        g = manifold.rgrad(Xg, quadratic.egrad(Xg, egw))
+        gn = manifold.norm(g)
+        consensus = jnp.all(ready).astype(f.dtype)
+        vec = jnp.stack([f, gn, consensus])
+        status_now = jnp.where(
+            gn < grad_norm_tol, rbcd.VERDICT_GRAD_NORM,
+            jnp.where(consensus > 0, rbcd.VERDICT_CONSENSUS,
+                      rbcd.VERDICT_RUNNING)).astype(jnp.int32)
+        status = jnp.where(term_eval >= 0, word & 7, status_now)
+        finite = jnp.isfinite(f) & jnp.isfinite(gn)
+        anom = jnp.maximum((word >> 3) & 7,
+                           jnp.where(finite, 0, rbcd.ANOMALY_NON_FINITE))
+        first = (term_eval < 0) & (status != rbcd.VERDICT_RUNNING)
+        term_eval = jnp.where(first, eval_idx, term_eval)
+        term_it = jnp.where(first, iteration.astype(jnp.int32), term_it)
+        hist = jax.lax.dynamic_update_slice(
+            hist, vec[None, :].astype(hist.dtype),
+            (eval_idx, jnp.zeros((), eval_idx.dtype)))
+        return ((status | (anom << 3)).astype(jnp.int32),
+                term_eval, term_it, eval_idx + 1, hist)
+
+    return jax.jit(jax.vmap(one))
+
+
 def _make_finalize_exec(meta: rbcd.GraphMeta, n_total: int, num_meas: int):
     def one(Xa, weights, graph):
         Xg = rbcd.gather_to_global(Xa, graph, n_total)
@@ -109,13 +148,23 @@ def _cached_exec(cache: ExecutableCache, fp: dict, make,
 
 def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
                max_iters: int | None = None, grad_norm_tol: float = 0.1,
-               eval_every: int = 1):
+               eval_every: int = 1, verdict_every: int | None = None):
     """Solve a list of same-bucket padded problems as one batched program.
 
     Returns ``(results, info)``: per-problem ``RBCDResult`` (trajectories
     and weights sliced back to the problem's real pose/measurement
     counts), and a dict of batch statistics (rounds, evals, batch width,
-    occupancy) for the serving metrics."""
+    occupancy) for the serving metrics.
+
+    ``verdict_every`` (a positive multiple of ``eval_every``) switches
+    the batch to the device-resident verdict loop: per-problem
+    termination latches on device (``_make_verdict_exec``) and the host
+    reads back one packed ``[B]`` int32 verdict vector per K rounds per
+    bucket, with the per-eval histories fetched once at the end.  A
+    member that terminates mid-window runs up to ``K - eval_every``
+    extra polish rounds (monotone under the plain schedule, like the
+    legacy batch's wait-for-the-batch behavior); its reported history
+    and round count are truncated at its latched terminal eval."""
     if not padded:
         return [], {"rounds": 0, "evals": 0, "batch": 0, "occupancy": 0.0}
     first = padded[0]
@@ -168,7 +217,83 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
     term = ["max_iters"] * B_real
     iters = [max_iters] * B_real
     run = obs.get_run()
-    while it < max_iters and not all(done):
+
+    if verdict_every is not None:
+        if verdict_every <= 0 or verdict_every % eval_every != 0:
+            raise ValueError(
+                f"verdict_every={verdict_every} must be a positive "
+                f"multiple of eval_every={eval_every}")
+        vex = _cached_exec(
+            cache, problem_fingerprint(meta, params, dtype, shape, B,
+                                       f"verdict{grad_norm_tol}"),
+            lambda: _make_verdict_exec(meta, shape.n_total, shape.num_meas,
+                                       grad_norm_tol))
+        max_evals = -(-max_iters // eval_every)
+        word = jnp.zeros((B,), jnp.int32)
+        term_eval = jnp.full((B,), -1, jnp.int32)
+        term_it = jnp.full((B,), -1, jnp.int32)
+        eidx = jnp.zeros((B,), jnp.int32)
+        hist = jnp.zeros((B, max_evals, 3), jnp.dtype(dtype))
+        eval_its: list[int] = []
+        while True:
+            vtarget = min(((it // verdict_every) + 1) * verdict_every,
+                          max_iters)
+            t_d0 = time.monotonic() if run is not None else 0.0
+            with span("device_dispatch", phase="serve", batch=B,
+                      verdict=True):
+                while it < vtarget:
+                    target = min(((it // eval_every) + 1) * eval_every,
+                                 vtarget)
+                    while it < target:
+                        uw, rs, end = rbcd.schedule_bounds(
+                            it, nwu, max_iters=max_iters,
+                            eval_every=eval_every, params=params,
+                            robust_on=robust_on, accel_on=accel_on)
+                        nwu += int(uw)
+                        state_b = seg(state_b, graph_b, end - it,
+                                      uw=uw, rs=rs)
+                        it = end
+                    word, term_eval, term_it, eidx, hist = vex(
+                        state_b.X, state_b.weights, state_b.ready,
+                        graph_b, eg_b, state_b.iteration,
+                        word, term_eval, term_it, eidx, hist)
+                    evals += 1
+                    eval_its.append(it)
+                # The batch's one readback per K rounds: the packed
+                # per-problem verdict vector.
+                # dpgolint: disable=DPG003 -- sanctioned verdict fetch
+                wv = rbcd._host_fetch(word)
+            if run is not None:
+                dt = time.monotonic() - t_d0
+                run.gauge("serve_dispatch_device_seconds",
+                          "wall-clock of the last batched dispatch window "
+                          "(segment launches through metrics readback)",
+                          unit="s").set(dt)
+                run.counter("serve_device_time_seconds_total",
+                            "cumulative batched-dispatch wall-clock",
+                            unit="s").inc(dt)
+            all_terminal = ((wv & 7) != rbcd.VERDICT_RUNNING).all()
+            if it >= max_iters or bool(all_terminal):
+                break
+        # Terminal epilogue: the full per-eval histories and latched
+        # terminal indices, one transfer each (lazy — never per eval).
+        hist_h = rbcd._host_fetch(hist)
+        te_h = rbcd._host_fetch(jnp.stack([term_eval, term_it]))
+        for b in range(B_real):
+            te, ti = int(te_h[0, b]), int(te_h[1, b])
+            status = int(wv[b]) & 7
+            if te >= 0:
+                n_keep = te + 1
+                iters[b] = ti
+                term[b] = rbcd._VERDICT_STATUS.get(status, "max_iters")
+            else:
+                n_keep = len(eval_its)
+                iters[b] = it
+                term[b] = "max_iters"
+            cost_hist[b] = [float(hist_h[b, r, 0]) for r in range(n_keep)]
+            gn_hist[b] = [float(hist_h[b, r, 1]) for r in range(n_keep)]
+
+    while verdict_every is None and it < max_iters and not all(done):
         target = min(((it // eval_every) + 1) * eval_every, max_iters)
         t_d0 = time.monotonic() if run is not None else 0.0
         with span("device_dispatch", phase="serve", batch=B):

@@ -243,7 +243,8 @@ class SolveServer:
                  metrics_port: int | None = None,
                  metrics_host: str = "127.0.0.1",
                  profile_dir: str | None = None,
-                 profile_batches: int = 3):
+                 profile_batches: int = 3,
+                 verdict_every: int | None = None):
         if max_batch < 1 or max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
         self.max_batch = int(max_batch)
@@ -252,6 +253,12 @@ class SolveServer:
         self.tenant_quota = tenant_quota
         self.quantum = int(quantum)
         self.init = init
+        #: Device-resident termination for dispatched buckets: one packed
+        #: [B] verdict-vector readback per this many rounds instead of the
+        #: per-eval float stack (``runner.run_bucket``'s verdict mode).
+        #: Requests whose ``eval_every`` does not divide it dispatch on
+        #: the legacy per-eval loop.  None = legacy everywhere.
+        self.verdict_every = verdict_every
         #: One ``ServeSLO`` for every tenant, or a per-tenant dict (the
         #: ``"default"`` key, when present, covers unlisted tenants).
         self.slo = slo
@@ -584,10 +591,13 @@ class SolveServer:
         if self._profiler is not None:
             self._profiler.batch_begin()
         try:
+            ve = self.verdict_every
+            if ve is not None and ve % max(req0.eval_every, 1) != 0:
+                ve = None  # incompatible cadence: legacy per-eval loop
             results, info = run_bucket(
                 [t._padded for t in tickets], self.cache,
                 max_iters=req0.max_iters, grad_norm_tol=req0.grad_norm_tol,
-                eval_every=req0.eval_every)
+                eval_every=req0.eval_every, verdict_every=ve)
         except Exception as e:
             for t in tickets:
                 t._finish(exception=e)

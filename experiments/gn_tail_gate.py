@@ -1,0 +1,169 @@
+"""GN-tail vs BCD-floor A/B on the absolute-gradnorm gates (ROADMAP item
+4 / ISSUE 9): does the preconditioned Gauss-Newton-CG centralized tail
+(`models.refine.gn_tail`) break the block-coordinate stall that floors
+ais2klinik (TPU arm gn 1.16) and the noisy-100k certification probe?
+
+Protocol, per dataset arm:
+
+1. Solve with the standard RBCD pipeline until the gradient-norm
+   trajectory stalls (``refine.stall_handoff`` on the eval history, or
+   the round cap) — the handoff iterate is the SHARED starting point.
+2. Arm "bcd+": the same budget of additional plain BCD rounds (the
+   block-coordinate floor the tail claims to break).
+3. Arm "gn_tail": ``refine.gn_tail`` from the handoff iterate.
+
+Reports centralized f64 gradient norms (the ``run_rbcd`` gate quantity)
+at handoff and after each arm, and writes one JSON table
+(``gn_tail_gate_results.json``) for BASELINE.md.
+
+Usage:
+  python experiments/gn_tail_gate.py [--rounds N] [--extra N]
+      [--datasets ais2klinik,noisy2k,...]
+
+Dataset arms (g2o files resolve under /root/reference/data when
+present; synthetic arms build deterministically):
+  ais2klinik  — the SE(2) absolute-gate dataset (skipped if the file is
+                absent on this machine)
+  noisy2k/noisy10k/noisy100k — the noisy synthetic certification probe
+                at increasing scale (noise 0.1, 20% loop closures)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DATA_DIR = "/root/reference/data"
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_meas(name: str):
+    from dpgo_tpu.utils.synthetic import make_measurements
+
+    if name.startswith("noisy"):
+        n = int(name[5:].replace("k", "000"))
+        meas, _ = make_measurements(np.random.default_rng(7), n=n, d=3,
+                                    num_lc=n // 5, rot_noise=0.1,
+                                    trans_noise=0.1)
+        return meas
+    path = os.path.join(DATA_DIR, f"{name}.g2o")
+    if not os.path.exists(path):
+        return None
+    from dpgo_tpu.utils.g2o import read_g2o
+
+    return read_g2o(path)
+
+
+def run_arm(name: str, rounds: int, extra: int, robots: int, rank: int):
+    import jax.numpy as jnp
+    from dpgo_tpu.config import AgentParams
+    from dpgo_tpu.models import rbcd, refine
+    from dpgo_tpu.types import edge_set_from_measurements
+
+    meas = build_meas(name)
+    if meas is None:
+        log(f"[{name}] dataset file absent on this machine — skipped")
+        return {"skipped": "dataset absent"}
+    r = min(rank, 5) if meas.d == 3 else 3
+    params = AgentParams(d=meas.d, r=r, num_robots=robots,
+                         rel_change_tol=0.0)
+    prob = rbcd.prepare_problem(meas, robots, params=params,
+                                dtype=jnp.float64)
+    edges_g = edge_set_from_measurements(prob.part.meas_global,
+                                         dtype=jnp.float64)
+
+    # Stage 1: BCD to the stall handoff.
+    t0 = time.perf_counter()
+    res = rbcd.dispatch_prepared(prob, max_iters=rounds, eval_every=5,
+                                 grad_norm_tol=1e-12, verdict_every=20)
+    handoff_rounds = res.iterations
+    for k in range(8, len(res.grad_norm_history) + 1):
+        if refine.stall_handoff(res.grad_norm_history[:k], window=8):
+            handoff_rounds = 5 * k
+            break
+    gn_handoff = res.grad_norm_history[-1]
+    Xg = np.asarray(rbcd.gather_to_global(jnp.asarray(res.X), prob.graph,
+                                          prob.n_total), np.float64)
+    t_bcd = time.perf_counter() - t0
+    log(f"[{name}] handoff after {res.iterations} rounds "
+        f"(stall at ~{handoff_rounds}): gn {gn_handoff:.4g} "
+        f"({t_bcd:.1f}s)")
+
+    # Arm A: more of the same BCD (the block floor).
+    st = rbcd.init_state(prob.graph, prob.meta, jnp.asarray(res.X),
+                         params=params)
+    t0 = time.perf_counter()
+    res_b = rbcd.dispatch_prepared(prob, max_iters=extra, eval_every=extra,
+                                   grad_norm_tol=1e-12, state=st,
+                                   verdict_every=extra)
+    gn_bcd = res_b.grad_norm_history[-1]
+    t_arm_a = time.perf_counter() - t0
+    log(f"[{name}] bcd+{extra}: gn {gn_bcd:.4g} ({t_arm_a:.1f}s)")
+
+    # Arm B: the GN-CG tail from the same handoff iterate.
+    t0 = time.perf_counter()
+    tail = refine.gn_tail(Xg, edges_g,
+                          refine.GNTailConfig(max_outer=20,
+                                              grad_norm_tol=0.1),
+                          log=log)
+    t_tail = time.perf_counter() - t0
+    log(f"[{name}] gn_tail: gn {tail.grad_norm_history[-1]:.4g} "
+        f"({tail.outer_iterations} outer / {tail.cg_iterations} CG, "
+        f"{t_tail:.1f}s) terminated_by={tail.terminated_by}")
+    return {
+        "poses": int(meas.num_poses), "d": int(meas.d), "rank": r,
+        "handoff_rounds": int(res.iterations),
+        "gn_handoff": float(gn_handoff),
+        "gn_bcd_extra": float(gn_bcd), "bcd_extra_rounds": int(extra),
+        "bcd_extra_seconds": round(t_arm_a, 2),
+        "gn_tail": float(tail.grad_norm_history[-1]),
+        "gn_tail_outer": tail.outer_iterations,
+        "gn_tail_cg": tail.cg_iterations,
+        "gn_tail_seconds": round(t_tail, 2),
+        "gn_tail_terminated_by": tail.terminated_by,
+        "below_gate": bool(tail.grad_norm_history[-1] < 0.1),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rounds", type=int, default=400,
+                    help="BCD round cap before handoff")
+    ap.add_argument("--extra", type=int, default=200,
+                    help="extra BCD rounds for the floor arm")
+    ap.add_argument("--robots", type=int, default=8)
+    ap.add_argument("--rank", type=int, default=5)
+    ap.add_argument("--datasets", default="ais2klinik,noisy2k")
+    args = ap.parse_args()
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "gn_tail_gate_results.json")
+    results = {}
+    if os.path.exists(out):  # merge: per-dataset arms accumulate
+        with open(out) as f:
+            results = json.load(f)
+    for name in args.datasets.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        results[name] = run_arm(name, args.rounds, args.extra,
+                                args.robots, args.rank)
+    print(json.dumps(results, indent=1))
+    with open(out, "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -2,20 +2,18 @@
 item 6: "restructure the per-edge VPU math").
 
 Round 4 established the kernel is VPU/loop-bound after the selection
-split + paired tiles.  This driver locates the time and A/Bs the round-5
-structural levers, each env-gated in ``ops.pallas_tcg`` so every variant
-runs in a fresh subprocess against the SAME problem:
+split + paired tiles; round 5 relocated the bottleneck to MXU dot issue
+and promoted packed selection + mode-gated wide tiles; round 6 DECIDED
+the surviving gates (see VARIANTS below).  Each variant runs in a fresh
+subprocess against the SAME problem:
 
-* ``unroll``  — PALLAS_UNROLL_TILES=1: static-unroll the edge-tile loop
-  (nt is compile-time) so Mosaic can software-pipeline MXU dots against
-  VPU edge math across tiles.
 * ``ns8``     — PALLAS_NS_SWEEPS=8: the retraction's Newton-Schulz polar
   runs 24 fixed sweeps (~1.9k [n]-wide FMAs, sized for near-singular
   M = X + eta); a trust-region step is never near-singular, so 8 sweeps
-  reach f32-grade orthonormality (drift checked below).
-* ``t256``    — PALLAS_TILE=256: the adaptive tile halves to T=128 when
-  the pose buffer exceeds 1024; at 100k/64 VMEM still fits T=256, which
-  halves the per-tile loop/dispatch overhead and doubles dot width.
+  reach f32-grade orthonormality (drift checked below).  Decision
+  standing: default stays 24 (drift not worth ~5-7%).
+* ``t128``/``t512`` — PALLAS_TILE (DPGO_AB-scoped): tile-width sweep
+  around the promoted mode-gated T=256 default.
 * ``inner2``  — max_inner_iters=2 (vs the production 10): NOT a
   candidate (changes semantics) — isolates per-tCG-iteration cost.
 
@@ -37,20 +35,23 @@ import numpy as np
 
 sys.path.insert(0, "/root/repo")
 
-# "unroll" (PALLAS_UNROLL_TILES) is a MEASURED DEAD END at the 100k
-# shape: Mosaic keeps every unrolled tile's transient one-hots live
-# concurrently instead of reusing the loop-carried buffer, so scoped
-# VMEM overflows (16.55M > 16M at T=128 bf16x3; 36.1M with t256+f32).
+# Round-6 A/B DECISIONS (recorded in BASELINE.md):
+#   * PALLAS_SEL_PACKED — promoted: packed selection is unconditional in
+#     ops.pallas_tcg; the unpacked code path and its gate are DELETED
+#     (winner at every measured shape: bf16x3 100k/64 36.7 -> 57.6).
+#   * PALLAS_UNROLL_TILES — deleted: measured dead end (Mosaic keeps all
+#     unrolled tiles' one-hot transients live; scoped VMEM 16.55M > 16M
+#     at T=128 bf16x3, 36.1M with t256+f32).
+#   * PALLAS_NS_SWEEPS — kept (the one remaining live gate): default
+#     stays 24 sweeps; ns8's ~5-7% is not worth 7e-4..2.6e-3 drift.
+#   * PALLAS_TILE — kept (DPGO_AB-scoped): T=512 read within hour noise
+#     of the promoted T=256 default, which keeps 2x the VMEM headroom.
 #
-# NOTE: after the round-5 promotion, "base" = the production defaults
-# (PACKED selection on, wide T=256 tiles for bf16 modes).  The ablation
-# variants therefore TURN THINGS OFF to reproduce the A/B:
+# "base" = the production defaults; the remaining variants measure the
+# two surviving knobs.
 VARIANTS = {
     "base": {},
-    "unpacked": {"PALLAS_SEL_PACKED": "0"},
     "t128": {"PALLAS_TILE": "128"},
-    "unpacked+t128": {"PALLAS_SEL_PACKED": "0",   # the round-4 config
-                      "PALLAS_TILE": "128"},
     "t512": {"PALLAS_TILE": "512"},
     "ns8": {"PALLAS_NS_SWEEPS": "8"},
 }

@@ -1,7 +1,9 @@
-"""The A/B experiment gates (ADVICE r5): ``PALLAS_TILE`` is scoped out of
-the production path behind ``DPGO_AB=1`` with validation, and the
-``pallas_tcg`` selection/sweep/unroll gates are read at kernel-build time
-so they are toggleable per-process."""
+"""The A/B experiment gates (ADVICE r5, pruned round 6): ``PALLAS_TILE``
+is scoped out of the production path behind ``DPGO_AB=1`` with
+validation, and the one surviving ``pallas_tcg`` gate (``ns_sweeps``) is
+read at kernel-build time so it is toggleable per-process.  The decided
+gates are gone: packed selection is unconditional, the tile-unroll dead
+end is deleted."""
 
 import pytest
 
@@ -32,17 +34,24 @@ def test_pallas_tile_applies_and_validates_with_ab(monkeypatch):
 def test_pallas_tcg_gates_read_per_call(monkeypatch):
     from dpgo_tpu.ops.pallas_tcg import _ab_gates
 
-    monkeypatch.delenv("PALLAS_SEL_PACKED", raising=False)
     monkeypatch.delenv("PALLAS_NS_SWEEPS", raising=False)
-    monkeypatch.delenv("PALLAS_UNROLL_TILES", raising=False)
     g = _ab_gates()
-    assert g.sel_packed is True and g.ns_sweeps == 24 \
-        and g.unroll_tiles is False
+    assert g.ns_sweeps == 24
     # Toggling mid-process takes effect on the NEXT kernel build — no
     # interpreter restart (the old import-time read froze these forever).
-    monkeypatch.setenv("PALLAS_SEL_PACKED", "0")
     monkeypatch.setenv("PALLAS_NS_SWEEPS", "8")
+    g = _ab_gates()
+    assert g.ns_sweeps == 8
+
+
+def test_decided_gates_are_retired(monkeypatch):
+    """Round-6 decisions are enforced, not advisory: a leaked
+    PALLAS_SEL_PACKED=0 / PALLAS_UNROLL_TILES=1 in the environment can no
+    longer change the kernel build (packed selection is unconditional,
+    the unroll path is deleted)."""
+    from dpgo_tpu.ops.pallas_tcg import _ab_gates
+
+    monkeypatch.setenv("PALLAS_SEL_PACKED", "0")
     monkeypatch.setenv("PALLAS_UNROLL_TILES", "1")
     g = _ab_gates()
-    assert g.sel_packed is False and g.ns_sweeps == 8 \
-        and g.unroll_tiles is True
+    assert not hasattr(g, "sel_packed") and not hasattr(g, "unroll_tiles")

@@ -533,3 +533,39 @@ def test_agent_iterate_pallas_kernel_matches_ell():
     for k, e in zip(ag_k, ag_e):
         assert np.allclose(k.X, e.X, atol=5e-5), \
             np.abs(k.X - e.X).max()
+
+
+def test_status_fetch_every_latches_rel_change():
+    """Deployment verdict cadence (AgentParams.status_fetch_every): with
+    K > 1 and telemetry off, iterate() leaves the status scalar
+    device-latched between fetch boundaries — the gossiped
+    relative_change only refreshes every K iterates — and the solve
+    still converges to the same place as the per-iterate fetch."""
+    import math
+
+    agents, part, _ = make_agents(2, status_fetch_every=3)
+    ref_agents, _, _ = make_agents(2)
+
+    def drive(ags, rounds):
+        for i in range(rounds):
+            exchange(ags)
+            for ag in ags:
+                ag.iterate()
+            yield i + 1
+
+    ref = drive(ref_agents, 6)
+    for it in drive(agents, 6):
+        next(ref)
+        if it < 3:
+            # Robot 1 steps from round 1 (robot 0's init frame arrived in
+            # the first exchange) but, before the first K boundary, its
+            # gossiped scalar still reads the initial inf — the value
+            # never left the device.
+            assert math.isinf(agents[1].get_status().relative_change)
+        if it % 3 == 0:
+            assert all(math.isfinite(ag.get_status().relative_change)
+                       for ag in agents)
+    # Identical math either way — only the fetch cadence differs.
+    for a, b in zip(agents, ref_agents):
+        np.testing.assert_allclose(np.asarray(a.X), np.asarray(b.X),
+                                   rtol=0, atol=0)

@@ -538,3 +538,67 @@ def test_leakcheck_fails_leaking_test_and_passes_clean(tmp_path):
     assert "sockets still open" in proc.stdout
     # exactly one error (the leak); clean + opted-out tests pass
     assert "3 passed, 1 error" in proc.stdout, proc.stdout
+
+
+def test_seeded_verdict_loop_sync_violations(tmp_path):
+    """ISSUE-9 seams: the device-resident driver's ONE sanctioned
+    verdict-word fetch rides a reviewed suppression — but (a) a NEW
+    ``_host_fetch`` call seeded into the verdict hot loop and (b) the
+    same seeded into ``run_bucket``'s dispatch loop must be flagged by
+    DPG003 via the configured ``sync_calls`` seam list, with file:line."""
+    # (a) models/rbcd.py: unsanctioned extra fetch in _run_verdict_loop.
+    mdir = tmp_path / "dpgo_tpu" / "models"
+    mdir.mkdir(parents=True)
+    src = (REPO / "dpgo_tpu" / "models" / "rbcd.py").read_text()
+    bad = src.replace(
+        "            n_pre = len(eval_its)\n\n    cost_hist",
+        "            n_pre = len(eval_its)\n"
+        "            _dbg = _host_fetch(state.X)\n\n    cost_hist")
+    assert bad != src
+    (mdir / "rbcd.py").write_text(bad)
+    findings = run_lint([str(tmp_path / "dpgo_tpu")], project_config())
+    hits = [f for f in findings if f.rule == "DPG003"
+            and "sync seam" in f.message]
+    assert hits, findings
+    assert all(f.path.endswith("models/rbcd.py") and f.line > 0
+               for f in hits)
+
+    # (b) serve/runner.py: unsanctioned fetch inside the bucket loop.
+    sdir = tmp_path / "b" / "dpgo_tpu" / "serve"
+    sdir.mkdir(parents=True)
+    rsrc = (REPO / "dpgo_tpu" / "serve" / "runner.py").read_text()
+    rbad = rsrc.replace(
+        "            all_terminal = ",
+        "            _dbg = rbcd._host_fetch(hist)\n"
+        "            all_terminal = ")
+    assert rbad != rsrc
+    (sdir / "runner.py").write_text(rbad)
+    findings = run_lint([str(tmp_path / "b" / "dpgo_tpu")],
+                        project_config())
+    hits = [f for f in findings if f.rule == "DPG003"
+            and "sync seam" in f.message]
+    assert hits, findings
+    assert all(f.path.endswith("serve/runner.py") for f in hits)
+
+
+def test_sanctioned_verdict_fetches_stay_suppressed(monkeypatch):
+    """The three reviewed verdict-loop fetch sites (word, lazy history,
+    terminal bookkeeping) must remain suppressed on the real tree — the
+    clean-tree check above covers it, but pin the intent: stripping any
+    one suppression makes DPG003 fire at that site."""
+    src = (REPO / "dpgo_tpu" / "models" / "rbcd.py").read_text()
+    stripped = src.replace(
+        "            # dpgolint: disable=DPG003 -- sanctioned "
+        "verdict-word fetch\n", "")
+    assert stripped != src
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        mdir = os.path.join(td, "dpgo_tpu", "models")
+        os.makedirs(mdir)
+        with open(os.path.join(mdir, "rbcd.py"), "w") as fh:
+            fh.write(stripped)
+        findings = run_lint([os.path.join(td, "dpgo_tpu")],
+                            project_config())
+    assert any(f.rule == "DPG003" and "_host_fetch" in f.message
+               for f in findings), findings

@@ -1,0 +1,135 @@
+"""Gauss-Newton-CG tail unit suite (``models.refine.gn_tail``): CG
+convergence on a small f64 assembly, preconditioner sanity, and the
+stall-handoff trigger."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from dpgo_tpu.config import AgentParams  # noqa: E402
+from dpgo_tpu.models import rbcd, refine  # noqa: E402
+from dpgo_tpu.models.certify import sparse_certificate  # noqa: E402
+from dpgo_tpu.ops import manifold, quadratic  # noqa: E402
+from dpgo_tpu.types import edge_set_from_measurements  # noqa: E402
+from dpgo_tpu.utils.synthetic import make_measurements  # noqa: E402
+
+
+def _problem(n=60, seed=0, noise=0.05):
+    meas, _ = make_measurements(np.random.default_rng(seed), n=n, d=3,
+                                num_lc=n // 2, rot_noise=noise,
+                                trans_noise=noise)
+    return meas
+
+
+def _stalled_iterate(meas, rounds=12):
+    params = AgentParams(d=3, r=5, num_robots=2, rel_change_tol=0.0)
+    prob = rbcd.prepare_problem(meas, 2, params=params, dtype=jnp.float64)
+    res = rbcd.dispatch_prepared(prob, max_iters=rounds, eval_every=rounds,
+                                 grad_norm_tol=1e-12)
+    Xg = np.asarray(rbcd.gather_to_global(jnp.asarray(res.X), prob.graph,
+                                          prob.n_total), np.float64)
+    edges = edge_set_from_measurements(prob.part.meas_global,
+                                       dtype=jnp.float64)
+    return Xg, edges
+
+
+def test_gradient_matches_driver_oracle():
+    """X @ S IS the centralized Riemannian gradient: the tail's gate
+    quantity agrees with run_rbcd's ``manifold.norm(rgrad)`` oracle."""
+    meas = _problem()
+    Xg, edges = _stalled_iterate(meas)
+    g_ref = manifold.rgrad(jnp.asarray(Xg),
+                           quadratic.egrad(jnp.asarray(Xg), edges))
+    gn_ref = float(manifold.norm(g_ref))
+    S = sparse_certificate(Xg, edges)
+    n, r, dh = Xg.shape
+    Xf = Xg.transpose(1, 0, 2).reshape(r, n * dh)
+    grad = refine._gn_tangent(
+        Xg, (Xf @ S).reshape(r, n, dh).transpose(1, 0, 2), 3)
+    gn = float(np.sqrt(np.sum(grad * grad)))
+    assert abs(gn - gn_ref) <= 1e-9 * max(gn_ref, 1.0)
+
+
+def test_gn_tail_converges_below_gate():
+    """ACCEPTANCE (unit scale): from a BCD iterate far above the gate,
+    the tail drives the centralized gradient norm to 1e-6 in a handful
+    of outer steps, with monotone f64 cost."""
+    meas = _problem()
+    Xg, edges = _stalled_iterate(meas)
+    t = refine.gn_tail(Xg, edges,
+                       refine.GNTailConfig(max_outer=12,
+                                           grad_norm_tol=1e-6))
+    assert t.converged and t.terminated_by == "grad_norm"
+    assert t.grad_norm_history[0] > 1e-2  # genuinely started above
+    assert t.grad_norm_history[-1] < 1e-6
+    costs = t.cost_history
+    assert all(b <= a + 1e-12 for a, b in zip(costs, costs[1:]))
+    assert t.outer_iterations <= 12
+
+
+def test_diag_blocks_match_dense():
+    """Preconditioner sanity: the vectorized block extraction equals the
+    dense diagonal blocks of S, and the shifted blocks are SPD."""
+    meas = _problem(n=30)
+    Xg, edges = _stalled_iterate(meas, rounds=4)
+    S = sparse_certificate(Xg, edges)
+    n, _, dh = Xg.shape
+    blocks = refine._gn_diag_blocks(S, n, dh, shift=0.1)
+    Sd = S.toarray()
+    for i in (0, 7, n - 1):
+        ref = Sd[i * dh:(i + 1) * dh, i * dh:(i + 1) * dh] \
+            + 0.1 * np.eye(dh)
+        assert np.allclose(blocks[i], ref, atol=1e-12)
+    # SPD after the shift: Cholesky must succeed on every block.
+    np.linalg.cholesky(blocks)
+
+
+def test_preconditioner_accelerates_cg():
+    """The block-Jacobi preconditioner pays: the preconditioned tail
+    reaches the gate in no more total CG iterations than a run with the
+    preconditioner degraded to (shifted) identity."""
+    meas = _problem(n=80, noise=0.08)
+    Xg, edges = _stalled_iterate(meas)
+    cfg = refine.GNTailConfig(max_outer=8, grad_norm_tol=1e-5)
+    t_pre = refine.gn_tail(Xg, edges, cfg)
+
+    orig = refine._gn_diag_blocks
+    try:
+        refine._gn_diag_blocks = \
+            lambda S, n, dh, shift: np.tile(np.eye(dh), (n, 1, 1))
+        t_id = refine.gn_tail(Xg, edges, cfg)
+    finally:
+        refine._gn_diag_blocks = orig
+    assert t_pre.converged
+    assert t_pre.cg_iterations <= t_id.cg_iterations
+
+
+def test_stall_handoff_trigger():
+    """Trigger fires on a plateaued-above-gate history; stays quiet while
+    the trajectory still improves or is already through the gate."""
+    assert refine.stall_handoff([1.2] * 10, window=8, grad_norm_tol=0.1)
+    improving = [10, 5, 2, 1, 0.5, 0.28, 0.25, 0.22, 0.19, 0.15]
+    assert not refine.stall_handoff(improving, window=8)
+    assert not refine.stall_handoff([0.05] * 10, window=8,
+                                    grad_norm_tol=0.1)
+    assert not refine.stall_handoff([1.2] * 5, window=8)  # window unfilled
+    assert not refine.stall_handoff([np.nan] * 10, window=8)
+
+
+def test_no_decrease_terminates_cleanly():
+    """At a (near-)stationary point the backtracking line search cannot
+    decrease the cost — the tail reports no_decrease/grad_norm instead of
+    looping or raising."""
+    meas = _problem(n=30)
+    Xg, edges = _stalled_iterate(meas, rounds=4)
+    t0 = refine.gn_tail(Xg, edges,
+                        refine.GNTailConfig(max_outer=20,
+                                            grad_norm_tol=1e-9))
+    # Restart from the converged point with an unreachable tolerance.
+    t1 = refine.gn_tail(t0.X, edges,
+                        refine.GNTailConfig(max_outer=5,
+                                            grad_norm_tol=0.0,
+                                            max_backtracks=3))
+    assert t1.terminated_by in ("no_decrease", "max_outer")
+    assert np.isfinite(t1.cost_history[-1])

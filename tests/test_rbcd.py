@@ -470,3 +470,108 @@ def test_colored_schedule_with_acceleration(rng):
         dtype=jnp.float64)
     assert res.terminated_by == "grad_norm"
     assert res.grad_norm_history[-1] < 0.05
+
+
+# ---------------------------------------------------------------------------
+# Device-resident verdict loop (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+def _verdict_problem(rng, n=50, noise=0.05):
+    meas, _ = make_measurements(rng, n=n, d=3, num_lc=n // 2,
+                                rot_noise=noise, trans_noise=noise)
+    return meas
+
+
+def test_verdict_loop_matches_legacy_histories(rng):
+    """Full-run parity: verdict mode reproduces the per-eval loop's
+    cost/gradnorm histories bitwise, termination label, round count, and
+    (at max_iters, where there is no overshoot) the iterate itself."""
+    meas = _verdict_problem(rng)
+    params = AgentParams(d=3, r=5, num_robots=2, rel_change_tol=0.0)
+    a = rbcd.solve_rbcd(meas, 2, params=params, max_iters=24, eval_every=2,
+                        grad_norm_tol=1e-9, dtype=jnp.float64)
+    b = rbcd.solve_rbcd(meas, 2, params=params, max_iters=24, eval_every=2,
+                        grad_norm_tol=1e-9, dtype=jnp.float64,
+                        verdict_every=8)
+    assert a.cost_history == b.cost_history
+    assert a.grad_norm_history == b.grad_norm_history
+    assert (a.iterations, a.terminated_by) == (b.iterations, b.terminated_by)
+    assert np.array_equal(np.asarray(a.X), np.asarray(b.X))
+
+
+def test_verdict_loop_termination_latches_mid_window(rng):
+    """A gradnorm termination latched between verdict fetches reports the
+    same terminal eval/round as the per-eval loop — histories truncated
+    at the latched eval, not at the fetch boundary."""
+    meas = _verdict_problem(rng)
+    params = AgentParams(d=3, r=5, num_robots=2, rel_change_tol=0.0)
+    a = rbcd.solve_rbcd(meas, 2, params=params, max_iters=200, eval_every=1,
+                        grad_norm_tol=2e-2, dtype=jnp.float64)
+    b = rbcd.solve_rbcd(meas, 2, params=params, max_iters=200, eval_every=1,
+                        grad_norm_tol=2e-2, dtype=jnp.float64,
+                        verdict_every=8)
+    assert a.terminated_by == "grad_norm"
+    assert (a.iterations, a.terminated_by) == (b.iterations, b.terminated_by)
+    assert a.cost_history == b.cost_history
+    assert a.grad_norm_history == b.grad_norm_history
+
+
+def test_verdict_loop_fetch_cadence(rng, monkeypatch):
+    """Telemetry off, the loop performs exactly rounds/K verdict-word
+    fetches plus the 2-call terminal epilogue — counted through the
+    ``_host_fetch`` seam (the bench's host_syncs shim technique)."""
+    meas = _verdict_problem(rng)
+    params = AgentParams(d=3, r=5, num_robots=2, rel_change_tol=0.0)
+    count = [0]
+    orig = rbcd._host_fetch
+    monkeypatch.setattr(rbcd, "_host_fetch",
+                        lambda x: (count.__setitem__(0, count[0] + 1),
+                                   orig(x))[1])
+    res = rbcd.solve_rbcd(meas, 2, params=params, max_iters=32,
+                          eval_every=4, grad_norm_tol=0.0,
+                          dtype=jnp.float64, verdict_every=16)
+    assert res.iterations == 32
+    assert count[0] == 32 // 16 + 2  # words + terminal history/indices
+
+
+def test_verdict_every_must_divide_eval_every(rng):
+    meas = _verdict_problem(rng, n=20)
+    params = AgentParams(d=3, r=5, num_robots=2)
+    with pytest.raises(ValueError, match="verdict_every"):
+        rbcd.solve_rbcd(meas, 2, params=params, max_iters=8, eval_every=3,
+                        grad_norm_tol=1e-9, dtype=jnp.float64,
+                        verdict_every=4)
+
+
+def test_verdict_word_pack_unpack_roundtrip():
+    for status in (rbcd.VERDICT_RUNNING, rbcd.VERDICT_GRAD_NORM,
+                   rbcd.VERDICT_CONSENSUS):
+        for anom in (rbcd.ANOMALY_NONE, rbcd.ANOMALY_STALL,
+                     rbcd.ANOMALY_NON_FINITE):
+            for stage in (0, 3, 97):
+                w = rbcd.pack_verdict(status, anom, stage)
+                dec = rbcd.unpack_verdict(w)
+                assert dec["stage"] == stage
+                assert dec["status"] == rbcd._VERDICT_STATUS[status]
+                assert dec["anomaly"] == rbcd._VERDICT_ANOMALY[anom]
+
+
+def test_verdict_loop_gnc_weight_updates_match(rng):
+    """Robust (GNC) schedule parity: flagged weight-update rounds land on
+    the same rounds in verdict mode (host-deterministic schedule_bounds),
+    so the mu trajectory and histories agree with the per-eval loop."""
+    from dpgo_tpu.config import RobustCostParams, RobustCostType
+
+    meas = _verdict_problem(rng)
+    params = AgentParams(
+        d=3, r=5, num_robots=2, rel_change_tol=0.0,
+        robust=RobustCostParams(cost_type=RobustCostType.GNC_TLS),
+        robust_opt_inner_iters=4)
+    a = rbcd.solve_rbcd(meas, 2, params=params, max_iters=20, eval_every=2,
+                        grad_norm_tol=1e-9, dtype=jnp.float64)
+    b = rbcd.solve_rbcd(meas, 2, params=params, max_iters=20, eval_every=2,
+                        grad_norm_tol=1e-9, dtype=jnp.float64,
+                        verdict_every=4)
+    assert a.cost_history == b.cost_history
+    assert a.grad_norm_history == b.grad_norm_history
+    assert np.array_equal(np.asarray(a.weights), np.asarray(b.weights))

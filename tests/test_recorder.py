@@ -45,7 +45,8 @@ def _params(**kw):
 
 
 def _run_recorded_solve(run, params, meas, max_iters=10, eval_every=2,
-                        fault=None, crash_at=None, snapshot_every=1):
+                        fault=None, crash_at=None, snapshot_every=1,
+                        verdict_every=None):
     """Drive ``run_rbcd`` the way ``solve_rbcd`` does, with a segment
     wrapper that injects the canonical NaN fault (``inject_nan``) the
     first time the cumulative round count crosses ``fault['iteration']``
@@ -83,7 +84,8 @@ def _run_recorded_solve(run, params, meas, max_iters=10, eval_every=2,
 
     res = rbcd.run_rbcd(state, graph, meta, step, part, max_iters,
                         grad_norm_tol=1e-12, eval_every=eval_every,
-                        dtype=jnp.float64, params=params, segment=seg)
+                        dtype=jnp.float64, params=params, segment=seg,
+                        verdict_every=verdict_every)
     return res, rec
 
 
@@ -252,3 +254,126 @@ def test_report_renders_health_and_blackbox(tmp_path, capsys):
     assert "numerical health:" in out
     assert "non_finite" in out
     assert "blackbox:" in out and "anomaly:non_finite" in out
+
+
+# ---------------------------------------------------------------------------
+# Verdict-word loop compatibility (ISSUE 9): the fused program and the
+# replay path stay on the byte-identical metrics computation
+# ---------------------------------------------------------------------------
+
+def test_verdict_history_rows_bitwise_match_central_metrics():
+    """The verdict program's device-side history rows must equal the
+    standalone ``_make_central_metrics`` program's output BITWISE on the
+    same states — the ``_central_metrics_body`` extraction contract that
+    lets ``--replay`` (which evaluates through ``_make_central_metrics``)
+    verify a verdict-mode recording bit-for-bit."""
+    from dpgo_tpu.models import rbcd
+    from dpgo_tpu.types import edge_set_from_measurements
+    from dpgo_tpu.utils.partition import partition_contiguous
+
+    meas = _tiny_problem()
+    params = _params()
+    part = partition_contiguous(meas, params.num_robots)
+    graph, meta = rbcd.build_graph(part, params.r, jnp.float64,
+                                   sel_mode=rbcd.resolved_sel_mode(params))
+    X0 = rbcd.centralized_chordal_init(part, meta, graph, jnp.float64)
+    state = rbcd.init_state(graph, meta, X0, params=params)
+    n_total = part.meas_global.num_poses
+    num_meas = len(part.meas_global)
+    edges_g = edge_set_from_measurements(part.meas_global,
+                                         dtype=jnp.float64)
+    central = rbcd._make_central_metrics(graph, edges_g, n_total,
+                                         num_meas, telemetry=True)
+    vstep = rbcd.make_verdict_program(
+        graph, edges_g, n_total, num_meas, telemetry=True,
+        grad_norm_tol=1e-12, robust_params=params.robust, max_evals=4)
+    vs = rbcd.init_verdict_state(4, meta.num_robots, jnp.float64,
+                                 telemetry=True)
+    for k in range(4):
+        state = rbcd.rbcd_segment(state, graph, 2, meta, params)
+        vs = vstep(state.X, state.weights, state.ready, state.mu,
+                   state.rel_change, state.iteration, vs)
+        ref = np.asarray(central(state.X, state.weights, state.ready,
+                                 state.mu, state.rel_change))
+        row = np.asarray(vs.hist)[k]
+        assert row.tobytes() == ref.tobytes(), (k, row, ref)
+
+
+def test_verdict_mode_replay_crosses_boundary_bit_for_bit(tmp_path):
+    """ACCEPTANCE (ISSUE 9 satellite): a verdict-mode recorded run with a
+    seeded NaN fault dumps a black box whose ``--replay`` resumes from a
+    K-boundary snapshot, crosses subsequent verdict boundaries, and
+    reproduces the recorded trajectory bit-for-bit (rc 0)."""
+    meas = _tiny_problem()
+    params = _params()
+    fault = {"iteration": 9, "agent": 1, "pose": 3}
+    d = str(tmp_path / "run")
+    with obs.run_scope(d) as run:
+        res, rec = _run_recorded_solve(run, params, meas, max_iters=16,
+                                       eval_every=2, fault=fault,
+                                       verdict_every=4)
+        # The on-device non-finite predicate latched into the verdict
+        # word (in-band signal) AND the host monitor re-judged the same
+        # rows into the standard anomaly event (stream parity).
+        npz = os.path.join(d, "blackbox.npz")
+        assert os.path.exists(npz)
+        # Snapshots were taken at verdict boundaries by snapshot_state.
+        ctx, _arrays = load_blackbox(npz)
+        snaps = ctx["snapshots"]
+        assert snaps and all(s["iteration"] % 4 == 0 for s in snaps)
+        assert any(s["healthy"] for s in snaps)
+    evs = read_events(os.path.join(d, "events.jsonl"))
+    kinds = {e.get("kind") for e in evs if e.get("event") == "anomaly"}
+    assert "non_finite" in kinds
+    ends = [e for e in evs if e.get("event") == "solve_end"]
+    assert ends and ends[0].get("verdict", {}).get("anomaly") == "non_finite"
+    # Exact replay across the verdict boundary: the ring rows came from
+    # the fused verdict program's history; the replay recomputes them
+    # through _make_central_metrics — bitwise agreement required.
+    rep = replay(npz)
+    assert rep.match, rep.mismatches
+    assert recorder_main(["--replay", npz]) == 0
+
+
+def test_verdict_mode_emits_identical_event_stream(tmp_path):
+    """ACCEPTANCE (ISSUE 9): with telemetry on, the verdict-word loop
+    must emit the SAME health/anomaly event stream and the same
+    solver-metric trajectory as the pre-fusion per-eval path on a seeded
+    NaN-injection run — the K-round fetch coarsens the transfer cadence,
+    never the observable events."""
+    meas = _tiny_problem()
+    params = _params()
+    fault = {"iteration": 9, "agent": 1, "pose": 3}
+    streams = {}
+    for mode, k in (("per_eval", None), ("verdict", 8)):
+        d = str(tmp_path / mode)
+        with obs.run_scope(d) as run:
+            _run_recorded_solve(run, params, meas, max_iters=16,
+                                eval_every=2, fault=fault,
+                                verdict_every=k)
+        streams[mode] = read_events(os.path.join(d, "events.jsonl"))
+
+    def anomalies(evs):
+        return [(e["kind"], e["severity"], e["iteration"])
+                for e in evs if e.get("event") == "anomaly"]
+
+    def metrics(evs, name):
+        # repr round-trips NaN equality (math.nan != math.nan).
+        return [(e["iteration"], repr(e["value"])) for e in evs
+                if e.get("event") == "metric" and e.get("metric") == name
+                and e.get("phase") == "eval"]
+
+    assert anomalies(streams["verdict"]) == anomalies(streams["per_eval"])
+    assert anomalies(streams["verdict"]), "fault must surface as anomaly"
+    for name in ("solver_cost", "solver_grad_norm", "gnc_mu",
+                 "gnc_inlier_fraction"):
+        assert metrics(streams["verdict"], name) == \
+            metrics(streams["per_eval"], name), name
+    assert metrics(streams["verdict"], "solver_cost"), "evals must emit"
+    # Identical terminal accounting (iterations, terminated_by).
+    (end_v,) = [e for e in streams["verdict"]
+                if e.get("event") == "solve_end"]
+    (end_p,) = [e for e in streams["per_eval"]
+                if e.get("event") == "solve_end"]
+    assert (end_v["iterations"], end_v["terminated_by"]) == \
+        (end_p["iterations"], end_p["terminated_by"])

@@ -1,0 +1,87 @@
+"""Bench non-regression gate (ISSUE 9 CI satellite).
+
+Reads one bench.py metric-record JSON (a file argument, or stdin) and
+enforces, in order:
+
+1. Record schema — the fields every consumer (BENCH_r0*.json trajectory,
+   obs report, regress gate) relies on must be present and sane on EVERY
+   platform, so a CPU-only CI runner still catches a bench.py refactor
+   that breaks the record.
+2. The accelerator floor — applied only to accelerator records
+   (``loop == "verdict_word"``; the CPU fallback measures a different
+   arm and machine class):
+     * rounds/s >= BENCH_FLOOR_ROUNDS_PER_S (default 1146, the round-5
+       BENCH_r05 reading — the no-worse-than-last-round band),
+     * kernel parity <= 7.7e-6 (the standing Mosaic-vs-XLA guard),
+     * verdict cadence K >= 4 and measured host_syncs_per_100_rounds
+       <= 100/K (one word fetch per K rounds, the readback-kill
+       acceptance).
+
+Exit 0 on pass, 1 on any violation, 2 on an unreadable record.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+FLOOR = float(os.environ.get("BENCH_FLOOR_ROUNDS_PER_S", "1146"))
+PARITY_BOUND = float(os.environ.get("BENCH_PARITY_BOUND", "7.7e-6"))
+MIN_VERDICT_K = int(os.environ.get("BENCH_MIN_VERDICT_K", "4"))
+
+
+def fail(msg: str) -> None:
+    print(f"bench floor gate: FAIL — {msg}")
+    sys.exit(1)
+
+
+def main() -> None:
+    try:
+        if len(sys.argv) > 1:
+            with open(sys.argv[1]) as f:
+                text = f.read()
+        else:
+            text = sys.stdin.read()
+        # bench.py prints exactly one JSON line last; tolerate log lines.
+        rec = json.loads(text.strip().splitlines()[-1])
+    except (OSError, ValueError, IndexError) as e:
+        print(f"bench floor gate: unreadable record ({e})")
+        sys.exit(2)
+
+    # 1. Schema (all platforms).
+    for key in ("metric", "value", "unit", "vs_baseline", "cpu_arm_band",
+                "loop", "fused_rounds_per_s"):
+        if key not in rec:
+            fail(f"record missing {key!r}: {sorted(rec)}")
+    if rec["metric"] != "rbcd_rounds_per_sec_sphere2500_8agents_r5":
+        fail(f"unexpected metric {rec['metric']!r}")
+    if not (isinstance(rec["value"], (int, float)) and rec["value"] > 0):
+        fail(f"non-positive value {rec['value']!r}")
+    band = rec["cpu_arm_band"]
+    if not (band["min"] <= band["median"] <= band["max"]):
+        fail(f"malformed cpu_arm_band {band}")
+
+    # 2. Accelerator floor.
+    if rec["loop"] != "verdict_word":
+        print(f"bench floor gate: schema ok; floor skipped "
+              f"(loop={rec['loop']!r} — CPU fallback arm, "
+              f"{rec['value']} {rec['unit']})")
+        return
+    if rec["value"] < FLOOR:
+        fail(f"{rec['value']} rounds/s < floor {FLOOR}")
+    parity = rec.get("kernel_parity_max_abs_diff")
+    if parity is None or parity > PARITY_BOUND:
+        fail(f"kernel parity {parity} exceeds bound {PARITY_BOUND}")
+    k = rec.get("verdict_every")
+    syncs = rec.get("host_syncs_per_100_rounds")
+    if not (isinstance(k, int) and k >= MIN_VERDICT_K):
+        fail(f"verdict_every={k!r} < required {MIN_VERDICT_K}")
+    if syncs is None or syncs > 100.0 / k + 1e-9:
+        fail(f"host_syncs_per_100_rounds={syncs!r} > 100/K={100.0 / k:.4g}")
+    print(f"bench floor gate: PASS — {rec['value']} rounds/s >= {FLOOR}, "
+          f"parity {parity:.2e} <= {PARITY_BOUND:.1e}, "
+          f"{syncs} syncs/100 rounds at K={k}")
+
+
+if __name__ == "__main__":
+    main()

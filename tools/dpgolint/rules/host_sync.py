@@ -11,11 +11,16 @@ functions:
 * ``.block_until_ready()`` and ``.item()``,
 * ``float(...)`` / ``int(...)`` / ``bool(...)`` applied directly to a
   call result or a subscript/attribute of one (values already fetched to
-  host — plain names — don't transfer again and are not flagged).
+  host — plain names — don't transfer again and are not flagged),
+* any call whose (dotted-tail) name appears in the configured
+  ``sync_calls`` list — the project's OWN fetch seams (``rbcd._host_fetch``,
+  the one function every sanctioned driver readback routes through since
+  the verdict-word loop), so wrapping a transfer in the seam helper does
+  not hide it from the rule.
 
-The sanctioned readback seams (the one-fetch-per-eval sites) carry
-reviewed ``# dpgolint: disable=DPG003`` suppressions; anything else is a
-hot-loop regression.
+The sanctioned readback seams (the per-eval stacked fetch, the verdict-
+word/lazy-history fetches) carry reviewed ``# dpgolint: disable=DPG003``
+suppressions; anything else is a hot-loop regression.
 """
 
 from __future__ import annotations
@@ -58,21 +63,24 @@ class HostSyncRule(Rule):
 
     def check(self, module: Module, config) -> list:
         fopts = config.file_options(self.id, module.relpath)
+        ropts = config.rule_options(self.id)
         hot = set(fopts.get("hot_functions",
-                            config.rule_options(self.id).get(
-                                "hot_functions", [])))
+                            ropts.get("hot_functions", [])))
         if not hot:
             return []
+        sync_calls = set(fopts.get("sync_calls",
+                                   ropts.get("sync_calls", [])))
         np_names = _numpy_aliases(module.tree)
         findings = []
         for node in ast.walk(module.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                     and node.name in hot:
-                findings.extend(self._check_fn(module, node, np_names))
+                findings.extend(self._check_fn(module, node, np_names,
+                                               sync_calls))
         return findings
 
-    def _check_fn(self, module: Module, fn: ast.AST,
-                  np_names: set[str]) -> list:
+    def _check_fn(self, module: Module, fn: ast.AST, np_names: set[str],
+                  sync_calls: set[str]) -> list:
         out = []
         seen: set[int] = set()
         for loop in walk_skipping_functions(fn):
@@ -82,7 +90,7 @@ class HostSyncRule(Rule):
                 if not isinstance(node, ast.Call) or id(node) in seen:
                     continue
                 seen.add(id(node))
-                hit = self._classify(node, np_names)
+                hit = self._classify(node, np_names, sync_calls)
                 if hit:
                     out.append(self.finding(
                         module, node,
@@ -92,10 +100,13 @@ class HostSyncRule(Rule):
                         "suppression at a sanctioned seam"))
         return out
 
-    def _classify(self, call: ast.Call, np_names: set[str]) -> str | None:
+    def _classify(self, call: ast.Call, np_names: set[str],
+                  sync_calls: set[str] = frozenset()) -> str | None:
         name = dotted_name(call.func)
         if name is not None:
             parts = name.split(".")
+            if name in sync_calls or parts[-1] in sync_calls:
+                return f"{name}(...) [configured sync seam]"
             if len(parts) >= 2 and parts[0] in np_names \
                     and parts[-1] in _NUMPY_FETCHERS:
                 return f"{name}(...)"
