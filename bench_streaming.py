@@ -1,0 +1,177 @@
+"""Streaming-solve benchmark: warm restart after +5% new edges vs. a cold
+re-solve.
+
+The elastic-session acceptance measurement (ROADMAP item 3): a converged
+live problem absorbs a batch of streamed edges (+5% of the measurement
+count by default) and re-solves two ways —
+
+* **cold** — the library path a streaming-less stack pays every time new
+  measurements land: ``solve_rbcd`` on the full measurement set (problem
+  build, fresh compile of the unpadded shapes, centralized chordal init,
+  full descent).  The persistent XLA compile cache is disabled below, so
+  cold is real.
+* **warm** — ``LiveProblem.warm_dispatch``: the edge batch lands as masked
+  appends into the padded bucket layout (no shape change, so every
+  compiled program is reused), and the solve resumes from the previous
+  terminal ``RBCDState`` instead of the chordal init.
+
+Both arms run to the block fixed point (``rel_change_tol=0`` +
+near-zero gradient tolerance), so the final costs must agree to
+``--parity-rtol`` (default 1e-6) — the warm path must buy SPEED, never a
+different answer.  Emits ONE ``metric_record`` JSON line (the
+``BENCH_r0*.json`` schema) with the wall-clock ratio the CI smoke gates
+at ``warm <= 0.25 x cold``.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python bench_streaming.py --n 60 --extra-frac 0.05
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+# Cold means cold: the sequential arm must pay its own compilation.
+os.environ.setdefault("DPGO_TPU_COMPILATION_CACHE", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+from dpgo_tpu import obs  # noqa: E402
+from dpgo_tpu.obs.events import metric_record  # noqa: E402
+from dpgo_tpu.config import AgentParams  # noqa: E402
+from dpgo_tpu.models import rbcd  # noqa: E402
+from dpgo_tpu.models.incremental import LiveProblem  # noqa: E402
+from dpgo_tpu.types import loop_closure_mask  # noqa: E402
+from dpgo_tpu.utils.synthetic import make_measurements  # noqa: E402
+
+
+def split_stream(n, num_lc, extra_frac, seed, noise):
+    """Full problem + (base, streamed-extra) split over a FIXED pose set:
+    the stream is the newest ``extra_frac`` of the loop closures."""
+    rng = np.random.default_rng(seed)
+    meas, _ = make_measurements(rng, n=n, d=3, num_lc=num_lc,
+                                rot_noise=noise, trans_noise=noise)
+    lc_idx = np.nonzero(loop_closure_mask(meas))[0]
+    n_extra = max(1, int(round(extra_frac * len(meas))))
+    keep = np.ones(len(meas), bool)
+    keep[lc_idx[-n_extra:]] = False
+    base = dataclasses.replace(meas.select(keep), num_poses=meas.num_poses)
+    extra = dataclasses.replace(meas.select(~keep),
+                                num_poses=meas.num_poses)
+    return meas, base, extra
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=60, help="poses")
+    ap.add_argument("--robots", type=int, default=3)
+    ap.add_argument("--num-lc", type=int, default=30)
+    ap.add_argument("--extra-frac", type=float, default=0.05,
+                    help="streamed fraction of the measurement count")
+    ap.add_argument("--noise", type=float, default=0.02)
+    ap.add_argument("--max-iters", type=int, default=400)
+    ap.add_argument("--eval-every", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--parity-rtol", type=float, default=1e-6,
+                    help="required relative agreement of final costs")
+    ap.add_argument("--telemetry", metavar="DIR", default=None)
+    args = ap.parse_args(argv)
+
+    meas, base, extra = split_stream(args.n, args.num_lc, args.extra_frac,
+                                     args.seed, args.noise)
+    # Fixed-point termination: consensus at rel_change 0 (the inner
+    # solver's early exit), gradient gate effectively off — both arms
+    # converge to the same optimum, making the 1e-6 parity meaningful.
+    params = AgentParams(d=3, r=5, num_robots=args.robots,
+                         rel_change_tol=0.0)
+    gtol = 1e-9
+
+    def log(msg):
+        print(msg, file=sys.stderr, flush=True)
+
+    scope = obs.run_scope(args.telemetry) if args.telemetry else None
+    run = scope.__enter__() if scope else None
+    try:
+        # --- session setup: solve the base problem (padded bucket) --------
+        live = LiveProblem(base, args.robots, params=params)
+        log(f"[base] {len(base)} edges, bucket {tuple(live.shape)}")
+        t0 = time.perf_counter()
+        res0 = live.solve(max_iters=args.max_iters, grad_norm_tol=gtol,
+                          eval_every=args.eval_every)
+        t_base = time.perf_counter() - t0
+        log(f"[base] {res0.iterations} rounds in {t_base:.2f}s "
+            f"({res0.terminated_by})")
+
+        # --- cold arm: the library path on the grown problem --------------
+        log(f"[cold] solve_rbcd on {len(meas)} edges "
+            f"(+{len(extra)} streamed)")
+        t0 = time.perf_counter()
+        resc = rbcd.solve_rbcd(meas, args.robots, params=params,
+                               max_iters=args.max_iters,
+                               grad_norm_tol=gtol,
+                               eval_every=args.eval_every)
+        t_cold = time.perf_counter() - t0
+        log(f"[cold] {resc.iterations} rounds in {t_cold:.2f}s")
+
+        # --- warm arm: delta apply + resume from the terminal state -------
+        t0 = time.perf_counter()
+        resw = live.warm_dispatch(res0, new_edges=extra,
+                                  max_iters=args.max_iters,
+                                  grad_norm_tol=gtol,
+                                  eval_every=args.eval_every)
+        t_warm = time.perf_counter() - t0
+        delta_mode = live.last_delta.mode if live.last_delta else "none"
+        log(f"[warm] {resw.iterations} rounds in {t_warm:.2f}s "
+            f"(delta mode {delta_mode})")
+
+        rel = abs(resw.cost_history[-1] - resc.cost_history[-1]) / \
+            max(1.0, abs(resc.cost_history[-1]))
+        if rel > args.parity_rtol:
+            log(f"PARITY FAIL: cold {resc.cost_history[-1]} vs warm "
+                f"{resw.cost_history[-1]} (rel {rel})")
+            return 1
+        ratio = t_warm / t_cold
+        log(f"[streaming] warm/cold wall {ratio:.3f} "
+            f"(cold {t_cold:.2f}s, warm {t_warm:.2f}s), parity rel "
+            f"{rel:.3g}")
+
+        rec = metric_record(
+            "streaming_warm_cold_ratio",
+            round(ratio, 4),
+            "x",
+            n_poses=args.n,
+            robots=args.robots,
+            edges_base=len(base),
+            edges_streamed=len(extra),
+            extra_frac=args.extra_frac,
+            mode=delta_mode,
+            t_cold_s=round(t_cold, 4),
+            t_warm_s=round(t_warm, 4),
+            rounds_cold=resc.iterations,
+            rounds_warm=resw.iterations,
+            parity_rel=float(f"{rel:.3g}"),
+            final_cost=resw.cost_history[-1],
+        )
+        if run is not None:
+            run.metric(rec["metric"], rec["value"], rec.get("unit"),
+                       phase="bench",
+                       **{k: v for k, v in rec.items()
+                          if k not in ("metric", "value", "unit")})
+    finally:
+        if scope:
+            scope.__exit__(None, None, None)
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -131,6 +131,7 @@ class PGOAgent:
         self._edges: EdgeSet | None = None
         self._is_shared: np.ndarray | None = None   # [E] bool
         self._shared_other: np.ndarray | None = None  # [E] neighbor robot (or -1)
+        self._is_lc: np.ndarray | None = None       # [E] bool (odometry = False)
         self._lc_upd: np.ndarray | None = None      # [E] LC & not known-inlier
         self._nbr_slot: dict[PoseID, int] = {}      # remote PoseID -> buffer slot
         self._slot_pose: list[PoseID] = []
@@ -317,6 +318,7 @@ class PGOAgent:
                 all_meas, tail_index=ti, head_index=hi, is_lc=is_lc,
                 dtype=jnp.float64)
             # Static masks hoisted out of the iterate() hot path.
+            self._is_lc = np.asarray(is_lc, bool)
             self._lc_upd = is_lc & ~np.asarray(all_meas.is_known_inlier, bool)
             self._weights = np.asarray(all_meas.weight, np.float64).copy()
             self._mu = self.params.robust.gnc_init_mu
@@ -615,6 +617,19 @@ class PGOAgent:
         self.update_neighbor_poses_packed(neighbor_id, robots, poses, vals,
                                           sequence=sequence)
 
+    def _invalidate_neighbor_cache(self, neighbor_id: int) -> None:
+        """Drop every cached pose (regular + aux) received from
+        ``neighbor_id`` (under the lock).  The iterate skips optimization
+        until the revived neighbor's fresh frames refill its slots —
+        exactly the missing-pose contract of ``_neighbor_buffer``."""
+        slots = np.asarray([s for (r, _p), s in self._nbr_slot.items()
+                            if r == neighbor_id], np.int64)
+        if slots.size:
+            self._nbr_have[slots] = False
+            self._aux_have[slots] = False
+            self._nbr_ver += 1
+            self._aux_ver += 1
+
     def update_neighbor_poses_packed(self, neighbor_id: int,
                                      robots: np.ndarray, poses: np.ndarray,
                                      vals: np.ndarray,
@@ -622,17 +637,39 @@ class PGOAgent:
         """The columnar receive fast path: index vectors + one contiguous
         value payload feed the vectorized buffer scatter directly.  The
         first message from an INITIALIZED neighbor triggers robust frame
-        alignment (``PGOAgent.cpp:369-432``)."""
+        alignment (``PGOAgent.cpp:369-432``).
+
+        A frame from a neighbor previously declared lost REVIVES it with a
+        sequence reset: the revived robot may have restarted (its sequence
+        numbering starts over, so the monotonic check must not drop its
+        fresh frames as stale), and its pre-outage cached poses are
+        invalidated rather than merged — only the fresh stream is trusted
+        after a partition heals."""
+        revived = False
         with self._lock:
-            if not self._check_pose_seq(self._nbr_pose_seq, neighbor_id,
-                                        sequence):
+            if neighbor_id in self._lost_neighbors:
+                revived = True
+                stale = False
+                self._nbr_pose_seq.pop(neighbor_id, None)
+                self._nbr_aux_seq.pop(neighbor_id, None)
+                self._invalidate_neighbor_cache(neighbor_id)
+                self._lost_neighbors.discard(neighbor_id)
+                if sequence is not None:
+                    self._nbr_pose_seq[neighbor_id] = int(sequence)
+            elif not self._check_pose_seq(self._nbr_pose_seq, neighbor_id,
+                                          sequence):
                 stale = True
             else:
                 stale = False
-                self._lost_neighbors.discard(neighbor_id)
         if stale:
             self._obs_stale_dropped(neighbor_id)
             return
+        if revived:
+            run = obs.get_run()
+            if run is not None:
+                run.event("peer_revived", phase="comms",
+                          robot=self.robot_id, peer=neighbor_id,
+                          iteration=self._status.iteration_number)
         robots, poses = np.asarray(robots), np.asarray(poses)
         vals = np.asarray(vals, np.float64)
         self._obs_comms_bytes("received", vals.nbytes + 8 * robots.size,
@@ -791,7 +828,9 @@ class PGOAgent:
         continues against the last received iterate, the RA-L 2020 delay
         tolerance — and it no longer blocks the ``should_terminate``
         quorum, so the surviving team can still finish.  A fresh pose
-        message revives the neighbor (``update_neighbor_poses``)."""
+        message revives the neighbor (``update_neighbor_poses``) with a
+        sequence reset and its stale cached poses invalidated — only data
+        received after the heal is trusted."""
         neighbor_id = int(neighbor_id)
         if neighbor_id == self.robot_id:
             return
@@ -809,6 +848,155 @@ class PGOAgent:
     def lost_neighbors(self) -> list[int]:
         with self._lock:
             return sorted(self._lost_neighbors)
+
+    def admit_neighbor(self, neighbor_id: int,
+                       shared_loop_closures: "Measurements | None" = None
+                       ) -> int:
+        """The inverse of ``mark_neighbor_lost``: a robot JOINED the live
+        solve (the bus's ``_joined`` handshake).  Clears any lost/sequence
+        state for it, grows the termination quorum when the joiner's id
+        exceeds the known fleet size — so a joining robot *extends* the
+        consensus test: ``should_terminate`` now also requires the
+        newcomer to be INITIALIZED and ready — and, when
+        ``shared_loop_closures`` carries the inter-robot measurements
+        connecting this agent to the joiner (robot-local indexing, the
+        ``setPoseGraph`` vocabulary), extends the live problem in place:
+        new edge rows, new neighbor slots grown through the existing
+        packed-scatter seam, new public poses, and a rebuilt jitted step —
+        with the iterate ``X``, GNC weights of existing edges, and all
+        cached neighbor poses preserved.  Returns the number of edges
+        added.  This agent's ``ready_to_terminate`` resets: consensus must
+        re-form around the larger problem."""
+        neighbor_id = int(neighbor_id)
+        if neighbor_id == self.robot_id:
+            return 0
+        with self._lock:
+            self._lost_neighbors.discard(neighbor_id)
+            self._nbr_pose_seq.pop(neighbor_id, None)
+            self._nbr_aux_seq.pop(neighbor_id, None)
+            # A joiner is new or rebooted either way: whatever this agent
+            # cached from it belongs to a previous life (same invalidation
+            # as the lost->revive path — fresh frames refill the slots).
+            self._invalidate_neighbor_cache(neighbor_id)
+            if neighbor_id >= self.num_robots:
+                self.num_robots = neighbor_id + 1
+            added = 0
+            if shared_loop_closures is not None \
+                    and len(shared_loop_closures):
+                added = self._extend_problem(shared_loop_closures)
+            self._status.ready_to_terminate = False
+        run = obs.get_run()
+        if run is not None:
+            run.event("peer_joined", phase="comms", robot=self.robot_id,
+                      peer=neighbor_id, edges_added=added,
+                      num_robots=self.num_robots,
+                      iteration=self._status.iteration_number)
+        return added
+
+    def _extend_problem(self, new_meas: "Measurements") -> int:
+        """Append measurements to the live problem (under the lock): the
+        same deterministic index build as ``set_pose_graph``, re-run over
+        the concatenated edge list.  The prefix rows reproduce the
+        original slot/public assignment exactly (same first-reference
+        order), so the preallocated neighbor buffers carry over by prefix
+        copy and only the NEW slots grow the packed-scatter tables.  The
+        iterate, GNC weights of existing edges, and mu are untouched; the
+        jitted step rebuilds for the grown shapes (one recompile per
+        admit, the price of a bigger problem)."""
+        from .types import edge_set_from_measurements
+
+        me = self.robot_id
+        if self._meas is None:
+            raise RuntimeError("admit_neighbor with measurements requires "
+                               "set_pose_graph first")
+        mine = (np.asarray(new_meas.r1) == me) | \
+            (np.asarray(new_meas.r2) == me)
+        sub = new_meas.select(mine) if not mine.all() else new_meas
+        if len(sub) == 0:
+            return 0
+        own1 = np.asarray(sub.r1) == me
+        own2 = np.asarray(sub.r2) == me
+        if (np.asarray(sub.p1)[own1] >= self.n).any() or \
+                (np.asarray(sub.p2)[own2] >= self.n).any():
+            raise ValueError(
+                "admitted measurements reference own poses this agent "
+                "does not have — the joiner cannot add poses to a "
+                "survivor's trajectory")
+        all_meas = Measurements.concatenate([self._meas, sub])
+        E = len(all_meas)
+        is_lc = np.concatenate(
+            [self._is_lc, np.ones(len(sub), bool)])
+
+        old_S = len(self._slot_pose)
+        old_nbr_vals, old_nbr_have = self._nbr_vals, self._nbr_have
+        old_aux_vals, old_aux_have = self._aux_vals, self._aux_have
+        self._nbr_slot = {}
+        self._slot_pose = []
+        is_shared = np.zeros(E, bool)
+        shared_other = np.full(E, -1, np.int64)
+        ti = np.zeros(E, np.int64)
+        hi = np.zeros(E, np.int64)
+        pub: dict[int, None] = {}
+        n = self.n
+        for k in range(E):
+            a, p = int(all_meas.r1[k]), int(all_meas.p1[k])
+            b, q = int(all_meas.r2[k]), int(all_meas.p2[k])
+            if a == me and b == me:
+                ti[k], hi[k] = p, q
+                continue
+            is_shared[k] = True
+            if a == me:
+                shared_other[k] = b
+                pub.setdefault(p)
+                ti[k] = p
+                hi[k] = n + self._slot(b, q)
+            else:
+                shared_other[k] = a
+                pub.setdefault(q)
+                hi[k] = q
+                ti[k] = n + self._slot(a, p)
+        assert len(self._slot_pose) >= old_S and all(
+            self._nbr_slot[key] == s
+            for s, key in enumerate(self._slot_pose[:old_S])), \
+            "prefix slot assignment must be stable across an extension"
+        self._public = sorted(pub)
+        self._public_np = np.asarray(self._public, np.int64)
+        self._is_shared = is_shared
+        self._shared_other = shared_other
+        S = len(self._slot_pose)
+        self._nbr_vals = np.zeros((S, self.r, self.d + 1))
+        self._nbr_have = np.zeros(S, bool)
+        self._aux_vals = np.zeros((S, self.r, self.d + 1))
+        self._aux_have = np.zeros(S, bool)
+        self._nbr_vals[:old_S] = old_nbr_vals
+        self._nbr_have[:old_S] = old_nbr_have
+        self._aux_vals[:old_S] = old_aux_vals
+        self._aux_have[:old_S] = old_aux_have
+        enc = np.fromiter(((r << 32) | p for (r, p) in self._slot_pose),
+                          np.int64, S)
+        order = np.argsort(enc, kind="stable")
+        self._slot_enc = enc[order]
+        self._slot_enc_order = order.astype(np.int64)
+        self._nbr_ver += 1
+        self._aux_ver += 1
+        self._shared_key_to_edge = {
+            ((int(all_meas.r1[k]), int(all_meas.p1[k])),
+             (int(all_meas.r2[k]), int(all_meas.p2[k]))): k
+            for k in np.nonzero(is_shared)[0]}
+        self._meas = all_meas
+        self._is_lc = np.asarray(is_lc, bool)
+        self._edges = edge_set_from_measurements(
+            all_meas, tail_index=ti, head_index=hi, is_lc=is_lc,
+            dtype=jnp.float64)
+        self._lc_upd = is_lc & ~np.asarray(all_meas.is_known_inlier, bool)
+        # Existing edges keep their live (possibly GNC-updated) weights;
+        # new edges start at their measurement weight.
+        self._weights = np.concatenate(
+            [self._weights, np.asarray(sub.weight, np.float64)])
+        self._weights_dev = None
+        if self._status.state == AgentState.INITIALIZED:
+            self._build_step()  # grown shapes: one recompile
+        return len(sub)
 
     def should_terminate(self) -> bool:
         """Team consensus (``shouldTerminate``, ``PGOAgent.cpp:1007-1031``):

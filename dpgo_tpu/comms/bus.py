@@ -90,11 +90,20 @@ class RoundBus:
         self.miss_limit = miss_limit
         self.liveness_timeout_s = liveness_timeout_s
         self.lost: set[int] = set()
+        #: Robots admitted AFTER the bus started (the join handshake);
+        #: rebroadcast cumulatively in the ``_joined`` key — like
+        #: ``_lost`` — so a drop-lossy link still learns about every
+        #: joiner eventually.
+        self.joined: set[int] = set()
         self._last_frames: dict[int, dict] = {}
         self._last_seqs: dict[int, int] = {}
         self._misses: dict[int, int] = {rid: 0 for rid in channels}
         self._anom_seen: dict[int, int] = {}  # rid -> last gossiped count
         self.rounds_served = 0
+        # Joins land between rounds from any thread (a launcher's accept
+        # loop); the relay drains them at the top of its next round.
+        self._admit_lock = threading.Lock()
+        self._admit_pending: list[tuple[int, ReliableChannel]] = []
 
     def _mark_lost(self, rid: int, reason: str) -> None:
         if rid in self.lost:
@@ -148,8 +157,52 @@ class RoundBus:
                           round=self.rounds_served)
             self._anom_seen[rid] = max(self._anom_seen.get(rid, 0), count)
 
+    def admit(self, rid: int, channel: ReliableChannel) -> None:
+        """The join handshake, hub side: attach a robot's channel to the
+        live relay.  Effective at the start of the next round; the robot
+        is announced to the fleet in the cumulative ``_joined`` broadcast
+        key so survivors can grow their problems
+        (``PGOAgent.admit_neighbor``).  Re-admitting a previously-lost
+        robot revives it (fresh channel, miss counters reset)."""
+        with self._admit_lock:
+            self._admit_pending.append((int(rid), channel))
+
+    def admit_hello(self, channel: ReliableChannel,
+                    timeout: float | None = None) -> int:
+        """Receive the joiner's ``{"hello": robot_id}`` introduction frame
+        (the same vocabulary ``accept_robots`` uses at launch) and admit
+        it.  Returns the robot id — the TCP launcher's accept-loop
+        helper."""
+        hello = channel.recv(timeout=timeout)
+        rid = int(hello["hello"])
+        channel.name = f"bus->robot{rid}"
+        self.admit(rid, channel)
+        return rid
+
+    def _drain_admissions(self) -> None:
+        with self._admit_lock:
+            pending, self._admit_pending = self._admit_pending, []
+        for rid, ch in pending:
+            stale = self.channels.pop(rid, None)
+            if stale is not None and stale is not ch:
+                try:
+                    stale.close(emit_summary=False)
+                except Exception:
+                    pass
+            self.channels[rid] = ch
+            self.lost.discard(rid)
+            self._misses[rid] = 0
+            self._last_frames.pop(rid, None)
+            self._last_seqs.pop(rid, None)
+            self.joined.add(rid)
+            run = obs.get_run()
+            if run is not None:
+                run.event("peer_joined", phase="comms", peer=rid,
+                          round=self.rounds_served)
+
     def round(self) -> dict:
         """One relay round; returns the merged broadcast frame."""
+        self._drain_admissions()
         # The hub's span (robot = -1): gather + rebroadcast wall-clock,
         # the wire half of every round's critical path.
         sp = trace.span("bus_round", phase="comms", robot=-1,
@@ -166,6 +219,9 @@ class RoundBus:
                 merged[f"r{rid}|_pseq"] = np.asarray(
                     self._last_seqs.get(rid, -1), np.int64)
             merged["_lost"] = np.asarray(sorted(self.lost), np.int64)
+            if self.joined:
+                merged["_joined"] = np.asarray(sorted(self.joined),
+                                               np.int64)
             for rid, ch in sorted(self.channels.items()):
                 if rid in self.lost:
                     continue
@@ -230,6 +286,10 @@ class BusClient:
         if channel.origin is None:
             channel.origin = self.robot_id  # clock-domain identity
         self.lost: set[int] = set()
+        #: Robots the hub admitted mid-run (the ``_joined`` broadcast key);
+        #: the driver reacts by growing its agent's problem
+        #: (``PGOAgent.admit_neighbor``) for joiners it has not seen.
+        self.joined: set[int] = set()
         self.staleness = 0
         # Overlap state is shared between the caller's compute thread and
         # the exchange worker; everything below rides one condition.
@@ -280,6 +340,9 @@ class BusClient:
             sp.add(got=True)
         if "_lost" in merged:
             self.lost = {int(x) for x in np.asarray(merged["_lost"]).ravel()}
+        if "_joined" in merged:
+            self.joined = {int(x)
+                           for x in np.asarray(merged["_joined"]).ravel()}
         return merged
 
     def exchange(self, frame: dict,

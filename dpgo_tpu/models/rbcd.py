@@ -1289,6 +1289,15 @@ class RBCDResult:
     iterations: int
     terminated_by: str
     weights: jax.Array | None = None  # [M] final per-measurement GNC weights
+    #: Exact terminal solver state (the warm-start handle of the live-session
+    #: layer, ``models.incremental``): resuming ``dispatch_prepared`` from it
+    #: after streaming new edges skips the centralized init entirely.  Set by
+    #: the single-problem driver loops; batched serving results leave it None
+    #: (their states ride the session store instead).
+    state: "RBCDState | None" = None
+    #: True when the serving plane completed this request by re-admitting it
+    #: from a crash-recovery session snapshot (``serve.session``).
+    recovered: bool = False
 
 
 def global_weights(weights: jax.Array, graph: MultiAgentGraph,
@@ -1944,7 +1953,8 @@ def run_rbcd(
             num_weight_updates=num_weight_updates)
     return RBCDResult(T=T, X=state.X, cost_history=cost_hist,
                       grad_norm_history=gn_hist, iterations=it,
-                      terminated_by=terminated_by, weights=w_glob)
+                      terminated_by=terminated_by, weights=w_glob,
+                      state=state)
 
 
 def _emit_sync_rate(obs_run, fetches: int, rounds: int) -> None:
@@ -2100,7 +2110,8 @@ def _run_verdict_loop(state, graph, meta, segment, *, max_iters,
             verdict_every=verdict_every, verdict=unpack_verdict(word))
     return RBCDResult(T=T, X=state.X, cost_history=cost_hist,
                       grad_norm_history=gn_hist, iterations=it_final,
-                      terminated_by=terminated_by, weights=w_glob)
+                      terminated_by=terminated_by, weights=w_glob,
+                      state=state)
 
 
 def initial_state_for(init: str, part: Partition, meta: GraphMeta,

@@ -24,6 +24,11 @@ as a *shared backend* rather than a per-robot binary:
   requests are traced end to end (admission -> queue -> dispatch ->
   reply spans with batch-mate flow arrows) and compiles profiled
   (``obs.profile``) — all of it only when a telemetry run is live.
+* ``session`` — the crash-recovery session store: schema-versioned
+  solver-state snapshots written on solve boundaries; a worker that dies
+  mid-batch is respawned and session-tagged requests are re-admitted
+  from their last valid snapshot (corrupt snapshots quarantined), the
+  reply flagged ``recovered``.
 
 Quickstart (in-process)::
 
@@ -42,6 +47,7 @@ from .cache import ExecutableCache, problem_fingerprint
 from .runner import run_bucket
 from .server import (OverCapacityError, ServeSLO, SolveRequest, SolveServer,
                      SolveTicket)
+from .session import SessionSnapshot, SessionStore
 
 __all__ = [
     "BucketShape",
@@ -55,4 +61,6 @@ __all__ = [
     "SolveRequest",
     "SolveServer",
     "SolveTicket",
+    "SessionSnapshot",
+    "SessionStore",
 ]

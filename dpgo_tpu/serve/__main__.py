@@ -59,6 +59,16 @@ def main(argv: list[str] | None = None) -> int:
                     help="capture a jax.profiler trace of the first "
                          "--profile-batches batched dispatches here")
     ap.add_argument("--profile-batches", type=int, default=3)
+    ap.add_argument("--session-dir", metavar="DIR", default=None,
+                    help="crash-recovery session store root: session-tagged "
+                         "requests snapshot their solver state on solve "
+                         "boundaries and are re-admitted from the last "
+                         "snapshot (reply flags recovered=1) when a worker "
+                         "dies mid-batch")
+    ap.add_argument("--drain", action="store_true",
+                    help="on SIGINT, drain instead of hard-close: stop "
+                         "admission with structured sheds, finish the "
+                         "in-flight batch (/healthz reports draining)")
     args = ap.parse_args(argv)
 
     slo = ServeSLO(latency_s=args.slo_latency_s) \
@@ -66,13 +76,16 @@ def main(argv: list[str] | None = None) -> int:
     scope = obs.run_scope(args.telemetry) if args.telemetry else None
     run = scope.__enter__() if scope else None
     try:
-        with SolveServer(max_batch=args.max_batch, max_queue=args.max_queue,
-                         batch_window_s=args.batch_window_ms / 1e3,
-                         tenant_quota=args.tenant_quota,
-                         quantum=args.quantum, slo=slo,
-                         metrics_port=args.metrics_port,
-                         profile_dir=args.profile_dir,
-                         profile_batches=args.profile_batches) as server:
+        server = SolveServer(max_batch=args.max_batch,
+                             max_queue=args.max_queue,
+                             batch_window_s=args.batch_window_ms / 1e3,
+                             tenant_quota=args.tenant_quota,
+                             quantum=args.quantum, slo=slo,
+                             metrics_port=args.metrics_port,
+                             profile_dir=args.profile_dir,
+                             profile_batches=args.profile_batches,
+                             session_store=args.session_dir)
+        try:
             with ServeFrontend(
                     server, host=args.host, port=args.port,
                     max_frame_bytes=int(args.max_frame_mb * 2 ** 20),
@@ -94,7 +107,14 @@ def main(argv: list[str] | None = None) -> int:
                     while True:
                         time.sleep(1.0)
                 except KeyboardInterrupt:
-                    print("shutting down", flush=True)
+                    print("draining" if args.drain else "shutting down",
+                          flush=True)
+                    # Drain while the connections are still up, so queued
+                    # requests get their structured shed replies instead
+                    # of a dropped socket; the frontend closes after.
+                    server.close(drain=args.drain)
+        finally:
+            server.close(drain=args.drain)  # idempotent
     finally:
         if scope:
             scope.__exit__(None, None, None)

@@ -60,6 +60,11 @@ class PaddedProblem:
     edges_g: EdgeSet  # padded global edge set (metrics + init)
     X0: jax.Array
     shape: BucketShape
+    #: Exact solver state to resume from instead of ``init_state(X0)`` —
+    #: the crash-recovery path (``serve.session``) re-admits a died-mid-
+    #: batch request with its last snapshot here.  Shapes must match the
+    #: bucket; carried factors are refreshed by the runner when absent.
+    state0: "rbcd.RBCDState | None" = None
 
 
 def _round_up(x: int, q: int) -> int:

@@ -90,6 +90,8 @@ def _handle_request(server: SolveServer, frame: dict, ctx) -> dict:
             eval_every=int(np.asarray(frame["eval_every"]))
             if "eval_every" in frame else 1,
             trace_ctx=ctx,
+            session_id=_unpack_str(frame["session"])
+            if "session" in frame else None,
         )
         res = server.submit(req).result()
     except OverCapacityError as e:
@@ -104,6 +106,9 @@ def _handle_request(server: SolveServer, frame: dict, ctx) -> dict:
         "grad_norm_history": np.asarray(res.grad_norm_history, np.float64),
         "iterations": np.int32(res.iterations),
         "terminated_by": _pack_str(res.terminated_by),
+        # Crash-recovery disclosure: the solve completed from a session
+        # snapshot after a worker death (serve.session).
+        "recovered": np.int8(bool(getattr(res, "recovered", False))),
     }
 
 
@@ -194,7 +199,8 @@ def solve_g2o(host: str, port: int, g2o, num_robots: int,
               eval_every: int = 1, deadline_s: float | None = None,
               timeout: float | None = None,
               max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
-              wire_format: str = "packed") -> dict:
+              wire_format: str = "packed",
+              session_id: str | None = None) -> dict:
     """Submit one g2o problem to a remote front-end and wait for the
     result.  ``g2o`` is the file's bytes or a path.  Returns a dict with
     ``ok`` plus either the result arrays (``T``, ``cost_history``,
@@ -216,6 +222,8 @@ def solve_g2o(host: str, port: int, g2o, num_robots: int,
         frame["max_iters"] = np.int32(max_iters)
     if deadline_s is not None:
         frame["deadline_s"] = np.float64(deadline_s)
+    if session_id is not None:
+        frame["session"] = _pack_str(session_id)
     # Request-scoped trace context: with telemetry on in the CLIENT
     # process, the whole round-trip is one span and its ids ride the
     # frame, so the server's spans join this trace (telemetry off:
@@ -242,6 +250,7 @@ def solve_g2o(host: str, port: int, g2o, num_robots: int,
         out["grad_norm_history"] = np.asarray(reply["grad_norm_history"])
         out["iterations"] = int(np.asarray(reply["iterations"]))
         out["terminated_by"] = _unpack_str(reply["terminated_by"])
+        out["recovered"] = bool(int(np.asarray(reply.get("recovered", 0))))
     else:
         out["error"] = _unpack_str(reply.get("error", _pack_str("")))
         out["shed"] = bool(int(np.asarray(reply.get("shed", 0))))

@@ -51,6 +51,12 @@ def _tree_stack(trees):
     return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
 
 
+def _slice_states(state_b, n: int):
+    """Per-problem views of the stacked batch state (device slices; the
+    session store materializes them on save)."""
+    return [jax.tree.map(lambda a: a[b], state_b) for b in range(n)]
+
+
 def _next_pow2(n: int) -> int:
     p = 1
     while p < n:
@@ -148,7 +154,8 @@ def _cached_exec(cache: ExecutableCache, fp: dict, make,
 
 def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
                max_iters: int | None = None, grad_norm_tol: float = 0.1,
-               eval_every: int = 1, verdict_every: int | None = None):
+               eval_every: int = 1, verdict_every: int | None = None,
+               session_cb=None, session_every: int = 1):
     """Solve a list of same-bucket padded problems as one batched program.
 
     Returns ``(results, info)``: per-problem ``RBCDResult`` (trajectories
@@ -164,7 +171,14 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
     member that terminates mid-window runs up to ``K - eval_every``
     extra polish rounds (monotone under the plain schedule, like the
     legacy batch's wait-for-the-batch behavior); its reported history
-    and round count are truncated at its latched terminal eval."""
+    and round count are truncated at its latched terminal eval.
+
+    ``session_cb(iteration, states)`` — the crash-recovery hook
+    (``serve.session``): called every ``session_every`` eval boundaries
+    (and at the verdict-mode K boundaries) with the per-problem sliced
+    solver states, so a server can persist resumable snapshots while the
+    batch is in flight.  A member problem carrying ``state0`` resumes
+    from that exact state instead of its ``X0`` init."""
     if not padded:
         return [], {"rounds": 0, "evals": 0, "batch": 0, "occupancy": 0.0}
     first = padded[0]
@@ -181,9 +195,19 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
 
     B_real = len(padded)
     B = _next_pow2(B_real)
+
+    def _initial_state(p: PaddedProblem):
+        if p.state0 is not None:
+            st = p.state0
+            # Persisted snapshots drop the recomputable factors; restore
+            # them from the carried weights (bit-identical refresh).
+            if st.chol is None:
+                st = rbcd.refresh_problem(st, p.graph, meta, params)
+            return st
+        return rbcd.init_state(p.graph, meta, p.X0, params=params)
+
     with span("stack", phase="serve", batch=B, size=B_real):
-        states = [rbcd.init_state(p.graph, meta, p.X0, params=params)
-                  for p in padded]
+        states = [_initial_state(p) for p in padded]
         graphs = [p.graph for p in padded]
         edges_g = [p.edges_g for p in padded]
         while len(states) < B:  # replicate the tail to the pow2 width
@@ -272,6 +296,10 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
                 run.counter("serve_device_time_seconds_total",
                             "cumulative batched-dispatch wall-clock",
                             unit="s").inc(dt)
+            if session_cb is not None:
+                # Snapshot at the verdict boundary: the live batch state is
+                # on hand and the window's segments have already retired.
+                session_cb(it, _slice_states(state_b, B_real))
             all_terminal = ((wv & 7) != rbcd.VERDICT_RUNNING).all()
             if it >= max_iters or bool(all_terminal):
                 break
@@ -320,6 +348,8 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
                         "cumulative batched-dispatch wall-clock",
                         unit="s").inc(dt)
         evals += 1
+        if session_cb is not None and evals % max(int(session_every), 1) == 0:
+            session_cb(it, _slice_states(state_b, B_real))
         for b in range(B_real):
             if done[b]:
                 continue

@@ -63,6 +63,7 @@ from ..types import Measurements
 from .bucketing import bucket_shape_of, pad_problem
 from .cache import ExecutableCache, fingerprint_key, problem_fingerprint
 from .runner import run_bucket
+from .session import SessionStore
 
 
 @dataclasses.dataclass(frozen=True)
@@ -180,6 +181,12 @@ class SolveRequest:
     #: spans join the client's trace.  None (default, and always with
     #: telemetry off) starts a fresh trace per request.
     trace_ctx: tuple | None = None
+    #: Durable session identity.  When the server carries a
+    #: ``SessionStore``, a session-tagged request's solver state is
+    #: snapshotted on solve boundaries and, if the worker dies mid-batch,
+    #: the request is re-admitted from the last snapshot and completes
+    #: with ``RBCDResult.recovered = True`` instead of being lost.
+    session_id: str | None = None
 
 
 class SolveTicket:
@@ -197,6 +204,9 @@ class SolveTicket:
         # worker-side scratch
         self._padded = None
         self._key: str | None = None
+        #: set when this request was re-admitted from a session snapshot
+        #: after a worker crash; stamped onto its result as ``recovered``.
+        self._recovered = False
         # tracing context (set by submit() only when telemetry is on)
         self.trace_id: int | None = None
         self.span_admission: int | None = None
@@ -232,8 +242,13 @@ class SolveTicket:
 class SolveServer:
     """Multi-tenant batched PGO solve server (in-process API).
 
-    Use as a context manager; ``close()`` drains nothing — queued requests
-    are shed with ``reason="closed"``."""
+    Use as a context manager.  ``close()`` sheds queued requests with
+    ``reason="closed"``; ``close(drain=True)`` is the graceful variant
+    (admission stops with structured sheds, the in-flight batch replies,
+    ``/healthz`` reports ``draining`` until shutdown completes).  With a
+    ``session_store``, session-tagged requests survive worker deaths: the
+    supervisor re-admits them from their last snapshot and the reply
+    carries ``recovered=True``."""
 
     def __init__(self, max_batch: int = 8, max_queue: int = 64,
                  batch_window_s: float = 0.005,
@@ -244,7 +259,10 @@ class SolveServer:
                  metrics_host: str = "127.0.0.1",
                  profile_dir: str | None = None,
                  profile_batches: int = 3,
-                 verdict_every: int | None = None):
+                 verdict_every: int | None = None,
+                 session_store: "SessionStore | str | None" = None,
+                 session_every: int = 1,
+                 worker_restarts: int = 2):
         if max_batch < 1 or max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
         self.max_batch = int(max_batch)
@@ -262,6 +280,17 @@ class SolveServer:
         #: One ``ServeSLO`` for every tenant, or a per-tenant dict (the
         #: ``"default"`` key, when present, covers unlisted tenants).
         self.slo = slo
+        #: Crash-recovery session store (``serve.session``): session-tagged
+        #: requests snapshot every ``session_every`` solve boundaries and
+        #: are re-admitted from their last snapshot when the worker dies.
+        #: A string is treated as the store's root directory.
+        self.session_store = SessionStore(session_store) \
+            if isinstance(session_store, str) else session_store
+        self.session_every = max(int(session_every), 1)
+        #: How many unexpected worker deaths the supervisor absorbs before
+        #: giving up and shedding the queue (a crash-looping device should
+        #: fail loudly, not spin).
+        self.worker_restarts = max(int(worker_restarts), 0)
         self.cache = ExecutableCache()
         # One condition serializes ALL cross-thread server state: client
         # threads (submit/status/sidecar scrapes), the worker, and close.
@@ -269,6 +298,10 @@ class SolveServer:
         self._pending: deque[SolveTicket] = deque()   # guarded-by: _cond
         self._inflight: dict[str, int] = {}           # guarded-by: _cond
         self._closed = False                          # guarded-by: _cond
+        self._draining = False                        # guarded-by: _cond
+        self._terminated = False                      # guarded-by: _cond
+        self._active: list[SolveTicket] = []          # guarded-by: _cond
+        self._crashes = 0                             # guarded-by: _cond
         self._t0_mono = time.monotonic()
         # Plain-int liveness tallies for /statusz (server state, not obs).
         self._n_batches = 0                           # guarded-by: _cond
@@ -297,7 +330,8 @@ class SolveServer:
 
                     self._profiler = ProfilerWindow(
                         profile_dir, num_batches=profile_batches)
-            self._worker = threading.Thread(target=self._loop, daemon=True,
+            self._worker = threading.Thread(target=self._supervise,
+                                            daemon=True,
                                             name="dpgo-serve-worker")
             self._worker.start()
         except BaseException:
@@ -338,6 +372,15 @@ class SolveServer:
         try:
             with self._cond:
                 if self._closed:
+                    if self._draining:
+                        # Graceful drain: admission stops with a structured
+                        # shed (the TCP front-end turns this into a
+                        # shed(reason=closed) reply, not a dropped
+                        # connection).
+                        self._obs_shed(request.tenant, "closed", 0.0)
+                        raise OverCapacityError(
+                            "server is draining: admission stopped",
+                            reason="closed")
                     raise RuntimeError("server is closed")
                 if len(self._pending) >= self.max_queue:
                     self._obs_shed(request.tenant, "queue", 0.0)
@@ -395,13 +438,30 @@ class SolveServer:
                       requests=len(requests))
         return len(groups)
 
-    def close(self) -> None:
+    def close(self, drain: bool = False) -> None:
+        """Shut down.  ``drain=True`` is the graceful path: admission stops
+        with structured ``OverCapacityError(reason="closed")`` sheds, the
+        in-flight batch finishes and replies normally, queued requests are
+        shed with the same structured reason, and ``/healthz`` reports
+        ``draining`` for the whole window before going 503."""
         with self._cond:
             if self._closed:
-                return
-            self._closed = True
-            self._cond.notify_all()
+                already = True
+            else:
+                already = False
+                self._draining = bool(drain)
+                self._closed = True
+                self._cond.notify_all()
+                run = obs.get_run()
+                if drain and run is not None:
+                    run.event("server_draining", phase="serve",
+                              queued=len(self._pending))
+        del already
         self._worker.join()
+        with self._cond:
+            if self._terminated:
+                return
+            self._terminated = True
         if self.sidecar is not None:
             self.sidecar.close()
         if self._profiler is not None:
@@ -414,7 +474,11 @@ class SolveServer:
         with self._cond:
             queue_depth = len(self._pending)
             inflight = dict(self._inflight)
-            closed = self._closed
+            # "closed" is the terminal state (503 on /healthz); a draining
+            # server is still finishing work and reports that instead.
+            closed = self._terminated
+            draining = self._draining and not self._terminated
+            crashes = self._crashes
             n_requests = self._n_requests
             n_batches = self._n_batches
             n_shed = self._n_shed
@@ -435,6 +499,8 @@ class SolveServer:
         out = {
             "uptime_s": time.monotonic() - self._t0_mono,
             "closed": closed,
+            "draining": draining,
+            "worker_crashes": crashes,
             "queue_depth": queue_depth,
             "max_queue": self.max_queue,
             "max_batch": self.max_batch,
@@ -479,6 +545,86 @@ class SolveServer:
                     self._inflight.pop(tenant, None)
                 else:
                     self._inflight[tenant] = n
+
+    def _supervise(self) -> None:
+        """Worker supervisor: run the drain loop; on an unexpected worker
+        death (anything escaping ``_loop`` — ``_run_batch`` already
+        contains per-batch solver failures) re-admit the in-flight batch
+        from session snapshots and respawn, up to ``worker_restarts``
+        times.  A TaskStop-style kill therefore loses no session-tagged
+        request and leaks no thread: the supervisor thread IS the next
+        worker."""
+        while True:
+            try:
+                self._loop()
+                return
+            except BaseException as e:  # the worker died mid-batch
+                if not self._recover_from_crash(e):
+                    return
+
+    def _recover_from_crash(self, exc: BaseException) -> bool:
+        """Re-admit the crashed batch (session-tagged tickets resume from
+        their newest valid snapshot; the rest fail with the crash), then
+        decide whether to respawn.  Returns True to run another worker
+        iteration."""
+        with self._cond:
+            self._crashes += 1
+            crashes = self._crashes
+            active, self._active = self._active, []
+            closed = self._closed
+        run = obs.get_run()
+        if run is not None:
+            run.event("worker_crashed", phase="serve",
+                      error=f"{type(exc).__name__}: {exc}",
+                      crashes=crashes, in_flight=len(active))
+        recovered, lost = [], []
+        for t in active:
+            snap = None
+            sid = t.request.session_id
+            if self.session_store is not None and sid is not None:
+                snap = self.session_store.load_newest(sid)
+            if snap is not None and t._padded is not None:
+                t._padded = dataclasses.replace(t._padded,
+                                                state0=snap.state)
+                t._recovered = True
+                recovered.append(t)
+            else:
+                lost.append(t)
+        for t in lost:
+            t._finish(exception=RuntimeError(
+                f"solve worker died mid-batch "
+                f"({type(exc).__name__}: {exc}) and no session snapshot "
+                "was available to recover from"))
+        self._release(lost)
+        with self._cond:
+            # Recovered tickets go back to the FRONT of the queue (they
+            # were already dispatched once); in-flight accounting never
+            # dropped them, so quotas stay consistent.
+            for t in reversed(recovered):
+                self._pending.appendleft(t)
+            if recovered:
+                self._cond.notify_all()
+        if run is not None and recovered:
+            run.counter("session_recoveries_total",
+                        "requests re-admitted from session snapshots "
+                        "after a worker crash").inc(len(recovered))
+            for t in recovered:
+                run.event("session_recovered", phase="serve",
+                          session=t.request.session_id,
+                          tenant=t.request.tenant)
+        if closed or crashes > self.worker_restarts:
+            # Give up: shed whatever is left so no caller blocks forever.
+            with self._cond:
+                leftovers = list(self._pending)
+                self._pending.clear()
+                self._closed = True
+            for t in leftovers:
+                t._finish(exception=OverCapacityError(
+                    "solve worker crash-looped; server gave up",
+                    reason="closed"))
+            self._release(leftovers)
+            return False
+        return True
 
     def _loop(self) -> None:
         while True:
@@ -590,6 +736,11 @@ class SolveServer:
                           ORIGIN_SERVE_SERVER, t.t_submit, t.t_submit_wall))
         if self._profiler is not None:
             self._profiler.batch_begin()
+        session_cb = self._session_cb(tickets)
+        with self._cond:
+            # The crash-recovery set: whatever the supervisor finds here
+            # when the worker dies is the batch that was in flight.
+            self._active = list(tickets)
         try:
             ve = self.verdict_every
             if ve is not None and ve % max(req0.eval_every, 1) != 0:
@@ -597,17 +748,28 @@ class SolveServer:
             results, info = run_bucket(
                 [t._padded for t in tickets], self.cache,
                 max_iters=req0.max_iters, grad_norm_tol=req0.grad_norm_tol,
-                eval_every=req0.eval_every, verdict_every=ve)
+                eval_every=req0.eval_every, verdict_every=ve,
+                session_cb=session_cb, session_every=self.session_every)
         except Exception as e:
             for t in tickets:
                 t._finish(exception=e)
             self._release(tickets)
+            with self._cond:
+                self._active = []
             if dsp is not None:
                 dsp.__exit__(type(e), e, None)
             if self._profiler is not None:
                 self._profiler.batch_end()
             return
+        with self._cond:
+            self._active = []
         for t, res in zip(tickets, results):
+            if t._recovered:
+                res.recovered = True
+            sid = t.request.session_id
+            if self.session_store is not None and sid is not None:
+                # The request completed; its recovery snapshots are spent.
+                self.session_store.discard(sid)
             t._finish(result=res)
         self._release(tickets)
         if self._profiler is not None:
@@ -637,6 +799,25 @@ class SolveServer:
                                 "rounds": info["rounds"],
                                 "duration_s": duration_s}
         self._obs_batch(tickets, results, info, duration_s)
+
+    def _session_cb(self, tickets):
+        """The runner's snapshot hook for this batch: persist each
+        session-tagged member's sliced state.  None when no store is
+        configured or no member carries a session id (zero overhead on
+        the common path)."""
+        if self.session_store is None:
+            return None
+        tagged = [(i, t.request.session_id) for i, t in enumerate(tickets)
+                  if t.request.session_id is not None]
+        if not tagged:
+            return None
+        store = self.session_store
+
+        def cb(iteration, states):
+            for i, sid in tagged:
+                store.save(sid, states[i], iteration=iteration,
+                           meta={"tenant": tickets[i].request.tenant})
+        return cb
 
     # -- telemetry (every site behind the zero-overhead fence) --------------
 

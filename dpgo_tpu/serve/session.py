@@ -1,0 +1,234 @@
+"""Crash-recovery session store: durable solver-state snapshots.
+
+The flight recorder (``obs.recorder``) snapshots exact ``RBCDState``\\ s
+for *replay* — a black box read after the fact.  This module promotes the
+same snapshot payload to a *session store*: a directory of
+schema-versioned ``.npz`` state files a live server writes on solve
+boundaries and reads back to re-admit work that died mid-batch.  It is a
+durability feature, not telemetry — it works with the obs stack entirely
+off (events/counters about it are separately fenced by the callers).
+
+Layout (one subdirectory per session id)::
+
+    <root>/<session id>/snap-00000040.npz     # newest wins
+    <root>/<session id>/snap-00000020.npz
+    <root>/<session id>/snap-00000020.npz.quarantined  # failed validation
+
+Every snapshot carries ``__schema__`` (``SESSION_SCHEMA_VERSION``) and the
+full ``RBCDState`` array set (``models.incremental.state_to_arrays``); the
+factors (``chol``/``Qbuf``) are never persisted — ``refresh_problem``
+recomputes them bit-for-bit from the stored weights.  Writes are atomic
+(temp file + rename), so a crash mid-write leaves at worst one torn temp
+file, never a torn snapshot.
+
+``load_newest`` is the recovery contract the server worker relies on:
+newest-first, any snapshot that fails to parse (truncated zip, bit-flipped
+member, wrong schema version, missing state field) is QUARANTINED — renamed
+aside so it is never retried — and the previous snapshot is tried instead.
+A corrupt store therefore degrades to an older resume point or a clean
+``None`` (cold re-solve); it never raises into the worker loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import threading
+
+import numpy as np
+
+from .. import obs
+from ..models.incremental import state_from_arrays, state_to_arrays
+from ..models.rbcd import RBCDState
+
+#: Bump on any incompatible change to the snapshot array set.  A loader
+#: finding a different major version quarantines the file — resuming a
+#: solver from arrays with silently different semantics is worse than a
+#: cold re-solve.
+SESSION_SCHEMA_VERSION = 1
+
+_SNAP_RE = re.compile(r"^snap-(\d{8})\.npz$")
+#: RBCDState fields every valid snapshot must carry (the optional
+#: ``V``/``X_init`` are schema-legal absences).
+_REQUIRED = ("X", "weights", "key", "rel_change", "ready", "gamma",
+             "alpha", "mu")
+
+
+@dataclasses.dataclass
+class SessionSnapshot:
+    """One recovered snapshot: the rebuilt state plus its bookkeeping."""
+
+    session_id: str
+    path: str
+    iteration: int
+    num_weight_updates: int
+    state: RBCDState
+    meta: dict
+
+
+def _sanitize(session_id: str) -> str:
+    """Session ids become directory names; keep them path-safe."""
+    out = re.sub(r"[^A-Za-z0-9._-]", "_", str(session_id))
+    if not out or out in (".", ".."):
+        raise ValueError(f"invalid session id {session_id!r}")
+    return out
+
+
+class SessionStore:
+    """Directory-backed store of per-session solver snapshots.
+
+    Thread-safe: the server worker saves while client threads may list or
+    discard; one lock serializes directory mutations per store."""
+
+    def __init__(self, root: str, keep: int = 2):
+        if keep < 1:
+            raise ValueError("keep must be >= 1")
+        self.root = str(root)
+        self.keep = int(keep)
+        self._lock = threading.Lock()
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- paths ---------------------------------------------------------------
+
+    def _dir(self, session_id: str) -> str:
+        return os.path.join(self.root, _sanitize(session_id))
+
+    def _snaps(self, sdir: str) -> list[tuple[int, str]]:
+        """(sequence, filename) of intact-looking snapshots, oldest first."""
+        try:
+            names = os.listdir(sdir)
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            m = _SNAP_RE.match(name)
+            if m:
+                out.append((int(m.group(1)), name))
+        return sorted(out)
+
+    # -- writing -------------------------------------------------------------
+
+    def save(self, session_id: str, state: RBCDState, iteration: int,
+             num_weight_updates: int = 0, meta: dict | None = None) -> str:
+        """Persist one snapshot atomically; prune to the ``keep`` newest.
+        ``iteration`` doubles as the snapshot sequence number, so saves on
+        the solver's K-boundaries land in replayable order."""
+        sdir = self._dir(session_id)
+        arrays = state_to_arrays(state)
+        arrays["__schema__"] = np.asarray(SESSION_SCHEMA_VERSION, np.int64)
+        arrays["__iteration__"] = np.asarray(int(iteration), np.int64)
+        arrays["__nwu__"] = np.asarray(int(num_weight_updates), np.int64)
+        if meta:
+            arrays["__meta__"] = np.frombuffer(
+                json.dumps(meta, sort_keys=True).encode("utf-8"), np.uint8)
+        with self._lock:
+            os.makedirs(sdir, exist_ok=True)
+            path = os.path.join(sdir, f"snap-{int(iteration):08d}.npz")
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:
+                np.savez_compressed(fh, **arrays)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+            for _, name in self._snaps(sdir)[:-self.keep]:
+                try:
+                    os.remove(os.path.join(sdir, name))
+                except OSError:
+                    pass
+        run = obs.get_run()
+        if run is not None:
+            run.counter("session_saves_total",
+                        "session snapshots persisted").inc()
+            run.event("session_saved", phase="session",
+                      session=str(session_id), iteration=int(iteration),
+                      path=path)
+        return path
+
+    # -- reading / recovery --------------------------------------------------
+
+    def _load_one(self, path: str) -> tuple[dict, dict]:
+        """Parse + validate one snapshot file; raises on any defect."""
+        arrays = dict(np.load(path, allow_pickle=False))
+        schema = int(np.asarray(arrays.pop("__schema__")))
+        if schema != SESSION_SCHEMA_VERSION:
+            raise ValueError(f"schema version {schema} != "
+                             f"{SESSION_SCHEMA_VERSION}")
+        for f in _REQUIRED:
+            if f not in arrays:
+                raise ValueError(f"missing state field {f!r}")
+            # Decompress every member now: a bit-flip deep in the zip
+            # stream must fail HERE, in the quarantine path, not later
+            # inside the solver.
+            np.asarray(arrays[f])
+        book = {
+            "iteration": int(np.asarray(arrays.pop("__iteration__", 0))),
+            "num_weight_updates": int(np.asarray(arrays.pop("__nwu__", 0))),
+        }
+        raw_meta = arrays.pop("__meta__", None)
+        book["meta"] = json.loads(bytes(np.asarray(raw_meta, np.uint8))
+                                  .decode("utf-8")) \
+            if raw_meta is not None else {}
+        return arrays, book
+
+    def _quarantine(self, path: str, error: Exception) -> None:
+        try:
+            os.replace(path, path + ".quarantined")
+        except OSError:
+            pass
+        run = obs.get_run()
+        if run is not None:
+            run.counter("session_quarantined_total",
+                        "corrupt session snapshots set aside").inc()
+            run.event("session_quarantined", phase="session", path=path,
+                      error=f"{type(error).__name__}: {error}")
+
+    def load_newest(self, session_id: str) -> SessionSnapshot | None:
+        """The newest VALID snapshot, quarantining corrupt ones on the way
+        down; None when no valid snapshot remains.  Never raises on bad
+        data — the recovery path must not kill the worker a second time."""
+        sdir = self._dir(session_id)
+        with self._lock:
+            candidates = [os.path.join(sdir, name)
+                          for _, name in reversed(self._snaps(sdir))]
+        for path in candidates:
+            try:
+                arrays, book = self._load_one(path)
+            except Exception as e:  # any defect: quarantine, fall back
+                self._quarantine(path, e)
+                continue
+            return SessionSnapshot(
+                session_id=str(session_id), path=path,
+                iteration=book["iteration"],
+                num_weight_updates=book["num_weight_updates"],
+                state=state_from_arrays(arrays), meta=book["meta"])
+        return None
+
+    # -- maintenance ---------------------------------------------------------
+
+    def sessions(self) -> list[str]:
+        try:
+            return sorted(d for d in os.listdir(self.root)
+                          if os.path.isdir(os.path.join(self.root, d)))
+        except OSError:
+            return []
+
+    def discard(self, session_id: str) -> None:
+        """Drop a finished session's snapshots (kept quarantined files are
+        dropped too — the session is over)."""
+        sdir = self._dir(session_id)
+        with self._lock:
+            try:
+                names = os.listdir(sdir)
+            except OSError:
+                return
+            for name in names:
+                try:
+                    os.remove(os.path.join(sdir, name))
+                except OSError:
+                    pass
+            try:
+                os.rmdir(sdir)
+            except OSError:
+                pass

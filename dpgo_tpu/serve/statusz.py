@@ -63,13 +63,17 @@ class MetricsSidecar:
                         ctype = PROMETHEUS_CONTENT_TYPE
                         code = 200
                     elif path == "/healthz":
-                        # status() reads the lifecycle flag under the
+                        # status() reads the lifecycle flags under the
                         # server lock — no bare cross-thread attribute
-                        # peeking from the scrape threads.
+                        # peeking from the scrape threads.  A draining
+                        # server still answers 200 (in-flight work is
+                        # finishing) but says so, so load balancers can
+                        # stop routing BEFORE the hard 503.
                         st = sidecar.server.status()
                         closed = st["closed"]
                         body = json.dumps(
                             {"ok": not closed,
+                             "draining": st.get("draining", False),
                              "uptime_s": st["uptime_s"],
                              "run": sidecar.run.run_id}).encode("utf-8")
                         ctype = "application/json"

@@ -569,3 +569,114 @@ def test_status_fetch_every_latches_rel_change():
     for a, b in zip(agents, ref_agents):
         np.testing.assert_allclose(np.asarray(a.X), np.asarray(b.X),
                                    rtol=0, atol=0)
+
+
+def test_revived_neighbor_sequence_reset_and_cache_invalidation():
+    """Lost/revive asymmetry fix: the FIRST frame from a revived neighbor
+    wins regardless of its sequence number (the robot may have restarted
+    its numbering), and the pre-outage cached poses are invalidated rather
+    than merged — a pose the fresh frame does not resupply reads as
+    missing, so the iterate skips instead of consuming stale state."""
+    agents, _, _ = make_agents(2, n=10, num_lc=6)
+    a0, a1 = agents
+    fresh = a0.get_shared_pose_dict()
+    assert len(fresh) >= 2  # the scenario needs a partial refill
+    keys = sorted(fresh)
+    a1.update_neighbor_poses(0, fresh, sequence=7)
+    for k in keys:
+        assert a1._nbr_lookup(k) is not None
+
+    a1.mark_neighbor_lost(0)
+    # Revival frame from a REBOOTED robot 0: lower sequence, and only one
+    # of the public poses on board.
+    partial = {keys[0]: np.ones_like(fresh[keys[0]])}
+    a1.update_neighbor_poses(0, partial, sequence=2)
+    assert a1.lost_neighbors == []
+    np.testing.assert_allclose(a1._nbr_lookup(keys[0]), 1.0)
+    for k in keys[1:]:
+        assert a1._nbr_lookup(k) is None  # invalidated, NOT merged
+    # The monotonic check resumes from the reset point.
+    a1.update_neighbor_poses(0, {keys[0]: np.zeros_like(fresh[keys[0]])},
+                             sequence=1)  # stale vs the reset seq 2
+    np.testing.assert_allclose(a1._nbr_lookup(keys[0]), 1.0)
+    a1.update_neighbor_poses(0, {keys[0]: np.zeros_like(fresh[keys[0]])},
+                             sequence=3)
+    np.testing.assert_allclose(a1._nbr_lookup(keys[0]), 0.0)
+
+
+def test_admit_neighbor_extends_quorum_and_problem():
+    """``admit_neighbor`` is the inverse of ``mark_neighbor_lost``: the
+    joiner EXTENDS the consensus test (a 2-robot fleet that was ready to
+    terminate is not ready once robot 2 joins until robot 2 is), and the
+    admitted shared edges grow the live problem in place (edge rows,
+    neighbor slots, public poses) with the iterate preserved."""
+    from dpgo_tpu.utils.partition import (agent_measurements as _am,
+                                          partition_contiguous as _pc)
+    from dpgo_tpu.utils.synthetic import make_measurements as _mm
+
+    rng = np.random.default_rng(3)
+    meas, _ = _mm(rng, n=18, d=3, num_lc=10, rot_noise=0.01,
+                  trans_noise=0.01)
+    part3 = _pc(meas, 3)
+
+    def drop_joiner(rid):
+        odo, priv, shared = _am(part3, rid)
+        touches = (np.asarray(shared.r1) == 2) | (np.asarray(shared.r2) == 2)
+        return (odo, priv, shared.select(~touches)), shared.select(touches)
+
+    params2 = AgentParams(d=3, r=5, num_robots=2, rel_change_tol=1e9)
+    agents = {rid: PGOAgent(rid, params2) for rid in (0, 1)}
+    agents[1].set_lifting_matrix(agents[0].get_lifting_matrix())
+    withheld = {}
+    for rid in (0, 1):
+        kept, withheld[rid] = drop_joiner(rid)
+        agents[rid].set_pose_graph(*kept)
+    for _ in range(2):
+        exchange(list(agents.values()))
+    for ag in agents.values():
+        ag.iterate(True)
+    exchange(list(agents.values()))
+    for ag in agents.values():
+        ag.iterate(True)
+    exchange(list(agents.values()))
+    assert agents[0].should_terminate()
+
+    e_before = {rid: int(agents[rid]._edges.i.shape[0]) for rid in (0, 1)}
+    X_before = {rid: np.asarray(agents[rid].X).copy() for rid in (0, 1)}
+    for rid in (0, 1):
+        added = agents[rid].admit_neighbor(2, withheld[rid])
+        assert added == len(withheld[rid])
+        assert agents[rid].num_robots == 3
+        assert int(agents[rid]._edges.i.shape[0]) == \
+            e_before[rid] + len(withheld[rid])
+        # the iterate survives the extension untouched
+        np.testing.assert_array_equal(np.asarray(agents[rid].X),
+                                      X_before[rid])
+    # Consensus must re-form around the larger fleet: not ready now.
+    assert not agents[0].should_terminate()
+
+    # Bring robot 2 up and run the full fleet to readiness again.
+    params3 = AgentParams(d=3, r=5, num_robots=3, rel_change_tol=1e9)
+    a2 = PGOAgent(2, params3)
+    a2.set_lifting_matrix(agents[0].get_lifting_matrix())
+    a2.set_pose_graph(*_am(part3, 2))
+    fleet = [agents[0], agents[1], a2]
+    for _ in range(3):
+        exchange(fleet)
+        for ag in fleet:
+            ag.iterate(True)
+    exchange(fleet)
+    assert agents[0].should_terminate()
+
+
+def test_admit_neighbor_rejects_unknown_own_poses():
+    import dataclasses as _dc
+
+    agents, _, _ = make_agents(2, n=10, num_lc=4)
+    a0 = agents[0]
+    bad = _dc.replace(
+        a0._meas.select([0]),
+        r1=np.asarray([0], np.int32), p1=np.asarray([a0.n + 3], np.int64),
+        r2=np.asarray([2], np.int32), p2=np.asarray([0], np.int64))
+    with pytest.raises(ValueError, match="own poses"):
+        a0.admit_neighbor(2, bad)

@@ -327,6 +327,229 @@ def test_chaos_overlap_staleness_converges_with_drops():
     assert cost_overlap == pytest.approx(cost_clean, rel=0.01)
 
 
+def _lockstep_fleet_round(agents, bus, clients, dead, on_merged=None):
+    """One lockstep publish -> relay -> collect/apply -> iterate round over
+    the live robots (the _run_fleet body, factored for drivers that mutate
+    the fleet between rounds)."""
+    for rid, ag in agents.items():
+        if rid in dead:
+            continue
+        clients[rid].publish(pack_agent_frame(ag, include_anchor=(rid == 0)),
+                             timeout=0.5)
+    bus.round()
+    for rid, ag in agents.items():
+        if rid in dead:
+            continue
+        merged = clients[rid].collect(timeout=0.3)
+        if merged is not None:
+            if on_merged is not None:
+                on_merged(rid, ag, clients[rid])
+            for peer, pf in clients[rid].peer_frames(merged).items():
+                apply_peer_frame(ag, peer, pf,
+                                 accept_anchor=(rid != 0 and peer == 0))
+            for lost in clients[rid].lost:
+                ag.mark_neighbor_lost(lost)
+        ag.iterate(True)
+
+
+def _new_loopback_robot(rid, injector=None):
+    """One extra loopback transport pair for a robot joining a live bus."""
+    from dpgo_tpu.comms import BusClient, ReliableChannel
+    from dpgo_tpu.comms.transport import LoopbackTransport
+
+    t_bus, t_robot = LoopbackTransport.pair("bus", f"robot{rid}",
+                                            injector=injector,
+                                            wire_format="packed")
+    hub_ch = ReliableChannel(t_bus, f"bus->robot{rid}", POLICY, origin=-1)
+    client = BusClient(ReliableChannel(t_robot, f"robot{rid}->bus", POLICY),
+                       rid)
+    return hub_ch, client
+
+
+def test_chaos_kill_and_join_mid_solve(tmp_path):
+    """ACCEPTANCE (elastic fleets): seeded run where robot 2 is KILLED and
+    a new robot 3 JOINS mid-solve, under a seeded 5% frame drop.  The
+    fleet must terminate, the survivors+joiner cost must land within 1% of
+    the fault-free all-robots run over the same edge set, and the joined
+    robot's activity must appear in the run's merged event record."""
+    n_robots = 4
+    joiner, join_at = 3, 15
+    kill = (2, 45)
+    rounds = 80
+    final_team = [0, 1, 3]
+
+    rng = np.random.default_rng(0)
+    meas, _ = make_measurements(rng, n=32, d=3, num_lc=16,
+                                rot_noise=0.01, trans_noise=0.01)
+    part = partition_contiguous(meas, n_robots)
+
+    def split_for(rid):
+        """(odometry, private, shared-without-joiner, shared-with-joiner)."""
+        odo, priv, shared = agent_measurements(part, rid)
+        touches = (np.asarray(shared.r1) == joiner) | \
+            (np.asarray(shared.r2) == joiner)
+        return odo, priv, shared.select(~touches), shared.select(touches)
+
+    # --- fault-free reference: all four robots from the start -------------
+    params4 = AgentParams(d=3, r=5, num_robots=n_robots)
+    clean = {rid: PGOAgent(rid, params4) for rid in range(n_robots)}
+    for rid in range(1, n_robots):
+        clean[rid].set_lifting_matrix(clean[0].get_lifting_matrix())
+    for rid, ag in clean.items():
+        ag.set_pose_graph(*agent_measurements(part, rid))
+    bus_c, clients_c = loopback_fleet(n_robots, policy=POLICY,
+                                      round_timeout_s=0.15, miss_limit=5,
+                                      liveness_timeout_s=0.5)
+    for _ in range(rounds):
+        _lockstep_fleet_round(clean, bus_c, clients_c, dead=set())
+    bus_c.close()
+    for c in clients_c.values():
+        c.close()
+    assert bus_c.lost == set()
+    cost_clean = _team_cost(clean, part, meas, final_team)
+
+    # --- chaos arm: start with 3 robots, join robot 3, kill robot 2 -------
+    injector = FaultInjector(FaultSpec(drop=0.05), seed=17)
+    params3 = AgentParams(d=3, r=5, num_robots=joiner)
+    agents = {rid: PGOAgent(rid, params3) for rid in range(joiner)}
+    for rid in range(1, joiner):
+        agents[rid].set_lifting_matrix(agents[0].get_lifting_matrix())
+    withheld = {}
+    for rid in range(joiner):
+        odo, priv, shared_kept, shared_joiner = split_for(rid)
+        withheld[rid] = shared_joiner
+        agents[rid].set_pose_graph(odo, priv, shared_kept)
+
+    with obs.run_scope(str(tmp_path / "join")):
+        bus, clients = loopback_fleet(joiner, injector=injector,
+                                      policy=POLICY, round_timeout_s=0.15,
+                                      miss_limit=50,
+                                      liveness_timeout_s=5.0)
+        for c in clients.values():
+            c.channel.start_heartbeat(0.05)
+        dead: set[int] = set()
+        admitted: dict[int, set] = {rid: set() for rid in range(joiner)}
+
+        def on_merged(rid, ag, client):
+            # The join handshake, survivor side: grow the problem with the
+            # withheld inter-robot edges the joiner brings.
+            for j in client.joined:
+                if j != rid and j not in admitted[rid]:
+                    ag.admit_neighbor(j, withheld.get(rid))
+                    admitted[rid].add(j)
+
+        for it in range(rounds):
+            if it == join_at:
+                # Joiner comes up: its own problem includes the shared
+                # edges to the survivors; the hub admits it via the
+                # hello handshake.
+                ag3 = PGOAgent(joiner, params4)
+                ag3.set_lifting_matrix(agents[0].get_lifting_matrix())
+                ag3.set_pose_graph(*agent_measurements(part, joiner))
+                hub_ch, cl3 = _new_loopback_robot(joiner, injector)
+                cl3.channel.start_heartbeat(0.05)
+                cl3.hello()
+                assert bus.admit_hello(hub_ch, timeout=1.0) == joiner
+                agents[joiner] = ag3
+                clients[joiner] = cl3
+                admitted[joiner] = set()
+            if it == kill[1]:
+                dead.add(kill[0])
+                clients[kill[0]].close()
+            _lockstep_fleet_round(agents, bus, clients, dead,
+                                  on_merged=on_merged)
+            if injector is not None:
+                time.sleep(PACE_S)
+        bus.close()
+        for rid, c in clients.items():
+            if rid not in dead:
+                c.close()
+
+    # The network actually dropped frames, deterministically.
+    assert injector.stats["dropped"] > 0
+    # The fleet knows who left and who arrived.
+    assert bus.lost == {kill[0]}
+    assert bus.joined == {joiner}
+    for rid in [0, 1]:
+        assert agents[rid].lost_neighbors == [kill[0]]
+        assert joiner in admitted[rid]
+        # quorum grew: the consensus test now spans the joiner too
+        assert agents[rid].num_robots == n_robots
+    # The joiner aligned into the global frame and took part.
+    assert agents[joiner].get_status().state == AgentState.INITIALIZED
+    assert agents[joiner].get_status().iteration_number >= \
+        (rounds - join_at) - 5
+
+    cost_chaos = _team_cost(agents, part, meas, final_team)
+    assert cost_chaos == pytest.approx(cost_clean, rel=0.01)
+
+    # The joined robot's activity is in the merged record: the bus + the
+    # survivors announced it, and its own lifecycle/iterate events landed.
+    evs = read_events(str(tmp_path / "join" / "events.jsonl"))
+    joined_evs = [e for e in evs if e["event"] == "peer_joined"]
+    assert {e.get("peer") for e in joined_evs} == {joiner}
+    assert any("robot" in e for e in joined_evs)  # agent-side admits
+    assert any(e["event"] == "agent_state" and e.get("robot") == joiner
+               and e.get("state") == "INITIALIZED" for e in evs)
+    assert any(e["event"] == "agent_iterate" and e.get("robot") == joiner
+               for e in evs)
+
+
+def test_chaos_partition_lost_then_healed_revives_with_fresh_state(tmp_path):
+    """Regression (lost/revive asymmetry): a partition long enough that
+    robot 1 IS declared lost; on heal the driver re-admits it and the
+    survivors' agents revive it off its first fresh frame — sequence reset,
+    stale cache invalidated, and the solve still converges to the
+    fault-free optimum."""
+    meas, part = _make_problem()
+    all_robots = [0, 1, 2]
+
+    clean_agents, _, _ = _run_fleet(part)
+    cost_clean = _team_cost(clean_agents, part, meas, all_robots)
+
+    spec = FaultSpec(partitions=(("robot1",),))
+    injector = FaultInjector(spec, seed=5)
+    injector.enabled = False
+
+    params = AgentParams(d=3, r=5, num_robots=NUM_ROBOTS)
+    agents = {rid: PGOAgent(rid, params) for rid in range(NUM_ROBOTS)}
+    for rid in range(1, NUM_ROBOTS):
+        agents[rid].set_lifting_matrix(agents[0].get_lifting_matrix())
+    for rid, ag in agents.items():
+        ag.set_pose_graph(*agent_measurements(part, rid))
+    with obs.run_scope(str(tmp_path / "heal")):
+        # Tight liveness so the outage DOES cross the dropout threshold.
+        bus, clients = loopback_fleet(
+            NUM_ROBOTS, injector=injector, policy=POLICY,
+            round_timeout_s=0.1, miss_limit=3, liveness_timeout_s=0.05)
+        lost_seen = False
+        for it in range(ROUNDS + 15):
+            injector.enabled = 20 <= it < 32  # the outage window
+            if it == 32:
+                # Heal: the hub re-admits the robot on its live channel
+                # (the rejoin handshake); its queued fresh frames flow
+                # again from the next round.
+                assert bus.lost == {1}  # the outage DID cross the threshold
+                bus.admit(1, bus.channels[1])
+            _lockstep_fleet_round(agents, bus, clients, dead=set())
+            if bus.lost == {1}:
+                lost_seen = True
+        bus.close()
+        for c in clients.values():
+            c.close()
+
+    assert lost_seen
+    assert bus.lost == set()
+    # Every survivor revived robot 1 (nobody still excludes it).
+    for rid in (0, 2):
+        assert agents[rid].lost_neighbors == []
+    evs = read_events(str(tmp_path / "heal" / "events.jsonl"))
+    assert any(e["event"] == "peer_revived" and e.get("peer") == 1
+               for e in evs)
+    cost = _team_cost(agents, part, meas, all_robots)
+    assert cost == pytest.approx(cost_clean, rel=0.01)
+
+
 def test_chaos_comms_layer_zero_obs_events_when_telemetry_off(monkeypatch):
     """The acceptance fence-throw: with telemetry off, the comms layer —
     channel traffic under faults, bus dropout, the agent's stale-drop and

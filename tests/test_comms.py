@@ -515,3 +515,78 @@ def test_transport_frame_cap_constructor_validation():
         LoopbackTransport.pair(max_frame_bytes=0)
     with pytest.raises(ValueError, match="positive"):
         LoopbackTransport.pair(max_frame_bytes=-1)
+
+
+def test_round_bus_admits_joiner_mid_run_and_broadcasts_joined(tmp_path):
+    """The join handshake: a robot admitted mid-run via ``admit_hello``
+    shows up in the relay from the next round, every client learns about
+    it through the cumulative ``_joined`` broadcast key, and the hub emits
+    a ``peer_joined`` event."""
+    from dpgo_tpu.comms import BusClient
+
+    with obs.run_scope(str(tmp_path / "join")):
+        bus, clients = _fleet(2)
+        for rid, c in clients.items():
+            c.publish({"v": np.asarray(rid)})
+        merged = bus.round()
+        assert "_joined" not in merged  # nothing joined yet
+        for c in clients.values():
+            c.collect(timeout=1.0)
+
+        t_bus, t_robot = LoopbackTransport.pair("bus", "robot2")
+        hub_ch = ReliableChannel(t_bus, origin=-1)
+        joiner = BusClient(ReliableChannel(t_robot, "robot2->bus", FAST), 2)
+        joiner.hello()
+        assert bus.admit_hello(hub_ch, timeout=1.0) == 2
+        assert bus.joined == set()  # effective at the next round
+
+        for rid, c in clients.items():
+            c.publish({"v": np.asarray(rid)})
+        joiner.publish({"v": np.asarray(2)})
+        merged = bus.round()
+        assert bus.joined == {2}
+        assert "r2|v" in merged
+        assert list(np.asarray(merged["_joined"])) == [2]
+        for rid, c in clients.items():
+            got = c.collect(timeout=1.0)
+            assert c.joined == {2}
+            assert set(c.peer_frames(got)) == {0, 1, 2} - {rid}
+        got = joiner.collect(timeout=1.0)
+        assert set(joiner.peer_frames(got)) == {0, 1}
+
+        evs_dir = str(tmp_path / "join" / "events.jsonl")
+        bus.close()
+        for c in clients.values():
+            c.close()
+        joiner.close()
+    evs = read_events(evs_dir)
+    assert any(e["event"] == "peer_joined" and e.get("peer") == 2
+               for e in evs)
+
+
+def test_round_bus_readmission_revives_lost_robot():
+    """Re-admitting a robot the hub declared lost clears its lost state
+    and resumes gathering from it (the partition-heal rejoin path)."""
+    bus, clients = _fleet(2)
+    for rid, c in clients.items():
+        c.publish({"v": np.asarray(rid)})
+    bus.round()
+    clients[1].close()
+    clients[0].publish({"v": np.asarray(0)})
+    bus.round()
+    assert bus.lost == {1}
+
+    from dpgo_tpu.comms import BusClient
+
+    t_bus, t_robot = LoopbackTransport.pair("bus", "robot1")
+    revived = BusClient(ReliableChannel(t_robot, "robot1->bus", FAST), 1)
+    bus.admit(1, ReliableChannel(t_bus, origin=-1))
+    clients[0].publish({"v": np.asarray(0)})
+    revived.publish({"v": np.asarray(111)})
+    merged = bus.round()
+    assert bus.lost == set()
+    assert int(np.asarray(merged["r1|v"])) == 111
+    assert "_joined" in merged and list(np.asarray(merged["_joined"])) == [1]
+    bus.close()
+    clients[0].close()
+    revived.close()

@@ -1,0 +1,233 @@
+"""Live-session layer (``models.incremental``): streamed edge deltas into
+the padded bucket layout, warm restarts from exact state, and the
+fingerprint/executable-reuse contract."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpgo_tpu.config import AgentParams, Schedule
+from dpgo_tpu.models import rbcd
+from dpgo_tpu.models.incremental import (LiveProblem, state_from_arrays,
+                                         state_to_arrays)
+from dpgo_tpu.serve.bucketing import pad_problem
+from dpgo_tpu.types import edge_set_from_measurements, loop_closure_mask
+from dpgo_tpu.utils.synthetic import make_measurements
+
+
+def _split_stream(seed=0, n=30, num_lc=14, hold=3, noise=0.02):
+    """A synthetic problem with ``hold`` loop closures withheld as the
+    stream (num_poses pinned so the pose set is identical)."""
+    rng = np.random.default_rng(seed)
+    meas, _ = make_measurements(rng, n=n, d=3, num_lc=num_lc,
+                                rot_noise=noise, trans_noise=noise)
+    lc_idx = np.nonzero(loop_closure_mask(meas))[0]
+    keep = np.ones(len(meas), bool)
+    keep[lc_idx[-hold:]] = False
+    base = dataclasses.replace(meas.select(keep), num_poses=meas.num_poses)
+    extra = dataclasses.replace(meas.select(~keep), num_poses=meas.num_poses)
+    return meas, base, extra
+
+
+PARAMS = AgentParams(d=3, r=5, num_robots=3, rel_change_tol=0.0)
+
+
+def _central(graph, part, num_meas):
+    return rbcd._make_central_metrics(
+        graph, edge_set_from_measurements(part.meas_global,
+                                          dtype=jnp.float64),
+        part.meas_global.num_poses, num_meas, telemetry=False)
+
+
+def test_delta_append_matches_full_rebuild_exactly():
+    """The masked-append graph must evaluate the SAME objective as a full
+    rebuild padded to the same bucket: identical cost and gradient norm at
+    an arbitrary iterate (row order differs, the math must not)."""
+    meas, base, extra = _split_stream()
+    live = LiveProblem(base, 3, params=PARAMS, dtype=jnp.float64)
+    res0 = live.solve(max_iters=40, grad_norm_tol=1e-6)
+
+    d = live.apply_edges(extra)
+    assert d.mode == "delta" and not d.recompiles
+
+    full = rbcd.prepare_problem(meas, 3, params=PARAMS, dtype=jnp.float64,
+                                init=None, pallas_sel=False)
+    ref = pad_problem(full, live.shape)
+    A = 3
+    ready = jnp.zeros((A,), bool)
+    rel = jnp.zeros((A,))
+    w_live = jnp.ones_like(live.padded.graph.edges.weight)
+    w_ref = jnp.ones_like(ref.graph.edges.weight)
+    v1 = np.asarray(_central(live.padded.graph, live.part, len(meas))(
+        res0.state.X, w_live, ready, jnp.asarray(0.1), rel))
+    v2 = np.asarray(_central(ref.graph, full.part, len(meas))(
+        res0.state.X, w_ref, ready, jnp.asarray(0.1), rel))
+    np.testing.assert_allclose(v1[:2], v2[:2], rtol=1e-12, atol=1e-12)
+
+
+def test_delta_keeps_bucket_and_meta_stable():
+    """Executable-reuse contract: a fitting delta leaves the bucket shape
+    AND the padded GraphMeta (the jit static argument every compiled
+    segment program is keyed on) untouched; a stream too large for the
+    padding re-buckets with an honest ``recompiles`` flag."""
+    meas, base, extra = _split_stream()
+    live = LiveProblem(base, 3, params=PARAMS, dtype=jnp.float64)
+    shape0, meta0 = live.shape, live.padded.meta
+    d = live.apply_edges(extra)
+    assert d.mode == "delta"
+    assert live.shape == shape0
+    assert live.padded.meta == meta0  # same static arg -> jit cache hit
+
+    # A burst far past the edge headroom must grow the bucket.
+    n = meas.num_poses
+    burst, _ = make_measurements(np.random.default_rng(5), n=n, d=3,
+                                 num_lc=80, rot_noise=0.01,
+                                 trans_noise=0.01)
+    lc = loop_closure_mask(burst)
+    burst = dataclasses.replace(burst.select(lc), num_poses=n)
+    d2 = live.apply_edges(burst)
+    assert d2.mode == "rebucket" and d2.recompiles
+    assert live.shape != shape0
+    assert len(live.meas) == len(meas) + len(burst)
+
+
+def test_delta_new_shared_edge_grows_slots_and_publics():
+    """A streamed CROSS-robot edge between poses that were never shared
+    exercises the slot/public append path; the graph must still match a
+    full rebuild."""
+    rng = np.random.default_rng(3)
+    meas, _ = make_measurements(rng, n=30, d=3, num_lc=6, rot_noise=0.01,
+                                trans_noise=0.01)
+    live = LiveProblem(meas, 3, params=PARAMS, dtype=jnp.float64)
+    s_used_before = int(np.asarray(live.padded.graph.nbr_mask).sum())
+    # poses 2 (robot 0) and 27 (robot 2): interior poses, certainly not
+    # shared by the odometry chain + few LCs above.
+    new = dataclasses.replace(
+        meas.select(np.zeros(len(meas), bool)), num_poses=meas.num_poses)
+    new = dataclasses.replace(
+        new,
+        r1=np.zeros(1, np.int32), p1=np.asarray([2], np.int64),
+        r2=np.zeros(1, np.int32), p2=np.asarray([27], np.int64),
+        R=np.eye(3)[None], t=np.zeros((1, 3)),
+        kappa=np.asarray([100.0]), tau=np.asarray([10.0]),
+        weight=np.ones(1), is_known_inlier=np.zeros(1, bool))
+    npr = meas.num_poses // 3
+    expected = int((2, 27 - 2 * npr) not in live._slot_of[0]) + \
+        int((0, 2) not in live._slot_of[2])
+    assert expected >= 1  # the edge genuinely grows at least one table
+    d = live.apply_edges(new)
+    assert d.mode == "delta"
+    s_used_after = int(np.asarray(live.padded.graph.nbr_mask).sum())
+    assert s_used_after == s_used_before + expected
+
+    cat = live.meas
+    full = rbcd.prepare_problem(cat, 3, params=PARAMS, dtype=jnp.float64,
+                                init=None, pallas_sel=False)
+    ref = pad_problem(full, live.shape)
+    X = ref.X0
+    ready = jnp.zeros((3,), bool)
+    rel = jnp.zeros((3,))
+    v1 = np.asarray(_central(live.padded.graph, live.part, len(cat))(
+        X, jnp.ones_like(live.padded.graph.edges.weight), ready,
+        jnp.asarray(0.1), rel))
+    v2 = np.asarray(_central(ref.graph, full.part, len(cat))(
+        X, jnp.ones_like(ref.graph.edges.weight), ready,
+        jnp.asarray(0.1), rel))
+    np.testing.assert_allclose(v1[:2], v2[:2], rtol=1e-12, atol=1e-12)
+
+
+def test_warm_dispatch_reaches_cold_cost():
+    """The streaming acceptance contract: after +edges, the warm restart
+    converges to the SAME final cost as a cold re-solve (rel <= 1e-6) —
+    both run to the block fixed point."""
+    meas, base, extra = _split_stream(seed=1, n=40, num_lc=18, hold=2)
+    live = LiveProblem(base, 3, params=PARAMS, dtype=jnp.float64)
+    res0 = live.solve(max_iters=300, grad_norm_tol=1e-9, eval_every=2)
+
+    cold = LiveProblem(meas, 3, params=PARAMS, dtype=jnp.float64)
+    resc = cold.solve(max_iters=300, grad_norm_tol=1e-9, eval_every=2)
+    resw = live.warm_dispatch(res0, new_edges=extra, max_iters=300,
+                              grad_norm_tol=1e-9, eval_every=2)
+    rel = abs(resw.cost_history[-1] - resc.cost_history[-1]) / \
+        max(1.0, abs(resc.cost_history[-1]))
+    assert rel <= 1e-6, (resw.cost_history[-1], resc.cost_history[-1])
+
+
+def test_warm_dispatch_without_delta_terminates_immediately():
+    """Resuming a converged state on the unchanged problem must terminate
+    at once with the identical cost — the exact-state contract."""
+    meas, base, _ = _split_stream(seed=2)
+    live = LiveProblem(base, 3, params=PARAMS, dtype=jnp.float64)
+    res0 = live.solve(max_iters=300, grad_norm_tol=1e-9, eval_every=2)
+    resw = live.warm_dispatch(res0, max_iters=300, grad_norm_tol=1e-9,
+                              eval_every=2)
+    assert resw.iterations <= 4
+    assert resw.cost_history[-1] == res0.cost_history[-1]
+
+
+def test_warm_dispatch_remaps_gnc_weights():
+    """Carried GNC weights follow their measurements onto the new rows:
+    an edge down-weighted before the delta stays down-weighted after."""
+    meas, base, extra = _split_stream(seed=4)
+    live = LiveProblem(base, 3, params=PARAMS, dtype=jnp.float64)
+    res0 = live.solve(max_iters=20, grad_norm_tol=1e-6)
+    st = res0.state
+    # Manually zero one loop closure's weight (as a GNC anneal would).
+    g = live.padded.graph
+    meas_id = np.asarray(g.meas_id)
+    is_lc = np.asarray(g.edges.is_lc) > 0
+    mask = np.asarray(g.edges.mask) > 0
+    a, e = map(int, np.argwhere(is_lc & mask)[0])
+    victim = int(meas_id[a, e])
+    w = np.asarray(st.weights).copy()
+    w[(meas_id == victim) & mask] = 0.125
+    st = st._replace(weights=jnp.asarray(w))
+
+    live.apply_edges(extra)
+    adapted = live._adapt_state(st, (meas_id, np.asarray(g.edges.mask),
+                                     len(base)))
+    w2 = np.asarray(adapted.weights)
+    id2 = np.asarray(live.padded.graph.meas_id)
+    m2 = np.asarray(live.padded.graph.edges.mask) > 0
+    rows = (id2 == victim) & m2
+    assert rows.any()
+    np.testing.assert_allclose(w2[rows], 0.125)
+    # streamed edges start at their measurement weight (1 here)
+    fresh = (id2 >= len(base)) & m2
+    assert fresh.any()
+    np.testing.assert_allclose(w2[fresh], 1.0)
+
+
+def test_new_poses_are_rejected():
+    meas, base, _ = _split_stream()
+    live = LiveProblem(base, 3, params=PARAMS, dtype=jnp.float64)
+    bad = dataclasses.replace(base.select([0]),
+                              num_poses=base.num_poses + 1,
+                              p2=np.asarray([base.num_poses]))
+    with pytest.raises(ValueError, match="NEW poses"):
+        live.apply_edges(bad)
+
+
+def test_colored_schedule_falls_back_to_rebuild():
+    """COLORED's agent coloring can be invalidated by a new shared edge;
+    the delta path must decline and the rebuild recolor."""
+    meas, base, extra = _split_stream()
+    params = dataclasses.replace(PARAMS, schedule=Schedule.COLORED)
+    live = LiveProblem(base, 3, params=params, dtype=jnp.float64)
+    d = live.apply_edges(extra)
+    assert d.mode in ("repad", "rebucket")
+
+
+def test_state_codec_round_trip():
+    meas, base, _ = _split_stream()
+    live = LiveProblem(base, 3, params=PARAMS, dtype=jnp.float64)
+    res = live.solve(max_iters=10, grad_norm_tol=1e-6)
+    arrays = state_to_arrays(res.state)
+    back = state_from_arrays(arrays)
+    for f in ("X", "weights", "key", "rel_change", "ready", "gamma",
+              "alpha", "mu", "iteration"):
+        np.testing.assert_array_equal(np.asarray(getattr(back, f)),
+                                      np.asarray(getattr(res.state, f)))
+    assert back.chol is None and back.Qbuf is None

@@ -1,0 +1,292 @@
+"""Session durability (``serve.session``) and server elasticity: snapshot
+robustness (truncation / bit flips / wrong schema -> quarantine fallback,
+never a crash), the worker crash-recovery path, and graceful drain.
+
+The crash-recovery acceptance test deliberately carries NO ``allow_leaks``
+marker: the leakcheck plugin asserting zero orphan threads/sockets after a
+mid-batch worker kill + recovery IS part of the contract."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpgo_tpu import obs
+from dpgo_tpu.config import AgentParams
+from dpgo_tpu.models.incremental import state_to_arrays
+from dpgo_tpu.serve import (OverCapacityError, SessionStore, SolveRequest,
+                            SolveServer)
+from dpgo_tpu.serve import server as server_mod
+from dpgo_tpu.serve.session import SESSION_SCHEMA_VERSION
+from dpgo_tpu.utils.synthetic import make_measurements
+
+PARAMS = AgentParams(d=3, r=5, num_robots=2)
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_run():
+    obs.end_run()
+    yield
+    obs.end_run()
+
+
+def _problem(seed=0, n=24):
+    rng = np.random.default_rng(seed)
+    meas, _ = make_measurements(rng, n=n, d=3, num_lc=8, rot_noise=0.01,
+                                trans_noise=0.01)
+    return meas
+
+
+def _solved_state(meas):
+    from dpgo_tpu.models.incremental import LiveProblem
+
+    live = LiveProblem(meas, 2, params=PARAMS)
+    res = live.solve(max_iters=6, grad_norm_tol=1e-9)
+    return res.state
+
+
+# ---------------------------------------------------------------------------
+# SessionStore robustness (satellite: corrupt snapshots must quarantine)
+# ---------------------------------------------------------------------------
+
+def test_store_round_trip_and_prune(tmp_path):
+    meas = _problem()
+    st = _solved_state(meas)
+    store = SessionStore(str(tmp_path / "s"), keep=2)
+    for it in (10, 20, 30):
+        store.save("sess", st, iteration=it, meta={"tenant": "t"})
+    sdir = tmp_path / "s" / "sess"
+    names = sorted(p.name for p in sdir.iterdir())
+    assert names == ["snap-00000020.npz", "snap-00000030.npz"]  # pruned
+    snap = store.load_newest("sess")
+    assert snap.iteration == 30 and snap.meta == {"tenant": "t"}
+    for f, v in state_to_arrays(st).items():
+        np.testing.assert_array_equal(np.asarray(getattr(snap.state, f)), v)
+    store.discard("sess")
+    assert store.load_newest("sess") is None
+    assert not sdir.exists()
+
+
+@pytest.mark.parametrize("corrupt", ["truncate", "bitflip", "schema"])
+def test_corrupt_newest_falls_back_to_previous(tmp_path, corrupt):
+    """Truncated / bit-flipped / wrong-schema newest snapshot: quarantined
+    aside, the previous one loads; no exception escapes."""
+    meas = _problem()
+    st = _solved_state(meas)
+    store = SessionStore(str(tmp_path / "s"), keep=3)
+    store.save("sess", st, iteration=10)
+    if corrupt == "schema":
+        arrays = state_to_arrays(st)
+        arrays["__schema__"] = np.asarray(SESSION_SCHEMA_VERSION + 7)
+        arrays["__iteration__"] = np.asarray(20)
+        arrays["__nwu__"] = np.asarray(0)
+        path = tmp_path / "s" / "sess" / "snap-00000020.npz"
+        with open(path, "wb") as fh:
+            np.savez_compressed(fh, **arrays)
+    else:
+        store.save("sess", st, iteration=20)
+        path = tmp_path / "s" / "sess" / "snap-00000020.npz"
+        blob = bytearray(path.read_bytes())
+        if corrupt == "truncate":
+            path.write_bytes(bytes(blob[: len(blob) // 3]))
+        else:
+            blob[len(blob) // 2] ^= 0xFF  # flip bits mid-zip-stream
+            path.write_bytes(bytes(blob))
+    snap = store.load_newest("sess")
+    assert snap is not None and snap.iteration == 10
+    names = sorted(p.name for p in (tmp_path / "s" / "sess").iterdir())
+    assert "snap-00000020.npz.quarantined" in names
+    assert "snap-00000020.npz" not in names
+    # quarantined files are never retried
+    assert store.load_newest("sess").iteration == 10
+
+
+def test_all_snapshots_corrupt_yields_none(tmp_path):
+    meas = _problem()
+    st = _solved_state(meas)
+    store = SessionStore(str(tmp_path / "s"))
+    store.save("sess", st, iteration=10)
+    p = tmp_path / "s" / "sess" / "snap-00000010.npz"
+    p.write_bytes(b"not a zip at all")
+    assert store.load_newest("sess") is None
+
+
+def test_session_id_sanitization(tmp_path):
+    store = SessionStore(str(tmp_path / "s"))
+    meas = _problem()
+    st = _solved_state(meas)
+    store.save("tenant/../../evil", st, iteration=1)
+    (entry,) = (tmp_path / "s").iterdir()
+    # no path separators survive: the session dir sits directly under the
+    # store root, whatever the id contained
+    assert "/" not in entry.name and "\\" not in entry.name
+    assert entry.parent == tmp_path / "s"
+    assert store.load_newest("tenant/../../evil").iteration == 1
+
+
+# ---------------------------------------------------------------------------
+# Crash recovery (ACCEPTANCE) — no allow_leaks: leakcheck must stay clean
+# ---------------------------------------------------------------------------
+
+class _WorkerKilled(BaseException):
+    """Escapes ``_run_batch``'s Exception handling — the in-test stand-in
+    for a mid-batch worker death (TaskStop, OOM-killer, fatal runtime)."""
+
+
+def test_worker_killed_mid_batch_recovers_from_snapshot(tmp_path,
+                                                        monkeypatch):
+    """ACCEPTANCE: the worker dies mid-batch after a session snapshot
+    landed; the supervisor respawns, re-admits the request from the
+    snapshot, the reply completes with ``recovered=True``,
+    ``session_recoveries_total`` increments — and the leakcheck plugin
+    (active, no opt-out) sees no orphan threads/sockets."""
+    meas = _problem()
+    real_run_bucket = server_mod.run_bucket
+    calls = {"n": 0}
+
+    def killer(padded, cache, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            # Real work first so a boundary snapshot lands, then die the
+            # way a killed worker does: nothing catches BaseException on
+            # the batch path.
+            real_run_bucket(padded, cache, max_iters=4,
+                            grad_norm_tol=kw["grad_norm_tol"],
+                            eval_every=kw["eval_every"],
+                            session_cb=kw["session_cb"], session_every=1)
+            raise _WorkerKilled("killed mid-batch")
+        return real_run_bucket(padded, cache, **kw)
+
+    monkeypatch.setattr(server_mod, "run_bucket", killer)
+    with obs.run_scope(str(tmp_path / "run")) as run:
+        store = SessionStore(str(tmp_path / "sessions"))
+        with SolveServer(max_batch=2, batch_window_s=0.0,
+                         session_store=store) as srv:
+            t = srv.submit(SolveRequest(
+                meas=meas, num_robots=2, params=PARAMS, max_iters=40,
+                grad_norm_tol=1e-3, session_id="tenant-a-42"))
+            res = t.result(timeout=300)
+            assert res.recovered is True
+            assert calls["n"] == 2  # died once, completed on respawn
+            assert srv.status()["worker_crashes"] == 1
+        snap = run.registry.snapshot()
+    families = [v for k, v in snap.items()
+                if "session_recoveries_total" in k]
+    assert families and families[0]["series"][0]["value"] == 1.0
+    # the finished session's snapshots were discarded
+    assert store.load_newest("tenant-a-42") is None
+
+
+def test_worker_kill_without_session_fails_cleanly(monkeypatch, tmp_path):
+    """No session id -> nothing to recover: the request fails with a
+    clear error, the server stays alive for the next request."""
+    meas = _problem()
+    real_run_bucket = server_mod.run_bucket
+    calls = {"n": 0}
+
+    def killer(padded, cache, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise _WorkerKilled("killed")
+        return real_run_bucket(padded, cache, **kw)
+
+    monkeypatch.setattr(server_mod, "run_bucket", killer)
+    with SolveServer(max_batch=2, batch_window_s=0.0,
+                     session_store=SessionStore(str(tmp_path))) as srv:
+        t = srv.submit(SolveRequest(meas=meas, num_robots=2, params=PARAMS,
+                                    max_iters=10, grad_norm_tol=1e-3))
+        with pytest.raises(RuntimeError, match="died mid-batch"):
+            t.result(timeout=300)
+        # the respawned worker serves the next request normally
+        t2 = srv.submit(SolveRequest(meas=meas, num_robots=2, params=PARAMS,
+                                     max_iters=10, grad_norm_tol=1e-3))
+        assert t2.result(timeout=300).recovered is False
+
+
+def test_crash_loop_gives_up_and_sheds(monkeypatch, tmp_path):
+    meas = _problem()
+
+    def always_dies(padded, cache, **kw):
+        raise _WorkerKilled("again")
+
+    monkeypatch.setattr(server_mod, "run_bucket", always_dies)
+    srv = SolveServer(max_batch=2, batch_window_s=0.0, worker_restarts=0)
+    try:
+        t = srv.submit(SolveRequest(meas=meas, num_robots=2, params=PARAMS,
+                                    max_iters=10))
+        with pytest.raises((OverCapacityError, RuntimeError)):
+            t.result(timeout=300)
+        deadline = time.monotonic() + 30
+        while srv._worker.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not srv._worker.is_alive()  # gave up: no crash-looping
+        with pytest.raises(RuntimeError, match="closed"):
+            srv.submit(SolveRequest(meas=meas, num_robots=2, params=PARAMS))
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain (satellite)
+# ---------------------------------------------------------------------------
+
+def test_close_drain_stops_admission_and_reports(tmp_path, monkeypatch):
+    """close(drain=True): in-flight batch finishes and replies; admission
+    during the drain is a STRUCTURED shed (reason=closed); /healthz says
+    draining (200) for the window and 503 only once closed."""
+    meas = _problem()
+    gate = threading.Event()
+    release = threading.Event()
+    real_run_bucket = server_mod.run_bucket
+
+    def slow(padded, cache, **kw):
+        gate.set()
+        assert release.wait(60)
+        return real_run_bucket(padded, cache, **kw)
+
+    monkeypatch.setattr(server_mod, "run_bucket", slow)
+    with obs.run_scope(str(tmp_path / "run")):
+        srv = SolveServer(max_batch=1, batch_window_s=0.0, metrics_port=0)
+        base = f"http://{srv.sidecar.host}:{srv.sidecar.port}"
+        t1 = srv.submit(SolveRequest(meas=meas, num_robots=2, params=PARAMS,
+                                     max_iters=6, grad_norm_tol=1e-3))
+        assert gate.wait(60)  # batch in flight and parked
+
+        closer = threading.Thread(target=lambda: srv.close(drain=True))
+        closer.start()
+        deadline = time.monotonic() + 10
+        while not srv.status()["draining"] and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.status()["draining"] is True
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+            body = json.loads(r.read())
+            assert r.status == 200 and body["draining"] is True
+
+        # admission during drain: structured shed, not a bare error
+        with pytest.raises(OverCapacityError) as exc:
+            srv.submit(SolveRequest(meas=meas, num_robots=2, params=PARAMS))
+        assert exc.value.reason == "closed"
+
+        release.set()
+        closer.join(timeout=120)
+        assert not closer.is_alive()
+        assert t1.result(timeout=60).iterations >= 1  # in-flight completed
+        st = srv.status()
+        assert st["closed"] is True and st["draining"] is False
+        # Once closed, /healthz is 503 for as long as the sidecar still
+        # answers, then the endpoint disappears with it — either way the
+        # 200/draining phase is over.
+        try:
+            with urllib.request.urlopen(base + "/healthz",
+                                        timeout=5) as r:
+                raise AssertionError(f"healthz still ok after close: "
+                                     f"{r.status}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            e.close()
+        except urllib.error.URLError:
+            pass  # sidecar already down

@@ -58,6 +58,7 @@ def project_config() -> Config:
             # the compiled solver/serving programs.
             "DPG001": [
                 "dpgo_tpu/models/rbcd.py",
+                "dpgo_tpu/models/incremental.py",
                 "dpgo_tpu/serve/runner.py",
                 "dpgo_tpu/parallel/sharded.py",
             ],
@@ -70,6 +71,7 @@ def project_config() -> Config:
             # DPG003: host-sync hazards in the solver/serving hot loops.
             "DPG003": [
                 "dpgo_tpu/models/rbcd.py",
+                "dpgo_tpu/models/incremental.py",
                 "dpgo_tpu/serve/runner.py",
             ],
             # DPG004 is annotation-driven (# guarded-by) — run everywhere;
@@ -123,6 +125,15 @@ def project_config() -> Config:
                     "dpgo_tpu/serve/runner.py": {
                         "hot_functions": ["run_bucket"],
                         "sync_calls": ["_host_fetch"],
+                    },
+                    # The live-session layer (ISSUE 10): delta application
+                    # and the warm-restart dispatch are host-side by
+                    # design, but they sit on the serving worker's request
+                    # path — a device sync creeping into their loops would
+                    # stall every batch behind a stream.
+                    "dpgo_tpu/models/incremental.py": {
+                        "hot_functions": ["apply_edges", "_try_delta",
+                                          "warm_dispatch", "_adapt_state"],
                     },
                 },
             },
