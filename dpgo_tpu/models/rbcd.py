@@ -365,6 +365,34 @@ def _ppermute_exchange(Xl: jax.Array, graph: MultiAgentGraph,
     return Z * graph.nbr_mask[:, :, None, None]
 
 
+def _exchange_for(graph: MultiAgentGraph, A_tot: int, axis_name,
+                  plan: PPermutePlan | None, shifts: tuple):
+    """The pose-exchange closure of a round: neighbor buffer resolved from
+    the all-gathered public table (v1), or the shift-based ppermute route
+    when a ``plan`` is given; plain gathers with ``axis_name=None``.
+
+    Factored out of ``_rbcd_round`` so the overlapped fused loop
+    (``_rbcd_rounds(overlap=True)``) can issue the NEXT round's exchange
+    outside the round body — the halo/compute-overlap restructure of the
+    sharded plane."""
+    if axis_name is None:
+        if plan is not None:
+            raise ValueError("ppermute exchange requires a mesh axis_name")
+        gather = lambda t: t
+    else:
+        gather = lambda t: jax.lax.all_gather(t, axis_name, axis=0,
+                                              tiled=True)
+    if plan is None:
+        return lambda Xl: neighbor_buffer(gather(public_table(Xl, graph)),
+                                          graph)
+
+    def exchange(Xl):
+        n_dev = A_tot // Xl.shape[0]
+        return _ppermute_exchange(Xl, graph, plan, shifts, axis_name, n_dev)
+
+    return exchange
+
+
 # ---------------------------------------------------------------------------
 # The jitted step
 # ---------------------------------------------------------------------------
@@ -774,7 +802,8 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
                 params: AgentParams, axis_name: str | None = None,
                 update_weights: bool = False, restart: bool = False,
                 plan: PPermutePlan | None = None,
-                shifts: tuple = ()) -> RBCDState:
+                shifts: tuple = (), halo: jax.Array | None = None,
+                return_halo: bool = False):
     """One synchronous RBCD round over the agents held by this device.
 
     Communication happens once per round: the public-pose table is built
@@ -808,6 +837,15 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
     near-chain; a random partition can need up to ``n_dev - 1`` shifts —
     all_gather volume).  The greedy schedule's argmax still all_gathers its
     [A] gradient-norm vector (negligible payload).
+
+    ``halo`` (plain rounds only — incompatible with ``update_weights``,
+    whose warm-start-off path resets X and must re-exchange) supplies the
+    neighbor buffer of the CURRENT iterate precomputed by the caller, and
+    ``return_halo`` makes the round also return the NEXT round's exchange
+    ``exchange(X_next)``, issued right after the Stiefel update so the
+    collective is in flight while the trailing status/momentum math runs —
+    the software-pipelined halo of ``_rbcd_rounds(overlap=True)``.  Same
+    values either way: the halo of round k is always ``exchange(X_k)``.
     """
     if params.acceleration and state.V is None:
         raise ValueError(
@@ -839,18 +877,16 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
         agent_ids = jax.lax.axis_index(axis_name) * A_loc + jnp.arange(A_loc)
         gather = lambda t: jax.lax.all_gather(t, axis_name, axis=0, tiled=True)
 
-    if plan is None:
-        exchange = lambda Xl: neighbor_buffer(gather(public_table(Xl, graph)),
-                                              graph)
-    else:
-        n_dev = A_tot // A_loc
-        exchange = lambda Xl: _ppermute_exchange(Xl, graph, plan, shifts,
-                                                 axis_name, n_dev)
+    exchange = _exchange_for(graph, A_tot, axis_name, plan, shifts)
+    if halo is not None and update_weights:
+        raise ValueError(
+            "a precomputed halo cannot serve a weight-update round: the "
+            "warm-start-off path resets X and must re-exchange")
 
     # Regular neighbor buffer (from X) — needed always when un-accelerated,
     # and on weight-update / restart rounds when accelerated.
     need_regular = (not accel) or restart or update_weights
-    Z = exchange(X) if need_regular else None
+    Z = (halo if halo is not None else exchange(X)) if need_regular else None
 
     # --- GNC weight update (before the pose update, reference iterate()
     # PGOAgent.cpp:654-668) ---
@@ -1061,24 +1097,32 @@ def _rbcd_round(state: RBCDState, graph: MultiAgentGraph, meta: GraphMeta,
         rel = jnp.where(fired, rel_new, state.rel_change)
         ready = jnp.where(fired, ready_new, state.ready)
 
-    return RBCDState(X=X_next, weights=weights,
-                     iteration=state.iteration + 1, key=key,
-                     rel_change=rel, ready=ready,
-                     V=V, gamma=gamma, alpha=alpha, mu=mu,
-                     X_init=state.X_init, chol=chol, Qbuf=qbuf)
+    new_state = RBCDState(X=X_next, weights=weights,
+                          iteration=state.iteration + 1, key=key,
+                          rel_change=rel, ready=ready,
+                          V=V, gamma=gamma, alpha=alpha, mu=mu,
+                          X_init=state.X_init, chol=chol, Qbuf=qbuf)
+    if not return_halo:
+        return new_state
+    # Next round's halo, issued here — after the Stiefel update, before
+    # the caller's loop re-enters — so the interconnect collective can
+    # overlap the status/momentum math above (its result feeds nothing in
+    # this round) and whatever pre-solve work the next round does first.
+    return new_state, exchange(X_next)
 
 
 #: Jitted RBCD round. Single-device over all agents with the default
 #: ``axis_name=None``; the sharded path re-wraps ``_rbcd_round`` in shard_map.
 rbcd_step = jax.jit(_rbcd_round, static_argnames=(
-    "meta", "params", "axis_name", "update_weights", "restart", "shifts"))
+    "meta", "params", "axis_name", "update_weights", "restart", "shifts",
+    "return_halo"))
 
 
 def _rbcd_rounds(state: RBCDState, graph: MultiAgentGraph, num_rounds,
                  meta: GraphMeta, params: AgentParams,
                  axis_name: str | None = None,
                  plan: PPermutePlan | None = None,
-                 shifts: tuple = ()) -> RBCDState:
+                 shifts: tuple = (), overlap: bool = False) -> RBCDState:
     """``num_rounds`` consecutive *plain* rounds (no weight update, no
     restart) as one on-device ``fori_loop``.
 
@@ -1088,7 +1132,33 @@ def _rbcd_rounds(state: RBCDState, graph: MultiAgentGraph, num_rounds,
     segment on-device — one dispatch per segment, identical math (the body
     is ``_rbcd_round`` itself, so single-round and fused traces agree).
     ``num_rounds`` is a traced scalar: one compile serves every segment
-    length."""
+    length.
+
+    ``overlap`` (mesh path, un-accelerated schedules) software-pipelines
+    the halo: the loop carries each round's neighbor buffer, computed as
+    ``exchange(X_k)`` at the END of round k-1 instead of at the top of
+    round k — so the interconnect collective for the next round's halo is
+    in flight while round k-1's trailing status math (and round k's
+    pre-solve bookkeeping) execute, instead of gating the whole round.
+    Identical values round for round (the halo of round k is always the
+    exchange of X_k); costs one extra exchange per fused call (the
+    prologue).  Accelerated schedules exchange the momentum point Ynes
+    in-round (it depends on the just-advanced gamma) and their plain
+    rounds never read the X-halo, so they take the unpipelined loop."""
+    accel = params.acceleration and state.V is not None
+    if overlap and axis_name is not None and not accel:
+        exchange = _exchange_for(graph, meta.num_robots, axis_name, plan,
+                                 shifts)
+
+        def body(_i, carry):
+            s, Z = carry
+            return _rbcd_round(s, graph, meta, params, axis_name=axis_name,
+                               plan=plan, shifts=shifts, halo=Z,
+                               return_halo=True)
+
+        state, _ = jax.lax.fori_loop(0, num_rounds, body,
+                                     (state, exchange(state.X)))
+        return state
     body = lambda _i, s: _rbcd_round(s, graph, meta, params,
                                      axis_name=axis_name, plan=plan,
                                      shifts=shifts)
@@ -1098,7 +1168,7 @@ def _rbcd_rounds(state: RBCDState, graph: MultiAgentGraph, num_rounds,
 #: Jitted fused rounds (single-device; ``parallel.make_sharded_multi_step``
 #: embeds the same loop inside shard_map for the mesh path).
 rbcd_steps = jax.jit(_rbcd_rounds, static_argnames=(
-    "meta", "params", "axis_name", "shifts"))
+    "meta", "params", "axis_name", "shifts", "overlap"))
 
 
 def _rbcd_segment(state: RBCDState, graph: MultiAgentGraph, num_rounds,
@@ -1107,7 +1177,8 @@ def _rbcd_segment(state: RBCDState, graph: MultiAgentGraph, num_rounds,
                   plan: PPermutePlan | None = None,
                   shifts: tuple = (),
                   first_update_weights: bool = False,
-                  first_restart: bool = False) -> RBCDState:
+                  first_restart: bool = False,
+                  overlap: bool = False) -> RBCDState:
     """One schedule segment — a (possibly flagged) first round followed by
     ``num_rounds - 1`` plain rounds — as ONE device dispatch.
 
@@ -1123,14 +1194,15 @@ def _rbcd_segment(state: RBCDState, graph: MultiAgentGraph, num_rounds,
                         update_weights=first_update_weights,
                         restart=first_restart, plan=plan, shifts=shifts)
     return _rbcd_rounds(state, graph, num_rounds - 1, meta, params,
-                        axis_name=axis_name, plan=plan, shifts=shifts)
+                        axis_name=axis_name, plan=plan, shifts=shifts,
+                        overlap=overlap)
 
 
 #: Jitted fused segment (single-device; ``parallel.make_sharded_segment``
 #: is the mesh equivalent).
 rbcd_segment = jax.jit(_rbcd_segment, static_argnames=(
     "meta", "params", "axis_name", "shifts", "first_update_weights",
-    "first_restart"))
+    "first_restart", "overlap"))
 
 
 # ---------------------------------------------------------------------------
@@ -1516,7 +1588,8 @@ def make_verdict_program(graph: MultiAgentGraph, edges_g: EdgeSet,
                          n_total: int, num_meas: int, telemetry: bool, *,
                          grad_norm_tol: float,
                          robust_params: RobustCostParams | None,
-                         max_evals: int, health_cfg=None):
+                         max_evals: int, health_cfg=None,
+                         metrics_body=None):
     """The fused per-eval program of the device-resident loop: evaluates
     the central metrics (the byte-identical ``_central_metrics_body``
     subcomputation), appends the row to the device-side history, folds the
@@ -1535,13 +1608,22 @@ def make_verdict_program(graph: MultiAgentGraph, edges_g: EdgeSet,
 
     ``max_evals`` bounds the history; the driver never records more rows
     than eval boundaries in ``max_iters``.  ``health_cfg`` duck-types
-    ``obs.health.HealthConfig`` (defaults used when None)."""
+    ``obs.health.HealthConfig`` (defaults used when None).
+
+    ``metrics_body`` overrides the stacked-metrics subcomputation — THE
+    reuse seam of the sharded plane: ``parallel.sharded`` traces the same
+    row schema inside ``shard_map`` with its reductions as psums
+    (``make_sharded_metrics_body``), and everything downstream of the row
+    (convergence test, health predicates, latch, history) is this one
+    shared program, so the verdict-word semantics cannot drift between
+    the single-device and mesh paths.  The override must match
+    ``_central_metrics_body``'s signature and row width."""
     if health_cfg is None:
         from ..obs.health import HealthConfig
 
         health_cfg = HealthConfig()
-    body = _central_metrics_body(graph, edges_g, n_total, num_meas,
-                                 telemetry)
+    body = metrics_body if metrics_body is not None else \
+        _central_metrics_body(graph, edges_g, n_total, num_meas, telemetry)
     spike_rtol = float(health_cfg.cost_spike_rtol)
     spike_atol = float(health_cfg.cost_spike_atol)
     expl_factor = float(health_cfg.grad_explosion_factor)
@@ -1668,6 +1750,7 @@ def run_rbcd(
     multi_step=None,
     segment=None,
     verdict_every: int | None = None,
+    metrics_body_factory=None,
 ) -> RBCDResult:
     """The driver loop shared by the single-device and mesh-sharded solvers —
     the analog of the ``multi-robot-example`` loop
@@ -1710,6 +1793,13 @@ def run_rbcd(
     of termination at the next boundary, the returned iterate may carry
     up to ``K - eval_every`` extra polish rounds; reported histories and
     ``iterations`` are truncated at the latched terminal eval.
+
+    ``metrics_body_factory`` (mesh path) supplies a replacement for the
+    stacked-metrics body: called once with the resolved telemetry flag, the
+    returned function is jitted for the per-eval readback AND handed to
+    ``make_verdict_program`` as its ``metrics_body`` — how the sharded
+    solver runs the centralized evals as a shard_map program with psum
+    reductions while sharing every downstream line of this driver.
     """
     n_total = part.meas_global.num_poses
     num_meas = len(part.meas_global)
@@ -1724,8 +1814,11 @@ def run_rbcd(
     obs_run = obs.get_run()
     telemetry = obs_run is not None
 
-    central_metrics = _make_central_metrics(graph, edges_g, n_total,
-                                            num_meas, telemetry)
+    metrics_body = metrics_body_factory(telemetry) \
+        if metrics_body_factory is not None else None
+    central_metrics = jax.jit(metrics_body) if metrics_body is not None \
+        else _make_central_metrics(graph, edges_g, n_total, num_meas,
+                                   telemetry)
 
     robust_on = params is not None and \
         params.robust.cost_type != RobustCostType.L2
@@ -1870,7 +1963,8 @@ def run_rbcd(
             edges_g=edges_g, n_total=n_total, num_meas=num_meas,
             telemetry=telemetry, obs_run=obs_run, health_mon=health_mon,
             flight_rec=flight_rec, emit_eval=_emit_eval,
-            bounds=_bounds, robust_on=robust_on)
+            bounds=_bounds, robust_on=robust_on,
+            metrics_body=metrics_body)
 
     # Pipelined driver: advance to each eval boundary, ENQUEUE the metrics
     # program, dispatch one speculative segment past the boundary, and only
@@ -1975,7 +2069,7 @@ def _run_verdict_loop(state, graph, meta, segment, *, max_iters,
                       grad_norm_tol, eval_every, verdict_every, dtype,
                       params, edges_g, n_total, num_meas, telemetry,
                       obs_run, health_mon, flight_rec, emit_eval, bounds,
-                      robust_on):
+                      robust_on, metrics_body=None):
     """Body of ``run_rbcd``'s device-resident mode (see its docstring).
 
     Per verdict boundary (every K rounds): dispatch the schedule segments
@@ -1995,7 +2089,8 @@ def _run_verdict_loop(state, graph, meta, segment, *, max_iters,
         grad_norm_tol=grad_norm_tol,
         robust_params=params.robust if robust_on else None,
         max_evals=max_evals,
-        health_cfg=health_mon.config if health_mon is not None else None)
+        health_cfg=health_mon.config if health_mon is not None else None,
+        metrics_body=metrics_body)
     vs0 = init_verdict_state(max_evals, meta.num_robots, dtype, telemetry)
 
     eval_its: list[int] = []

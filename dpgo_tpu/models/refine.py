@@ -1047,6 +1047,29 @@ def _gn_diag_blocks(S, n: int, dh: int, shift: float) -> np.ndarray:
     return blocks
 
 
+def gn_precond_blocks(edges, lam, n_max: int, s_max: int, d: int,
+                      shift: float) -> jax.Array:
+    """Per-pose (d+1)x(d+1) diagonal blocks of ``S = Q - Lambda`` for a
+    BATCH of agents — ``_gn_diag_blocks`` (the host tail's block-Jacobi
+    preconditioner) vectorized per shard, on device, for the sharded
+    device-resident tail (``parallel.sharded.gn_tail_sharded``).
+
+    ``edges`` is the per-agent EdgeSet ([A, E] fields, buffer-indexed);
+    each agent's diag-block scatter drops neighbor-slot rows (index >=
+    ``n_max``), so a shared edge contributes exactly one block per
+    endpoint across the fleet — the same no-double-counting argument as
+    the sharded S matvec.  ``lam [A, n, d, d]`` carries the per-pose dual
+    blocks ``sym(Y^T (XQ)_Y)``; the Tikhonov ``shift`` mirrors the host
+    recipe."""
+
+    def one(e):
+        return quadratic.diag_blocks(e, n_max + s_max, n_out=n_max)
+
+    blocks = jax.vmap(one)(edges)
+    blocks = blocks.at[..., :d, :d].add(-lam)
+    return blocks + shift * jnp.eye(d + 1, dtype=blocks.dtype)
+
+
 def _gn_tangent(X: np.ndarray, V: np.ndarray, d: int) -> np.ndarray:
     """Tangent projection at X (numpy twin of ``manifold.tangent_project``):
     rotation columns lose their Y sym(Y^T W) component, translations pass."""

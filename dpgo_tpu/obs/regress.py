@@ -40,7 +40,12 @@ from .run import EVENTS_FILE, META_FILE
 #: Gated metrics and their improvement direction.  The host-sync rate is
 #: the readback-kill gate (ISSUE 9): a change that silently reintroduces
 #: per-eval device->host fetches into the driver loop regresses here even
-#: when the convergence numbers are untouched.
+#: when the convergence numbers are untouched.  Sharded records gate the
+#: same lower-is-better way (ISSUE 11) — the mesh identity rides the run
+#: fingerprint (solver=solve_rbcd_sharded, mesh_size, exchange), so a
+#: sharded run only ever compares against a same-mesh baseline and a
+#: reopened readback on the mesh path fails here too
+#: (tests/test_sharded_verdict.py pins it).
 GATED_METRICS = {"solver_cost": "lower", "solver_grad_norm": "lower",
                  "host_syncs_per_100_rounds": "lower"}
 #: Fingerprint keys that never gate (recorded for the report only).

@@ -253,6 +253,105 @@ def _serving_lines(stats: dict | None) -> list[str]:
     return lines
 
 
+def sharded_stats(events: list[dict]) -> dict | None:
+    """Mesh-path facts from the event stream (``solve_rbcd_sharded`` /
+    ``bench_sharded.py`` schemas), shared by the text report, ``--json``,
+    and the bench's assertions: mesh layout + exchange backend + halo
+    overlap flag (``sharded_solve`` setup events), modeled vs measured
+    interconnect bytes per round (``sharded_comm_bytes_measured`` metric,
+    measured = parsed from the compiled program's collectives), halo
+    overlap efficiency (``sharded_overlap_efficiency`` metric, 1 -
+    t_overlap/t_lockstep), the verdict sync rate, and the sharded GN-CG
+    tail summary (``gn_tail`` events with ``sharded=True``)."""
+    setup = [ev for ev in events if ev.get("event") == "sharded_solve"]
+    overlap = [ev for ev in events if ev.get("event") == "metric"
+               and ev.get("metric") == "sharded_overlap_efficiency"]
+    comm = [ev for ev in events if ev.get("event") == "metric"
+            and ev.get("metric") == "sharded_comm_bytes_measured"]
+    tails = [ev for ev in events if ev.get("event") == "gn_tail"
+             and ev.get("sharded")]
+    if not (setup or overlap or comm or tails):
+        return None
+    out: dict = {"solves": [], "gn_tails": []}
+    syncs = [ev for ev in events if ev.get("event") == "metric"
+             and ev.get("metric") == "host_syncs_per_100_rounds"]
+    for ev in setup:
+        out["solves"].append({
+            "mesh_size": ev.get("mesh_size"),
+            "mesh_axes": ev.get("mesh_axes"),
+            "agents_per_shard": ev.get("agents_per_shard"),
+            "exchange": ev.get("exchange"),
+            "overlap": ev.get("overlap"),
+            "verdict_every": ev.get("verdict_every"),
+            "comm_bytes_per_round": ev.get("comm_bytes_per_round"),
+        })
+    if syncs:
+        out["host_syncs_per_100_rounds"] = syncs[-1].get("value")
+    if overlap:
+        ev = overlap[-1]
+        out["overlap"] = {"efficiency": ev.get("value"),
+                          "overlap_rounds_per_s": ev.get("overlap_rounds_per_s"),
+                          "lockstep_rounds_per_s": ev.get("lockstep_rounds_per_s")}
+    if comm:
+        ev = comm[-1]
+        out["comm_measured"] = {"measured": ev.get("value"),
+                                "modeled": ev.get("modeled")}
+    for ev in tails:
+        out["gn_tails"].append({
+            "terminated_by": ev.get("terminated_by"),
+            "outer_iterations": ev.get("outer_iterations"),
+            "cg_iterations": ev.get("cg_iterations"),
+            "cost": ev.get("cost"), "grad_norm": ev.get("grad_norm")})
+    return out
+
+
+def _sharded_lines(stats: dict | None) -> list[str]:
+    """Render the sharded section (mesh-path events present)."""
+    if not stats:
+        return []
+    lines = ["sharded:"]
+    for s in stats["solves"]:
+        axes = "x".join(str(a) for a in (s.get("mesh_axes") or []))
+        parts = [f"mesh {s['mesh_size']} devices ({axes})",
+                 f"{s['agents_per_shard']} agents/shard",
+                 f"exchange {s['exchange']}",
+                 f"halo overlap {'on' if s.get('overlap') else 'off'}"]
+        if s.get("verdict_every"):
+            parts.append(f"verdict loop K={s['verdict_every']}")
+        lines.append("  " + ", ".join(parts))
+        if s.get("comm_bytes_per_round") is not None:
+            lines.append("  interconnect (modeled): "
+                         f"{_fmt_bytes(s['comm_bytes_per_round'])}/round"
+                         "/device")
+    cm = stats.get("comm_measured")
+    if cm and cm.get("measured") is not None:
+        ratio = ""
+        if cm.get("modeled"):
+            ratio = f" ({cm['measured'] / cm['modeled']:.2f}x model)"
+        lines.append(f"  interconnect (compiled collectives): "
+                     f"{_fmt_bytes(cm['measured'])}/round/device{ratio}")
+    if stats.get("host_syncs_per_100_rounds") is not None:
+        lines.append("  verdict sync rate: "
+                     f"{_fmt(stats['host_syncs_per_100_rounds'])} host "
+                     "fetches / 100 rounds")
+    ov = stats.get("overlap")
+    if ov and ov.get("efficiency") is not None:
+        detail = ""
+        if ov.get("overlap_rounds_per_s") and ov.get("lockstep_rounds_per_s"):
+            detail = (f" ({ov['overlap_rounds_per_s']:.1f} vs "
+                      f"{ov['lockstep_rounds_per_s']:.1f} rounds/s)")
+        lines.append(
+            f"  halo overlap efficiency: {ov['efficiency'] * 100:.1f}%"
+            + detail)
+    for t in stats["gn_tails"]:
+        lines.append(
+            f"  gn tail: {t['terminated_by']} after "
+            f"{t['outer_iterations']} outer / {t['cg_iterations']} CG "
+            f"iters, cost {_fmt(t.get('cost'))}, "
+            f"gn {_fmt(t.get('grad_norm'))}")
+    return lines
+
+
 def render_statusz(status: dict) -> str:
     """Human rendering of a live ``/statusz`` payload (the JSON
     ``serve.statusz.MetricsSidecar`` serves and ``SolveServer.status()``
@@ -488,6 +587,7 @@ def render_report(run_dir: str) -> str:
                     f"/ {row.get('count', 0)} "
                     f"({row.get('avg_ms', 0.0):.2f} ms avg)")
 
+        lines.extend(_sharded_lines(sharded_stats(events)))
         lines.extend(_serving_lines(serving_stats(events)))
         lines.extend(_health_lines(events))
         lines.extend(_fleet_lines(fleet_timeline_stats(events)))
@@ -546,6 +646,7 @@ def report_data(run_dir: str) -> dict:
                             if ev.get("event") in ("anomaly",
                                                    "peer_anomaly",
                                                    "blackbox_dump")]
+        out["sharded"] = sharded_stats(events)
         out["serving"] = serving_stats(events)
         out["fleet_timeline"] = fleet_timeline_stats(events)
     m_path = os.path.join(run_dir, METRICS_FILE)

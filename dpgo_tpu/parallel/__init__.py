@@ -1,12 +1,13 @@
 """Distributed (device-mesh) execution layer — see ``sharded.py``."""
 
-from .sharded import (AXIS, comm_bytes_per_round, make_mesh,
-                      make_multislice_mesh,
+from .sharded import (AXIS, comm_bytes_per_round, gn_tail_sharded,
+                      make_mesh, make_multislice_mesh,
+                      make_sharded_metrics_body,
                       make_sharded_multi_step, make_sharded_segment,
                       make_sharded_step, shard_problem, solve_rbcd_sharded)
 
-__all__ = ["AXIS", "comm_bytes_per_round", "make_mesh",
-           "make_multislice_mesh",
+__all__ = ["AXIS", "comm_bytes_per_round", "gn_tail_sharded", "make_mesh",
+           "make_multislice_mesh", "make_sharded_metrics_body",
            "make_sharded_multi_step", "make_sharded_segment",
            "make_sharded_step", "shard_problem",
            "solve_rbcd_sharded"]

@@ -39,7 +39,8 @@ from .. import obs
 from ..ops import manifold, quadratic
 from ..models import rbcd
 from ..models.rbcd import MultiAgentGraph
-from .sharded import AXIS, _axes, _specs, make_mesh  # noqa: F401  (re-export mesh)
+from .sharded import (AXIS, _axes, _gather_exchange,  # noqa: F401
+                      _shard_map, _specs, make_mesh)  # (re-export mesh)
 
 
 def _egrad_local(V, Vz, graph: MultiAgentGraph):
@@ -67,10 +68,10 @@ def _certificate_shard(X, graph: MultiAgentGraph, key, *, axis_name,
     dtype = X.dtype
     mask = graph.pose_mask[..., None, None]  # [A, n, 1, 1]
 
-    gather = lambda t: jax.lax.all_gather(t, axis_name, axis=0, tiled=True)
     psum = lambda v: jax.lax.psum(v, axis_name)
-    exchange = lambda Vl: rbcd.neighbor_buffer(
-        gather(rbcd.public_table(Vl, graph)), graph)
+    # Shared with the solver round and the sharded GN tail: the v1
+    # all_gather neighbor-buffer exchange (sharded._gather_exchange).
+    exchange = _gather_exchange(graph, axis_name)
 
     # Dual blocks from each agent's complete local gradient rows.
     Z = exchange(X)
@@ -222,9 +223,7 @@ def make_sharded_certificate(mesh, num_probe: int = 4,
                     jax.sharding.PartitionSpec())
         from jax.sharding import PartitionSpec as P
         out_specs = (P(), P(), P(), P(_axes(mesh)))
-        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs,
-                             check_vma=False)(X, graph, key)
+        return _shard_map(body, mesh, in_specs, out_specs)(X, graph, key)
 
     return cert
 

@@ -98,6 +98,105 @@ def make_measurements(rng, n, d=3, num_lc=5, rot_noise=0.0, trans_noise=0.0,
     return meas, (Rs, ts)
 
 
+def _quats_to_rotations_np(q: np.ndarray) -> np.ndarray:
+    """Batched unit quaternion (x, y, z, w) -> rotation matrix [n, 3, 3]
+    (vectorized twin of ``lie.quat_to_rotation``)."""
+    x, y, z, w = q[:, 0], q[:, 1], q[:, 2], q[:, 3]
+    R = np.empty((q.shape[0], 3, 3))
+    R[:, 0, 0] = 1 - 2 * (y * y + z * z)
+    R[:, 0, 1] = 2 * (x * y - z * w)
+    R[:, 0, 2] = 2 * (x * z + y * w)
+    R[:, 1, 0] = 2 * (x * y + z * w)
+    R[:, 1, 1] = 1 - 2 * (x * x + z * z)
+    R[:, 1, 2] = 2 * (y * z - x * w)
+    R[:, 2, 0] = 2 * (x * z - y * w)
+    R[:, 2, 1] = 2 * (y * z + x * w)
+    R[:, 2, 2] = 1 - 2 * (x * x + y * y)
+    return R
+
+
+def _random_rotations_np(rng, n: int, d: int) -> np.ndarray:
+    """n uniform random rotations, fully vectorized (quaternions for
+    SO(3), angles for SO(2)) — no per-pose SVD, so million-pose
+    trajectories synthesize in seconds."""
+    if d == 3:
+        q = rng.standard_normal((n, 4))
+        q /= np.maximum(np.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+        return _quats_to_rotations_np(q)
+    th = rng.uniform(0.0, 2.0 * np.pi, n)
+    c, s = np.cos(th), np.sin(th)
+    return np.stack([np.stack([c, -s], -1), np.stack([s, c], -1)], axis=1)
+
+
+def _rotation_noise_np(rng, n: int, d: int, sigma: float) -> np.ndarray:
+    """n small random rotations (axis-angle, angle ~ N(0, sigma)),
+    vectorized — the noise model of ``relative_measurement``."""
+    ang = rng.normal(0.0, sigma, n)
+    if d == 2:
+        c, s = np.cos(ang), np.sin(ang)
+        return np.stack([np.stack([c, -s], -1), np.stack([s, c], -1)],
+                        axis=1)
+    axis = rng.standard_normal((n, 3))
+    axis /= np.maximum(np.linalg.norm(axis, axis=1, keepdims=True), 1e-12)
+    q = np.concatenate([np.sin(ang / 2)[:, None] * axis,
+                        np.cos(ang / 2)[:, None]], axis=1)
+    return _quats_to_rotations_np(q)
+
+
+def make_measurements_vectorized(rng, n, d=3, num_lc=5, rot_noise=0.0,
+                                 trans_noise=0.0, kappa=100.0, tau=10.0):
+    """``make_measurements`` without the per-edge Python loop: odometry
+    chain + random loop closures assembled entirely from batched numpy.
+
+    Exists for the pod-scale bench arms (``bench_sharded.py``): the
+    looped generator synthesizes ~1e4 edges/s, which turns a 1M-pose /
+    1M-edge problem into a multi-minute build before the solver even
+    starts; this one does the same construction in a handful of batched
+    ops.  Same measurement model (exact relative transforms plus optional
+    axis-angle rotation noise and Gaussian translation noise), not
+    edge-for-edge identical to the looped generator's RNG stream."""
+    Rs = _random_rotations_np(rng, n, d)
+    ts = np.cumsum(rng.standard_normal((n, d)), axis=0)
+    R0inv = Rs[0].T
+    ts = (ts - ts[0]) @ R0inv.T
+    Rs = np.einsum("ab,nbc->nac", R0inv, Rs)
+
+    i_odo = np.arange(n - 1)
+    j_odo = i_odo + 1
+    if num_lc > 0:
+        # Oversample, keep i + 1 < j, dedupe — vectorized rejection.
+        cand = rng.integers(0, n, (4 * num_lc + 64, 2))
+        lo, hi = cand.min(1), cand.max(1)
+        keep = hi > lo + 1
+        pairs = np.unique(np.stack([lo[keep], hi[keep]], -1), axis=0)
+        take = rng.permutation(pairs.shape[0])[:num_lc]
+        i_lc, j_lc = pairs[take, 0], pairs[take, 1]
+    else:
+        i_lc = j_lc = np.zeros(0, np.int64)
+    ei = np.concatenate([i_odo, i_lc])
+    ej = np.concatenate([j_odo, j_lc])
+    m = ei.shape[0]
+
+    # R = R_i^T R_j, t = R_i^T (t_j - t_i), batched.
+    Ri = Rs[ei]
+    Rm = np.einsum("eba,ebc->eac", Ri, Rs[ej])
+    tm = np.einsum("eba,eb->ea", Ri, ts[ej] - ts[ei])
+    if rot_noise > 0:
+        Rm = np.einsum("eab,ebc->eac", _rotation_noise_np(rng, m, d,
+                                                          rot_noise), Rm)
+    if trans_noise > 0:
+        tm = tm + rng.normal(0.0, trans_noise, (m, d))
+
+    return Measurements(
+        d=d, num_poses=n,
+        r1=np.zeros(m, np.int32), p1=ei.astype(np.int64),
+        r2=np.zeros(m, np.int32), p2=ej.astype(np.int64),
+        R=Rm, t=tm,
+        kappa=np.full(m, kappa), tau=np.full(m, tau),
+        weight=np.ones(m), is_known_inlier=np.zeros(m, bool),
+    ), (Rs, ts)
+
+
 def corrupt_loop_closures(meas: Measurements, fraction: float, rng=None,
                           seed: int = 0):
     """Replace a random ``fraction`` of the loop closures with gross

@@ -581,6 +581,50 @@ def test_seeded_verdict_loop_sync_violations(tmp_path):
     assert all(f.path.endswith("serve/runner.py") for f in hits)
 
 
+def test_seeded_sharded_gn_tail_sync_violation(tmp_path):
+    """ISSUE-11 seam: the sharded GN tail's outer loop reads exactly one
+    gate scalar + one stats vector per outer step through the sanctioned
+    ``rbcd._host_fetch`` seam — a NEW ``_host_fetch`` call seeded into
+    that loop must be flagged by DPG003 via the configured ``sync_calls``
+    list, with file:line."""
+    pdir = tmp_path / "dpgo_tpu" / "parallel"
+    pdir.mkdir(parents=True)
+    src = (REPO / "dpgo_tpu" / "parallel" / "sharded.py").read_text()
+    bad = src.replace(
+        "        cost_hist.append(f_new)\n        X = X_new",
+        "        cost_hist.append(f_new)\n"
+        "        _dbg = rbcd._host_fetch(X_new)\n        X = X_new")
+    assert bad != src
+    (pdir / "sharded.py").write_text(bad)
+    findings = run_lint([str(tmp_path / "dpgo_tpu")], project_config())
+    hits = [f for f in findings if f.rule == "DPG003"
+            and "sync seam" in f.message]
+    assert hits, findings
+    assert all(f.path.endswith("parallel/sharded.py") and f.line > 0
+               for f in hits)
+
+
+def test_sanctioned_sharded_gn_tail_fetches_stay_suppressed(tmp_path):
+    """The two reviewed GN-tail fetch sites (gate scalar, per-outer
+    stats) must remain suppressed on the real tree: stripping either
+    suppression makes DPG003 fire at that site."""
+    src = (REPO / "dpgo_tpu" / "parallel" / "sharded.py").read_text()
+    for marker in (
+            "        # dpgolint: disable=DPG003 -- sanctioned GN-tail "
+            "gate fetch\n",
+            "        # dpgolint: disable=DPG003 -- sanctioned per-outer "
+            "stats fetch\n"):
+        stripped = src.replace(marker, "")
+        assert stripped != src, marker
+        pdir = tmp_path / marker.split()[-2] / "dpgo_tpu" / "parallel"
+        pdir.mkdir(parents=True)
+        (pdir / "sharded.py").write_text(stripped)
+        findings = run_lint([str(pdir.parent.parent / "dpgo_tpu")],
+                            project_config())
+        assert any(f.rule == "DPG003" and "_host_fetch" in f.message
+                   for f in findings), (marker, findings)
+
+
 def test_sanctioned_verdict_fetches_stay_suppressed(monkeypatch):
     """The three reviewed verdict-loop fetch sites (word, lazy history,
     terminal bookkeeping) must remain suppressed on the real tree — the

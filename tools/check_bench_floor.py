@@ -1,7 +1,10 @@
-"""Bench non-regression gate (ISSUE 9 CI satellite).
+"""Bench non-regression gate (ISSUE 9 CI satellite; MULTICHIP schema
+added by ISSUE 11).
 
-Reads one bench.py metric-record JSON (a file argument, or stdin) and
-enforces, in order:
+Reads one bench record JSON (a file argument, or stdin), auto-detects its
+kind, and enforces:
+
+For a ``bench.py`` kernel record:
 
 1. Record schema — the fields every consumer (BENCH_r0*.json trajectory,
    obs report, regress gate) relies on must be present and sane on EVERY
@@ -17,6 +20,13 @@ enforces, in order:
        <= 100/K (one word fetch per K rounds, the readback-kill
        acceptance).
 
+For a ``bench_sharded.py`` MULTICHIP record (``record == "MULTICHIP"``):
+the weak-scaling arm is present, device counts ascend, every arm carries
+positive rounds/s + poses/s, the sharded verdict cadence keeps host
+syncs at <= 100/K, the overlap A/B and GN-tail parity blocks are sane
+(tail parity <= 1e-6 when the arm ran), and a scale_test block (when
+present) actually completed through the sharded verdict path.
+
 Exit 0 on pass, 1 on any violation, 2 on an unreadable record.
 """
 from __future__ import annotations
@@ -28,11 +38,70 @@ import sys
 FLOOR = float(os.environ.get("BENCH_FLOOR_ROUNDS_PER_S", "1146"))
 PARITY_BOUND = float(os.environ.get("BENCH_PARITY_BOUND", "7.7e-6"))
 MIN_VERDICT_K = int(os.environ.get("BENCH_MIN_VERDICT_K", "4"))
+GN_TAIL_PARITY_BOUND = float(
+    os.environ.get("BENCH_GN_TAIL_PARITY_BOUND", "1e-6"))
 
 
 def fail(msg: str) -> None:
     print(f"bench floor gate: FAIL — {msg}")
     sys.exit(1)
+
+
+def _num(x) -> bool:
+    return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def check_multichip(rec: dict) -> None:
+    """MULTICHIP-record schema gate (``bench_sharded.py`` output)."""
+    for key in ("n_devices", "ok", "backend", "weak_scaling",
+                "verdict_every", "host_syncs_per_100_rounds", "overlap"):
+        if key not in rec:
+            fail(f"MULTICHIP record missing {key!r}: {sorted(rec)}")
+    if not (isinstance(rec["n_devices"], int) and rec["n_devices"] >= 1):
+        fail(f"bad n_devices {rec['n_devices']!r}")
+    if rec["ok"] is not True:
+        fail(f"record reports ok={rec['ok']!r}")
+    ws = rec["weak_scaling"]
+    if not (isinstance(ws, list) and ws):
+        fail("empty weak_scaling arm")
+    prev = 0
+    for arm in ws:
+        for key in ("devices", "num_robots", "n_poses", "rounds_per_s",
+                    "poses_per_s"):
+            if not _num(arm.get(key)) or arm[key] <= 0:
+                fail(f"weak_scaling arm field {key!r} bad: {arm}")
+        if arm["devices"] <= prev:
+            fail(f"weak_scaling device counts must ascend: {ws}")
+        prev = arm["devices"]
+    k = rec["verdict_every"]
+    syncs = rec["host_syncs_per_100_rounds"]
+    if not (isinstance(k, int) and k >= 1):
+        fail(f"verdict_every={k!r}")
+    if not _num(syncs) or syncs > 100.0 / k + 1e-9:
+        fail(f"host_syncs_per_100_rounds={syncs!r} > 100/K={100.0 / k:.4g}")
+    ov = rec["overlap"]
+    for key in ("efficiency", "overlap_rounds_per_s",
+                "lockstep_rounds_per_s"):
+        if not _num(ov.get(key)):
+            fail(f"overlap block field {key!r} bad: {ov}")
+    tail = rec.get("gn_tail")
+    if tail and not tail.get("skipped"):
+        if not _num(tail.get("parity_rel")) \
+                or tail["parity_rel"] > GN_TAIL_PARITY_BOUND:
+            fail(f"gn_tail parity {tail.get('parity_rel')!r} exceeds "
+                 f"{GN_TAIL_PARITY_BOUND}")
+    scale = rec.get("scale_test")
+    if scale and not scale.get("skipped"):
+        if scale.get("completed") is not True:
+            fail(f"scale_test did not complete: {scale}")
+        for key in ("n_poses", "num_robots", "rounds"):
+            if not _num(scale.get(key)) or scale[key] <= 0:
+                fail(f"scale_test field {key!r} bad: {scale}")
+    print(f"bench floor gate: PASS — MULTICHIP schema ok "
+          f"({rec['n_devices']} devices, {len(ws)} weak-scaling arms, "
+          f"{syncs} syncs/100 rounds at K={k}"
+          + (f", scale_test {scale['n_poses']} poses ok"
+             if scale and not scale.get("skipped") else "") + ")")
 
 
 def main() -> None:
@@ -47,6 +116,10 @@ def main() -> None:
     except (OSError, ValueError, IndexError) as e:
         print(f"bench floor gate: unreadable record ({e})")
         sys.exit(2)
+
+    if rec.get("record") == "MULTICHIP":
+        check_multichip(rec)
+        return
 
     # 1. Schema (all platforms).
     for key in ("metric", "value", "unit", "vs_baseline", "cpu_arm_band",

@@ -73,6 +73,7 @@ def project_config() -> Config:
                 "dpgo_tpu/models/rbcd.py",
                 "dpgo_tpu/models/incremental.py",
                 "dpgo_tpu/serve/runner.py",
+                "dpgo_tpu/parallel/sharded.py",
             ],
             # DPG004 is annotation-driven (# guarded-by) — run everywhere;
             # files without annotations produce nothing.
@@ -134,6 +135,17 @@ def project_config() -> Config:
                     "dpgo_tpu/models/incremental.py": {
                         "hot_functions": ["apply_edges", "_try_delta",
                                           "warm_dispatch", "_adapt_state"],
+                    },
+                    # The sharded driver loop (ISSUE 11): the sharded
+                    # GN-CG tail's outer loop reads one gate scalar and
+                    # one stats vector per outer step through the same
+                    # sanctioned seam as the verdict loop; anything else
+                    # inside it (or inside a future solve_rbcd_sharded
+                    # loop) is a hot-loop regression on the mesh path.
+                    "dpgo_tpu/parallel/sharded.py": {
+                        "hot_functions": ["gn_tail_sharded",
+                                          "solve_rbcd_sharded"],
+                        "sync_calls": ["_host_fetch"],
                     },
                 },
             },
