@@ -1,0 +1,295 @@
+"""Fleet soak benchmark: QPS vs. replicas, chaos soak, cold-start split.
+
+The scale-out acceptance measurement for ``dpgo_tpu.serve.fleet``.
+Three arms, one FLEET metric record:
+
+1. **QPS vs. replicas** — the same stream of session-tagged small solves
+   through a 1-replica fleet and then a 2-replica fleet (optionally
+   more), with a shared pre-warmed persistent AOT cache so compiles never
+   pollute the throughput numbers.  Rendezvous hashing spreads sessions
+   across replicas, whose batch windows and device dispatches overlap;
+   ``scaling_1_to_2`` (QPS ratio) is the number CI gates (>= 1.7 by
+   default, ``FLEET_MIN_SCALING``).
+
+2. **Chaos soak** — concurrent long-running live sessions on an
+   autoscaling fleet (min 2, max 3 replicas, queue-wait SLO pinned low
+   so the burn trips): mid-soak one replica is hard-killed and the
+   autoscaler brings up another.  Every session must complete (the
+   killed replica's sessions resume from their boundary snapshots on
+   their rehashed replicas): the gate is ``lost == 0`` with
+   ``migrations >= 1`` and ``scale_ups >= 1``.
+
+3. **Cold start** — one replica compiles a fingerprint and persists it
+   (cold), a fresh replica on the same cache root then serves its first
+   solve from disk: the warm run's ``serve_compile_seconds_total`` must
+   be exactly 0 with ``disk_hits >= 1`` (XLA never ran), and the record
+   carries the cold/warm first-solve split.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python bench_fleet.py --requests 16 --sessions 6
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+# The fleet's own disk tier is the thing under test; keep jax's global
+# compilation cache out of the measurement.
+os.environ.setdefault("DPGO_TPU_COMPILATION_CACHE", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+from dpgo_tpu import obs  # noqa: E402
+from dpgo_tpu.config import AgentParams  # noqa: E402
+from dpgo_tpu.obs.events import metric_record  # noqa: E402
+from dpgo_tpu.serve import (FleetRouter, ReplicaManager, SolveRequest,  # noqa: E402
+                            SolveServer)
+from dpgo_tpu.utils.synthetic import make_measurements  # noqa: E402
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_meas(n: int, seed: int = 0):
+    meas, _ = make_measurements(np.random.default_rng(seed), n=n, d=3,
+                                num_lc=8, rot_noise=0.01, trans_noise=0.01)
+    return meas
+
+
+#: Consensus unreachable + zero gradient tolerance: solves run their full
+#: iteration budget, so soak solves stay in flight long enough to migrate.
+PARAMS = AgentParams(d=3, r=5, num_robots=2, rel_change_tol=-1.0)
+
+
+def req(meas, sid=None, iters=2, eval_every=2):
+    return SolveRequest(meas=meas, num_robots=2, params=PARAMS,
+                        max_iters=iters, grad_norm_tol=0.0,
+                        eval_every=eval_every, session_id=sid)
+
+
+def build_fleet(n, aot_root, sess_root=None, max_replicas=None,
+                batch_window_s=0.08, max_batch=2, **mgr_kw):
+    def make_server(rid):
+        return SolveServer(max_batch=max_batch,
+                           batch_window_s=batch_window_s,
+                           replica_id=rid, aot_cache_dir=aot_root,
+                           session_store=sess_root, session_every=1,
+                           resume_sessions=sess_root is not None)
+
+    mgr = ReplicaManager(make_server, min_replicas=n,
+                         max_replicas=max_replicas,
+                         monitor_interval_s=0.1, **mgr_kw)
+    return FleetRouter(mgr)
+
+
+#: QPS-arm coalescing window: each heterogeneous request pays this once
+#: on a lone replica; replicas pay it concurrently.
+QPS_WINDOW_S = 0.2
+
+
+def balanced_sids(count, n_replicas):
+    """Session ids pre-balanced over the fleet's deterministic replica
+    ids (r0..r{n-1}) with the router's own rendezvous hash, so the arm
+    measures scale-out rather than hash variance on tiny streams."""
+    from dpgo_tpu.serve.fleet.router import _hrw_weight
+
+    rids = [f"r{i}" for i in range(n_replicas)]
+    per = {rid: 0 for rid in rids}
+    quota = -(-count // n_replicas)
+    out, i = [], 0
+    while len(out) < count:
+        sid = f"q{i}"
+        i += 1
+        rid = max(rids, key=lambda r: _hrw_weight(f"s|{sid}", r))
+        if per[rid] < quota:
+            per[rid] += 1
+            out.append(sid)
+    return out
+
+
+def arm_qps(meas, replica_counts, requests, aot_root) -> list[dict]:
+    """The same heterogeneous request stream through fleets of ascending
+    size.
+
+    Every request carries a unique batch key (distinct ``grad_norm_tol``;
+    identical compiled programs), so none coalesce: each dispatch is a
+    batch of one that first waits out the coalescing window — the
+    latency gamble the serving plane takes on every non-full batch.  A
+    lone replica pays that window serially per request; a fleet pays it
+    concurrently across members, which is precisely the scale-out win
+    this arm measures.  The shared pre-warmed AOT disk cache keeps XLA
+    out of the timings."""
+    t0 = time.perf_counter()
+    with SolveServer(max_batch=2, batch_window_s=0.0,
+                     aot_cache_dir=aot_root) as srv:
+        srv.solve(req(meas), timeout=600)
+    log(f"[qps] warmed AOT cache in {time.perf_counter() - t0:.2f}s")
+
+    def hreq(sid, k):
+        # Unique grad_norm_tol => unique batch key, same executables
+        # (the runner's compile fingerprints don't include it).
+        r = req(meas, sid=sid)
+        r.grad_norm_tol = 1e-12 * (k + 1)
+        return r
+
+    arms = []
+    for n in replica_counts:
+        sids = balanced_sids(requests, n)
+        # max_batch above the stream depth: the queue never looks full,
+        # so the window applies to every dispatch (the lone-replica cost
+        # being measured); max_batch is not the contended resource here.
+        router = build_fleet(n, aot_root, batch_window_s=QPS_WINDOW_S,
+                             max_batch=2 * requests)
+        try:
+            # One throwaway request per replica pays its executable disk
+            # load before the clock starts.
+            warm = [router.submit(req(meas, sid=f"w{i}"))
+                    for i in range(2 * n)]
+            for t in warm:
+                t.result(timeout=600)
+            t0 = time.perf_counter()
+            tickets = [router.submit(hreq(sid, k))
+                       for k, sid in enumerate(sids)]
+            for t in tickets:
+                t.result(timeout=600)
+            wall = time.perf_counter() - t0
+        finally:
+            router.close()
+        arms.append({"replicas": n, "qps": round(requests / wall, 4),
+                     "wall_s": round(wall, 4), "requests": requests,
+                     "window_s": QPS_WINDOW_S})
+        log(f"[qps] {n} replica(s): {arms[-1]['qps']} problems/s")
+    return arms
+
+
+def arm_soak(meas, sessions, soak_iters, aot_root) -> dict:
+    """Concurrent live sessions with a mid-soak kill AND a mid-soak
+    autoscale-up; zero sessions may be lost."""
+    sess_root = tempfile.mkdtemp(prefix="fleet-sess-")
+    # queue_wait_slo_s=0 => every completed request reads as burning the
+    # wait budget, so the autoscaler provably trips mid-soak.
+    router = build_fleet(2, aot_root, sess_root=sess_root, max_replicas=3,
+                         queue_wait_slo_s=0.0, scale_cooldown_s=0.5,
+                         min_scale_observations=2, scale_window_s=60.0,
+                         batch_window_s=0.02, max_batch=2)
+    mgr = router.manager
+    try:
+        tickets = {f"soak-{i}": router.submit(
+            req(meas, sid=f"soak-{i}", iters=soak_iters, eval_every=1))
+            for i in range(sessions)}
+        time.sleep(1.5)  # let solves get in flight and snapshot
+        victim = mgr.replicas()[0].replica_id
+        mgr.kill_replica(victim)
+        log(f"[soak] killed {victim} mid-soak")
+        lost, done = [], 0
+        for sid, t in tickets.items():
+            try:
+                t.result(timeout=900)
+                done += 1
+            except Exception as e:
+                log(f"[soak] LOST {sid}: {type(e).__name__}: {e}")
+                lost.append(sid)
+        st = mgr.status()
+        migrations = router.migrations
+    finally:
+        router.close()
+    out = {"sessions": sessions, "completed": done, "lost": len(lost),
+           "lost_ids": lost, "killed": victim, "migrations": migrations,
+           "scale_ups": st["scale_ups"], "respawns": st["respawns"],
+           "replicas_end": len(st["pool"])}
+    log(f"[soak] {out}")
+    return out
+
+
+def arm_cold_start(meas) -> dict:
+    """Cold compile+persist, then a fresh replica proves the disk path:
+    first solve with serve_compile_seconds_total == 0."""
+    aot_root = tempfile.mkdtemp(prefix="fleet-aot-")
+
+    def one_solve(label):
+        with obs.run_scope(tempfile.mkdtemp(prefix=f"fleet-{label}-")) as run:
+            t0 = time.perf_counter()
+            with SolveServer(max_batch=2, batch_window_s=0.0,
+                             aot_cache_dir=aot_root) as srv:
+                srv.solve(req(meas), timeout=600)
+                disk = srv.cache.stats()["disk"]
+            wall = time.perf_counter() - t0
+            compile_s = sum(run.counter(
+                "serve_compile_seconds_total",
+                "wall-clock spent in XLA compiles of serving executables",
+                unit="s").series().values())
+            run.metric("serve_cold_start_seconds", wall, "s", phase="bench",
+                       arm=label, compile_seconds_total=compile_s,
+                       disk_hits=disk["disk_hits"], stores=disk["stores"])
+        return wall, compile_s, disk
+
+    cold_s, cold_compile, cold_disk = one_solve("cold")
+    warm_s, warm_compile, warm_disk = one_solve("warm")
+    out = {"cold_first_solve_s": round(cold_s, 3),
+           "warm_first_solve_s": round(warm_s, 3),
+           "cold_compile_seconds_total": round(cold_compile, 3),
+           "compile_seconds_total": round(warm_compile, 6),
+           "disk_hits": warm_disk["disk_hits"],
+           "stores": cold_disk["stores"],
+           "speedup": round(cold_s / max(warm_s, 1e-9), 2)}
+    log(f"[cold] {out}")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n-poses", type=int, default=24)
+    ap.add_argument("--requests", type=int, default=16,
+                    help="stream length for the QPS arm")
+    ap.add_argument("--replicas", type=int, nargs="+", default=[1, 2],
+                    help="ascending replica counts for the QPS arm")
+    ap.add_argument("--sessions", type=int, default=6,
+                    help="concurrent live sessions in the chaos soak")
+    ap.add_argument("--soak-iters", type=int, default=400,
+                    help="iteration budget of each soak session")
+    ap.add_argument("--skip-soak", action="store_true")
+    ap.add_argument("--skip-cold", action="store_true")
+    args = ap.parse_args(argv)
+
+    meas = make_meas(args.n_poses)
+    aot_root = tempfile.mkdtemp(prefix="fleet-aot-")
+
+    qps = arm_qps(meas, args.replicas, args.requests, aot_root)
+    soak = {"skipped": True} if args.skip_soak else \
+        arm_soak(meas, args.sessions, args.soak_iters, aot_root)
+    cold = {"skipped": True} if args.skip_cold else arm_cold_start(meas)
+
+    by_n = {a["replicas"]: a["qps"] for a in qps}
+    scaling = round(by_n[2] / by_n[1], 3) if 1 in by_n and 2 in by_n \
+        else None
+    ok = (soak.get("skipped") or soak["lost"] == 0) \
+        and (cold.get("skipped") or cold["compile_seconds_total"] == 0.0)
+    rec = metric_record(
+        "fleet_qps",
+        by_n.get(max(by_n)),
+        "problems/s",
+        record="FLEET",
+        ok=bool(ok),
+        backend=jax.default_backend(),
+        qps=qps,
+        scaling_1_to_2=scaling,
+        soak=soak,
+        cold_start=cold,
+    )
+    print(json.dumps(rec), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,13 +10,15 @@ analog of the CI perf smoke.  The comparison:
   an apples-to-oranges comparison is refused with a clear message rather
   than producing a meaningless delta table.  Package version is recorded
   but never gates — comparing across versions is the point of the gate.
-* **Terminal metrics with noise bands.**  For each gated metric
-  (``solver_cost``, ``solver_grad_norm`` — lower is better) run B's final
-  value is checked against run A's tail *noise band* (min/median/max over
-  the last ``tail`` evals — the ``cpu_arm_band`` schema of ``bench.py``'s
-  metric_record) widened by ``rtol``: B regresses when its final value
-  exceeds A's band max beyond tolerance, or goes non-finite where A was
-  finite.
+* **Terminal metrics with noise bands.**  For each gated metric run B's
+  final value is checked against run A's tail *noise band* (min/median/
+  max over the last ``tail`` evals — the ``cpu_arm_band`` schema of
+  ``bench.py``'s metric_record) widened by ``rtol``.  ``GATED_METRICS``
+  declares each metric's improvement direction: lower-is-better metrics
+  (``solver_cost``, ...) regress when B's final exceeds A's band max
+  beyond tolerance; higher-is-better metrics (``fleet_qps``) regress
+  when B's final drops below A's band min.  Either way a non-finite B
+  where A was finite regresses.
 * **Trajectory deltas.**  Per-iteration aligned relative deviation over
   the common eval grid, reported per metric (informational).
 * **Anomaly gate.**  Run B showing critical ``anomaly`` events where run
@@ -46,8 +48,14 @@ from .run import EVENTS_FILE, META_FILE
 #: sharded run only ever compares against a same-mesh baseline and a
 #: reopened readback on the mesh path fails here too
 #: (tests/test_sharded_verdict.py pins it).
+#: Fleet records (ISSUE 13) gate both ways: throughput must not drop
+#: (``fleet_qps`` — the first higher-is-better metric, mirrored band
+#: check against A's tail MIN) and a warm restart must not get slower
+#: (``serve_cold_start_seconds``).
 GATED_METRICS = {"solver_cost": "lower", "solver_grad_norm": "lower",
-                 "host_syncs_per_100_rounds": "lower"}
+                 "host_syncs_per_100_rounds": "lower",
+                 "fleet_qps": "higher",
+                 "serve_cold_start_seconds": "lower"}
 #: Fingerprint keys that never gate (recorded for the report only).
 NON_GATING_KEYS = {"version"}
 
@@ -152,6 +160,17 @@ def compare_runs(dir_a: str, dir_b: str, rtol: float = 0.05,
                     regressed = True
                     why = (f"final {b_final:.6g} above band max "
                            f"{band_a['max']:.6g} (+{rtol * 100:.0f}%)")
+        elif direction == "higher":
+            if not math.isfinite(b_final) and math.isfinite(a_final):
+                regressed, why = True, "non-finite final value"
+            elif math.isfinite(b_final) and math.isfinite(band_a["min"]):
+                bound = band_a["min"] * (1.0 - rtol) - atol \
+                    if band_a["min"] >= 0 \
+                    else band_a["min"] * (1.0 + rtol) - atol
+                if b_final < bound:
+                    regressed = True
+                    why = (f"final {b_final:.6g} below band min "
+                           f"{band_a['min']:.6g} (-{rtol * 100:.0f}%)")
         entry = {"a_final": a_final, "b_final": b_final,
                  "delta": b_final - a_final
                  if math.isfinite(b_final) and math.isfinite(a_final)

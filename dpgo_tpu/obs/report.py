@@ -463,6 +463,126 @@ def _fleet_lines(stats: dict | None) -> list[str]:
     return lines
 
 
+def fleet_serve_stats(events: list[dict]) -> dict | None:
+    """Fleet-of-replicas serving stats from ``serve.fleet``'s event
+    schema (``replica_spawn``/``replica_death``/``fleet_scale``/
+    ``session_migrated`` plus the AOT disk tier's ``compile_profile``/
+    ``aot_entry_quarantined``/``aot_store_failed``), shared by the text
+    report and the ``--json`` payload (``out["fleet"]``).
+
+    Distinct from :func:`~dpgo_tpu.obs.timeline.fleet_timeline_stats`,
+    which reconstructs the *robot* fleet's span timeline — this section
+    is about the *replica* fleet: lifecycle churn, live migrations by
+    kind, autoscaler decisions, and the persistent-cache disk-hit vs.
+    compile split that proves a warm restart skipped XLA."""
+    spawns = [ev for ev in events if ev.get("event") == "replica_spawn"]
+    deaths = [ev for ev in events if ev.get("event") == "replica_death"]
+    scales = [ev for ev in events if ev.get("event") == "fleet_scale"]
+    migs = [ev for ev in events if ev.get("event") == "session_migrated"]
+    quarantined = [ev for ev in events
+                   if ev.get("event") == "aot_entry_quarantined"]
+    store_fails = [ev for ev in events
+                   if ev.get("event") == "aot_store_failed"]
+    fleet_seen = any(ev.get("phase") == "fleet" for ev in events)
+    if not (fleet_seen or quarantined or store_fails):
+        return None
+    profiles = [ev for ev in events if ev.get("event") == "compile_profile"]
+    disk_hits = [ev for ev in profiles if ev.get("disk_hit")]
+    compiles = [ev for ev in profiles if not ev.get("disk_hit")]
+    cold = [ev for ev in events if ev.get("event") == "metric"
+            and ev.get("metric") == "serve_cold_start_seconds"]
+    out: dict = {
+        "replicas": {
+            "spawned": len(spawns),
+            "spawn_reasons": dict(_TallyCounter(
+                ev.get("reason", "?") for ev in spawns)),
+            "deaths": len(deaths),
+            "pool_end": ([ev.get("pool") for ev in spawns + deaths
+                          + scales] or [None])[-1],
+        },
+        "migrations": {
+            "count": len(migs),
+            "by_kind": dict(_TallyCounter(
+                ev.get("kind", "?") for ev in migs)),
+            "failed": sum(1 for ev in migs if not ev.get("ok")),
+            "sessions": sorted({ev["session"] for ev in migs
+                                if ev.get("session")}),
+        },
+        "scale": {
+            "events": len(scales),
+            "by_direction": dict(_TallyCounter(
+                ev.get("direction", "?") for ev in scales)),
+            "last_burn": scales[-1].get("burn") if scales else None,
+        },
+        "aot": {
+            "disk_hits": len(disk_hits),
+            "compiles": len(compiles),
+            "quarantined": len(quarantined),
+            "store_failures": len(store_fails),
+        } if (profiles or quarantined or store_fails) else None,
+        "cold_start": [
+            {"arm": ev.get("arm", "?"),
+             "first_solve_s": ev.get("value"),
+             "compile_seconds_total": ev.get("compile_seconds_total"),
+             "disk_hits": ev.get("disk_hits")}
+            for ev in cold] or None,
+    }
+    return out
+
+
+def _fleet_serve_lines(stats: dict | None) -> list[str]:
+    """Render the replica-fleet section (fleet-phase events present)."""
+    if not stats:
+        return []
+    rep = stats["replicas"]
+    reasons = ", ".join(f"{k} {n}" for k, n
+                        in sorted(rep["spawn_reasons"].items()))
+    lines = [f"fleet: {rep['spawned']} replicas spawned"
+             + (f" ({reasons})" if reasons else "")
+             + f", {rep['deaths']} deaths"
+             + (f", pool {rep['pool_end']} at end"
+                if rep["pool_end"] is not None else "")]
+    mig = stats["migrations"]
+    if mig["count"]:
+        kinds = ", ".join(f"{k} {n}" for k, n
+                          in sorted(mig["by_kind"].items()))
+        line = f"  migrations: {mig['count']} ({kinds})"
+        if mig["failed"]:
+            line += f", {mig['failed']} FAILED"
+        if mig["sessions"]:
+            line += " — sessions " + ", ".join(mig["sessions"][:6])
+            if len(mig["sessions"]) > 6:
+                line += f" (+{len(mig['sessions']) - 6} more)"
+        lines.append(line)
+    sc = stats["scale"]
+    if sc["events"]:
+        dirs = ", ".join(f"{k} {n}" for k, n
+                         in sorted(sc["by_direction"].items()))
+        line = f"  autoscale: {sc['events']} decisions ({dirs})"
+        if sc["last_burn"] is not None:
+            line += f", last burn {sc['last_burn']:.3g}"
+        lines.append(line)
+    aot = stats["aot"]
+    if aot:
+        line = (f"  aot cache: {aot['disk_hits']} disk hits / "
+                f"{aot['compiles']} compiles")
+        if aot["quarantined"]:
+            line += f", {aot['quarantined']} QUARANTINED"
+        if aot["store_failures"]:
+            line += f", {aot['store_failures']} store failures"
+        lines.append(line)
+    for row in stats["cold_start"] or []:
+        parts = []
+        if row["first_solve_s"] is not None:
+            parts.append(f"first solve {row['first_solve_s']:.3f}s")
+        if row["compile_seconds_total"] is not None:
+            parts.append(f"compile {row['compile_seconds_total']:.3f}s")
+        if row["disk_hits"] is not None:
+            parts.append(f"{row['disk_hits']} disk hits")
+        lines.append(f"  cold start [{row['arm']}]: " + ", ".join(parts))
+    return lines
+
+
 def render_report(run_dir: str) -> str:
     lines = [f"== telemetry report: {run_dir} =="]
     meta_path = os.path.join(run_dir, META_FILE)
@@ -591,6 +711,7 @@ def render_report(run_dir: str) -> str:
         lines.extend(_serving_lines(serving_stats(events)))
         lines.extend(_health_lines(events))
         lines.extend(_fleet_lines(fleet_timeline_stats(events)))
+        lines.extend(_fleet_serve_lines(fleet_serve_stats(events)))
     else:
         lines.append("events: none")
 
@@ -649,6 +770,7 @@ def report_data(run_dir: str) -> dict:
         out["sharded"] = sharded_stats(events)
         out["serving"] = serving_stats(events)
         out["fleet_timeline"] = fleet_timeline_stats(events)
+        out["fleet"] = fleet_serve_stats(events)
     m_path = os.path.join(run_dir, METRICS_FILE)
     if os.path.exists(m_path):
         with open(m_path) as fh:

@@ -29,6 +29,11 @@ as a *shared backend* rather than a per-robot binary:
   mid-batch is respawned and session-tagged requests are re-admitted
   from their last valid snapshot (corrupt snapshots quarantined), the
   reply flagged ``recovered``.
+* ``fleet`` — the scale-out layer: ``ReplicaManager`` runs N replicas
+  (spawn/monitor/respawn/autoscale), ``FleetRouter`` rendezvous-hashes
+  sessions onto them and live-migrates tickets across drains and deaths,
+  and ``AOTDiskCache`` persists compiled executables so replica restarts
+  skip XLA entirely.
 
 Quickstart (in-process)::
 
@@ -44,6 +49,7 @@ TCP: ``python -m dpgo_tpu.serve --port 0`` then
 
 from .bucketing import BucketShape, bucket_shape_of, pad_problem
 from .cache import ExecutableCache, problem_fingerprint
+from .fleet import AOTDiskCache, FleetRouter, Replica, ReplicaManager
 from .runner import run_bucket
 from .server import (OverCapacityError, ServeSLO, SolveRequest, SolveServer,
                      SolveTicket)
@@ -63,4 +69,8 @@ __all__ = [
     "SolveTicket",
     "SessionSnapshot",
     "SessionStore",
+    "AOTDiskCache",
+    "FleetRouter",
+    "Replica",
+    "ReplicaManager",
 ]

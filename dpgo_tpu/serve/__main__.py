@@ -65,6 +65,21 @@ def main(argv: list[str] | None = None) -> int:
                          "boundaries and are re-admitted from the last "
                          "snapshot (reply flags recovered=1) when a worker "
                          "dies mid-batch")
+    ap.add_argument("--replica-id", default=None,
+                    help="identity this server reports in status()/healthz "
+                         "replica blocks (fleet deployments name each "
+                         "member; defaults to an anonymous singleton)")
+    ap.add_argument("--aot-cache-dir", metavar="DIR", default=None,
+                    help="persistent AOT executable cache root (shared "
+                         "across replicas/restarts): compiled programs are "
+                         "serialized here and reloaded without invoking "
+                         "XLA, so a warm restart's first solve skips the "
+                         "compile entirely")
+    ap.add_argument("--resume-sessions", action="store_true",
+                    help="with --session-dir: resume session-tagged "
+                         "requests from their newest snapshot at ADMISSION "
+                         "(not just after a crash) — the receiving end of "
+                         "fleet live-migration")
     ap.add_argument("--drain", action="store_true",
                     help="on SIGINT, drain instead of hard-close: stop "
                          "admission with structured sheds, finish the "
@@ -84,7 +99,10 @@ def main(argv: list[str] | None = None) -> int:
                              metrics_port=args.metrics_port,
                              profile_dir=args.profile_dir,
                              profile_batches=args.profile_batches,
-                             session_store=args.session_dir)
+                             session_store=args.session_dir,
+                             replica_id=args.replica_id,
+                             resume_sessions=args.resume_sessions,
+                             aot_cache_dir=args.aot_cache_dir)
         try:
             with ServeFrontend(
                     server, host=args.host, port=args.port,

@@ -80,9 +80,17 @@ class ExecutableCache:
     different keys must compile concurrently); per-key in-flight events
     provide the exclusion.  A builder that raises clears its in-flight
     marker so waiters (and retries) attempt the build themselves.
+
+    ``disk`` is the optional persistent tier
+    (``serve.fleet.aotcache.AOTDiskCache``): when set, the runner stores
+    ``AOTExecutable`` entries that resolve through disk before compiling,
+    so a fresh process with a warm disk skips XLA entirely.  The cache
+    itself only carries the handle and surfaces the tier's stats; the
+    tiering logic lives in the entry wrapper.
     """
 
-    def __init__(self):
+    def __init__(self, disk=None):
+        self.disk = disk
         self._lock = threading.Lock()
         self._entries: dict[str, object] = {}           # guarded-by: _lock
         self._building: dict[str, threading.Event] = {}  # guarded-by: _lock
@@ -142,5 +150,8 @@ class ExecutableCache:
 
     def stats(self) -> dict:
         with self._lock:
-            return {"entries": len(self._entries), "compiles": self.compiles,
-                    "hits": self.hits}
+            out = {"entries": len(self._entries), "compiles": self.compiles,
+                   "hits": self.hits}
+        if self.disk is not None:
+            out["disk"] = self.disk.stats()
+        return out

@@ -1,0 +1,19 @@
+"""Fleet layer: replicated solve service with session affinity.
+
+Composes three pieces on top of the single-replica ``SolveServer``:
+
+* ``manager.ReplicaManager`` — spawns/monitors/respawns/autoscales a
+  pool of ``Replica``\\ s (each one ``SolveServer``, optionally pinned to
+  its own device);
+* ``router.FleetRouter`` — rendezvous-hashes session ids (and a bucket
+  proxy for untagged traffic) onto the pool, and live-migrates tickets
+  across drains and deaths so a replica retirement loses zero sessions;
+* ``aotcache.AOTDiskCache`` / ``AOTExecutable`` — the persistent compile
+  cache replicas share, making XLA compilation a fleet-wide one-time
+  cost instead of a per-restart tax.
+"""
+
+from .aotcache import AOT_CACHE_SCHEMA_VERSION  # noqa: F401
+from .aotcache import AOTDiskCache, AOTExecutable, entry_identity  # noqa: F401
+from .manager import Replica, ReplicaManager  # noqa: F401
+from .router import FleetRouter, RouterTicket  # noqa: F401

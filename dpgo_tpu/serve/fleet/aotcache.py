@@ -1,0 +1,314 @@
+"""Persistent AOT executable cache: the disk tier under ``ExecutableCache``.
+
+The in-memory executable cache dies with its process, so every replica
+restart and autoscale-up repays the XLA compile bill for every bucket it
+has ever served (seconds per program on CPU, tens of seconds on TPU).
+This module makes the compile a fleet-wide one-time cost: compiled
+executables are serialized with ``jax.experimental.serialize_executable``
+(the stable pickling surface under ``jax.export``) and written to a
+shared directory keyed by the config fingerprint plus everything that
+could invalidate the bytes — static-argument combination, backend,
+jax/jaxlib versions, x64 mode, and the entry schema version.  A replica
+that restarts with a warm disk deserializes and loads the executable
+without ever invoking XLA, so ``serve_compile_seconds_total`` stays flat
+and cold-start becomes I/O-dominated (``compile_profile`` events with
+``disk_hit=True`` carry the load time for the report's cold-start split).
+
+Durability discipline mirrors ``serve.session.SessionStore``:
+
+* writes are atomic (temp file + fsync + rename), so a crash mid-write
+  leaves a torn temp file, never a torn entry;
+* every entry embeds its full identity dict and ``load`` re-validates it
+  against the requested identity — a stale or hash-colliding entry is
+  refused, not deserialized;
+* ANY load defect (unreadable pickle, identity mismatch, deserialization
+  failure) QUARANTINES the entry — renamed aside so it is never retried —
+  and falls back to a fresh compile.  The cache is strictly fail-open:
+  no admission path ever sees a disk-cache exception.
+
+``AOTExecutable`` is the cache-entry wrapper (the disk-tier sibling of
+``obs.profile.ProfiledExecutable``): each distinct static-argument
+combination resolves once through disk-load -> AOT-compile -> disk-store,
+and later calls dispatch the loaded/compiled executable with the static
+kwargs stripped.  Unlike ``ProfiledExecutable`` it AOT-compiles on the
+telemetry-off path too (the disk tier is a durability feature, not
+telemetry) — but it constructs no obs objects and emits nothing unless a
+run is live, keeping the zero-overhead fence intact.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+
+from ... import obs
+
+#: Bump on any incompatible change to the entry payload layout.  A loader
+#: finding a different version quarantines the file — running executables
+#: deserialized under different framing assumptions is worse than a
+#: recompile.
+AOT_CACHE_SCHEMA_VERSION = 1
+
+
+#: Guards the process-global compilation-cache flag toggle below.
+_COMPILE_LOCK = threading.Lock()
+
+
+@contextlib.contextmanager
+def _self_contained_compile():
+    """Serialization-safe compile scope.  An executable that jax's own
+    persistent compilation cache deserialized does NOT re-serialize
+    completely on the CPU backend: ``serialize_executable.serialize``
+    drops the fusion symbols' object code and a later
+    ``deserialize_and_load`` dies with ``Symbols not found``.  Entries
+    written to THIS disk tier must therefore come from a genuine XLA
+    compile, so the jax cache is disabled for the duration.  Flipping the
+    flag alone is not enough: ``compilation_cache.is_cache_used`` MEMOIZES
+    its verdict at the process's first compile, so the memo is reset on
+    entry (cache off takes effect) and again on exit (the restored flag
+    re-memoizes at the next ordinary compile).  The flag and memo are
+    process-global, hence the lock; concurrent unrelated compiles merely
+    miss jax's cache once."""
+    import jax
+
+    try:
+        from jax.experimental.compilation_cache import (compilation_cache
+                                                        as _jax_cc)
+    except ImportError:  # pragma: no cover - future jax reorganisations
+        _jax_cc = None
+
+    with _COMPILE_LOCK:
+        prev = bool(jax.config.jax_enable_compilation_cache)
+        jax.config.update("jax_enable_compilation_cache", False)
+        if _jax_cc is not None:
+            _jax_cc.reset_cache()
+        try:
+            yield
+        finally:
+            jax.config.update("jax_enable_compilation_cache", prev)
+            if _jax_cc is not None:
+                _jax_cc.reset_cache()
+
+
+def _versions() -> dict:
+    import jax
+    import jaxlib
+
+    return {
+        "backend": jax.default_backend(),
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "x64": bool(jax.config.jax_enable_x64),
+    }
+
+
+def entry_identity(fingerprint_key: str, combo: tuple) -> dict:
+    """The full identity of one disk entry: everything that could make a
+    serialized executable wrong to load.  ``combo`` is the sorted
+    static-argument tuple the executable was lowered with."""
+    ident = {
+        "schema": AOT_CACHE_SCHEMA_VERSION,
+        "fingerprint": str(fingerprint_key),
+        "static": [[str(k), repr(v)] for k, v in combo],
+    }
+    ident.update(_versions())
+    return ident
+
+
+def _ident_digest(ident: dict) -> str:
+    blob = json.dumps(ident, sort_keys=True).encode("utf-8")
+    return hashlib.blake2b(blob, digest_size=16).hexdigest()
+
+
+class AOTDiskCache:
+    """Directory-backed store of serialized compiled executables.
+
+    Thread-safe and multi-process-safe by construction: entries are
+    immutable once renamed into place, writes are atomic, and identity
+    validation makes concurrent writers idempotent (same identity ->
+    same bytes semantics).  Replicas of one fleet share a root and each
+    keep their own in-memory tier above it."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+        self.disk_hits = 0      # guarded-by: _lock
+        self.disk_misses = 0    # guarded-by: _lock
+        self.stores = 0         # guarded-by: _lock
+        self.quarantined = 0    # guarded-by: _lock
+        self.store_errors = 0   # guarded-by: _lock
+
+    def _path(self, ident: dict) -> str:
+        return os.path.join(self.root, f"aot-{_ident_digest(ident)}.bin")
+
+    # -- reading -------------------------------------------------------------
+
+    def load(self, ident: dict):
+        """The deserialized, loaded executable for ``ident``, or None.
+
+        None covers both a plain miss and every defect path (quarantined
+        entry, version skew, unreadable file) — the caller always falls
+        back to compiling.  Never raises."""
+        path = self._path(ident)
+        if not os.path.exists(path):
+            with self._lock:
+                self.disk_misses += 1
+            self._obs("disk_miss")
+            return None
+        try:
+            with open(path, "rb") as fh:
+                entry = pickle.load(fh)
+            if entry.get("ident") != ident:
+                # A digest collision or a stale/foreign entry: the bytes
+                # were compiled for a different program — refuse them.
+                raise ValueError(
+                    f"entry identity mismatch: {entry.get('ident')!r}")
+            from jax.experimental import serialize_executable as se
+
+            compiled = se.deserialize_and_load(
+                entry["payload"], entry["in_tree"], entry["out_tree"])
+        except Exception as e:  # any defect: quarantine, fall back
+            self._quarantine(path, e)
+            return None
+        with self._lock:
+            self.disk_hits += 1
+        self._obs("disk_hit")
+        return compiled
+
+    def _quarantine(self, path: str, error: Exception) -> None:
+        try:
+            os.replace(path, path + ".quarantined")
+        except OSError:
+            pass
+        with self._lock:
+            self.quarantined += 1
+        run = obs.get_run()
+        if run is not None:
+            run.counter("serve_aot_quarantined_total",
+                        "corrupt/stale persisted executables set aside").inc()
+            run.event("aot_entry_quarantined", phase="serve", path=path,
+                      error=f"{type(error).__name__}: {error}")
+
+    # -- writing -------------------------------------------------------------
+
+    def store(self, ident: dict, compiled) -> bool:
+        """Serialize + atomically persist one compiled executable.  Write
+        failures are swallowed (the disk tier must never take a solve
+        down); returns whether the entry landed."""
+        try:
+            from jax.experimental import serialize_executable as se
+
+            payload, in_tree, out_tree = se.serialize(compiled)
+            blob = pickle.dumps({"ident": ident, "payload": payload,
+                                 "in_tree": in_tree, "out_tree": out_tree})
+            path = self._path(ident)
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        except Exception as e:
+            with self._lock:
+                self.store_errors += 1
+            run = obs.get_run()
+            if run is not None:
+                run.event("aot_store_failed", phase="serve",
+                          error=f"{type(e).__name__}: {e}")
+            return False
+        with self._lock:
+            self.stores += 1
+        run = obs.get_run()
+        if run is not None:
+            run.counter("serve_aot_stores_total",
+                        "compiled executables persisted to the disk "
+                        "tier").inc()
+        return True
+
+    def _obs(self, outcome: str) -> None:
+        run = obs.get_run()
+        if run is None:
+            return
+        run.counter("serve_cache_requests_total",
+                    "executable-cache lookups by outcome").inc(
+            outcome=outcome)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"root": self.root, "disk_hits": self.disk_hits,
+                    "disk_misses": self.disk_misses, "stores": self.stores,
+                    "quarantined": self.quarantined,
+                    "store_errors": self.store_errors}
+
+
+class AOTExecutable:
+    """A cache entry backed by the persistent disk tier.
+
+    The disk-tier sibling of ``obs.profile.ProfiledExecutable``: wraps
+    the jitted program the in-memory cache would otherwise store, and
+    resolves each distinct static-argument combination exactly once
+    through three tiers — disk load (no XLA, ``compile_profile`` event
+    with ``disk_hit=True`` and the load seconds), else AOT compile
+    (through ``aot_compile_profile`` when telemetry is on, so the compile
+    lands in ``serve_compile_seconds_total``; a bare ``lower().compile()``
+    otherwise), then a disk store so the NEXT replica skips the compile.
+    Later calls dispatch the resolved executable with static kwargs
+    stripped."""
+
+    def __init__(self, jitfn, disk: AOTDiskCache, key: str, label: str,
+                 static_names: tuple = (), **extra):
+        self._jitfn = jitfn
+        self._disk = disk
+        self._key = str(key)
+        self._label = str(label)
+        self._static = tuple(static_names)
+        self._extra = dict(extra)
+        self._compiled: dict[tuple, object] = {}  # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def __call__(self, *args, **kwargs):
+        combo = tuple(sorted(
+            (k, kwargs[k]) for k in self._static if k in kwargs))
+        with self._lock:
+            compiled = self._compiled.get(combo)
+        if compiled is None:
+            compiled = self._obtain(combo, args, kwargs)
+            with self._lock:
+                compiled = self._compiled.setdefault(combo, compiled)
+        dyn = {k: v for k, v in kwargs.items() if k not in self._static}
+        return compiled(*args, **dyn)
+
+    def _obtain(self, combo: tuple, args, kwargs):
+        ident = entry_identity(self._key, combo)
+        run = obs.get_run()
+        t0 = time.monotonic()
+        compiled = self._disk.load(ident)
+        if compiled is not None:
+            if run is not None:
+                # The cold-start proof: a disk hit reports its I/O time
+                # under the same event family as compiles, but touches
+                # serve_compile_seconds_total NOT AT ALL — a restarted
+                # replica serving only seen fingerprints keeps it at 0.
+                run.event("compile_profile", phase="serve", key=self._key,
+                          label=self._label, disk_hit=True,
+                          load_s=time.monotonic() - t0,
+                          static=dict(combo) or None, **self._extra)
+            return compiled
+        with _self_contained_compile():
+            if run is not None:
+                from ...obs.profile import aot_compile_profile
+
+                compiled = aot_compile_profile(
+                    run, self._jitfn, args, kwargs, self._key, self._label,
+                    static=dict(combo) or None, disk_hit=False,
+                    **self._extra)
+            else:
+                compiled = self._jitfn.lower(*args, **kwargs).compile()
+        self._disk.store(ident, compiled)
+        return compiled

@@ -95,8 +95,17 @@ def _handle_request(server: SolveServer, frame: dict, ctx) -> dict:
         )
         res = server.submit(req).result()
     except OverCapacityError as e:
-        return {"ok": np.int8(0), "shed": np.int8(1),
-                "reason": _pack_str(e.reason), "error": _pack_str(str(e))}
+        reply = {"ok": np.int8(0), "shed": np.int8(1),
+                 "reason": _pack_str(e.reason), "error": _pack_str(str(e))}
+        if e.reason == "closed":
+            # Disclose a drain/shutdown shed distinctly: the client should
+            # reconnect (to the fleet's next replica), not back off.
+            try:
+                draining = bool(server.status().get("draining"))
+            except Exception:
+                draining = False
+            reply["draining"] = np.int8(draining)
+        return reply
     except Exception as e:  # bad payload, solver failure: structured reply
         return {"ok": np.int8(0), "error": _pack_str(f"{type(e).__name__}: {e}")}
     return {
@@ -126,7 +135,12 @@ class ServeFrontend:
         self.wire_format = wire_format
         self._listener = listen_tcp(host, port)
         self.host, self.port = self._listener.getsockname()[:2]
-        self._transports: list[TcpTransport] = []
+        #: Each connection pairs its transport with a send lock: handler
+        #: replies and ``close()``'s teardown serialize on it, so a reply
+        #: for a request that was in flight when shutdown began either
+        #: lands whole before the socket closes or is skipped cleanly —
+        #: never interleaved with the close.
+        self._transports: list[tuple[TcpTransport, threading.Lock]] = []
         self._lock = threading.Lock()
         self._closed = False
         self._accepter = threading.Thread(target=self._accept, daemon=True,
@@ -142,15 +156,29 @@ class ServeFrontend:
             tr = TcpTransport(sock, src="serve-frontend",
                               max_frame_bytes=self.max_frame_bytes,
                               wire_format=self.wire_format)
+            send_lock = threading.Lock()
             with self._lock:
                 if self._closed:
                     tr.close()
                     return
-                self._transports.append(tr)
-            threading.Thread(target=self._serve_conn, args=(tr,),
+                self._transports.append((tr, send_lock))
+            threading.Thread(target=self._serve_conn, args=(tr, send_lock),
                              daemon=True).start()
 
-    def _serve_conn(self, tr: TcpTransport) -> None:
+    def _send(self, tr: TcpTransport, send_lock: threading.Lock,
+              reply: dict) -> bool:
+        """Send one reply under the connection's send lock.  A teardown
+        that already began (``close()`` holds the lock while closing the
+        socket) makes this a clean no-op instead of a write racing the
+        close; returns whether the reply was delivered."""
+        with send_lock:
+            with self._lock:
+                if self._closed:
+                    return False
+            tr.send(reply)
+            return True
+
+    def _serve_conn(self, tr: TcpTransport, send_lock: threading.Lock) -> None:
         while True:
             try:
                 frame = tr.recv()
@@ -158,18 +186,24 @@ class ServeFrontend:
                 return
             except ProtocolError as e:
                 try:
-                    tr.send({"ok": np.int8(0),
-                             "error": _pack_str(f"protocol error: {e}")})
+                    if not self._send(tr, send_lock, {
+                            "ok": np.int8(0),
+                            "error": _pack_str(f"protocol error: {e}")}):
+                        return
                     continue
                 except (TransportClosed, ProtocolError):
                     return
             try:
-                tr.send(handle_request(self.server, frame))
+                if not self._send(tr, send_lock,
+                                  handle_request(self.server, frame)):
+                    return
             except ProtocolError as e:
                 # Reply exceeds the frame cap: report instead of dying.
                 try:
-                    tr.send({"ok": np.int8(0),
-                             "error": _pack_str(f"reply too large: {e}")})
+                    if not self._send(tr, send_lock, {
+                            "ok": np.int8(0),
+                            "error": _pack_str(f"reply too large: {e}")}):
+                        return
                 except (TransportClosed, ProtocolError):
                     return
             except TransportClosed:
@@ -183,8 +217,12 @@ class ServeFrontend:
             self._listener.close()
         except OSError:
             pass
-        for tr in transports:
-            tr.close()
+        for tr, send_lock in transports:
+            # Serialize with any in-flight reply: a handler mid-send
+            # finishes its frame first; handlers that arrive after see
+            # ``_closed`` and skip the send entirely.
+            with send_lock:
+                tr.close()
 
     def __enter__(self) -> "ServeFrontend":
         return self
@@ -256,4 +294,6 @@ def solve_g2o(host: str, port: int, g2o, num_robots: int,
         out["shed"] = bool(int(np.asarray(reply.get("shed", 0))))
         if "reason" in reply:
             out["reason"] = _unpack_str(reply["reason"])
+        if "draining" in reply:
+            out["draining"] = bool(int(np.asarray(reply["draining"])))
     return out

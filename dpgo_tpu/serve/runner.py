@@ -140,7 +140,20 @@ def _cached_exec(cache: ExecutableCache, fp: dict, make,
     telemetry fence: with a run live, the cached entry is a
     ``ProfiledExecutable`` (AOT compile + cost/memory analysis recorded
     per fingerprint key); with telemetry off the bare jit wrapper is
-    stored and no profiling object ever exists."""
+    stored and no profiling object ever exists.
+
+    A cache carrying a persistent disk tier stores ``AOTExecutable``
+    entries instead, on BOTH telemetry paths: the disk tier is a
+    durability feature (replica restarts must skip XLA with telemetry
+    off too), and the wrapper keeps its own obs emission behind the
+    fence."""
+    if cache.disk is not None:
+        from .fleet.aotcache import AOTExecutable
+
+        return cache.get(fp, lambda: AOTExecutable(
+            make(), cache.disk, key=fingerprint_key(fp),
+            label=fp.get("kind", "?"), static_names=static_names,
+            bucket=fp.get("bucket_shape"), batch=fp.get("batch")))
     run = obs.get_run()
     if run is None:
         return cache.get(fp, make)
@@ -155,7 +168,8 @@ def _cached_exec(cache: ExecutableCache, fp: dict, make,
 def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
                max_iters: int | None = None, grad_norm_tol: float = 0.1,
                eval_every: int = 1, verdict_every: int | None = None,
-               session_cb=None, session_every: int = 1):
+               session_cb=None, session_every: int = 1,
+               should_stop=None):
     """Solve a list of same-bucket padded problems as one batched program.
 
     Returns ``(results, info)``: per-problem ``RBCDResult`` (trajectories
@@ -178,9 +192,18 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
     (and at the verdict-mode K boundaries) with the per-problem sliced
     solver states, so a server can persist resumable snapshots while the
     batch is in flight.  A member problem carrying ``state0`` resumes
-    from that exact state instead of its ``X0`` init."""
+    from that exact state instead of its ``X0`` init.
+
+    ``should_stop()`` — the live-migration hook (``serve.fleet``):
+    polled at eval/verdict boundaries, AFTER the boundary's
+    ``session_cb`` snapshot lands (when one is due it is forced, so a
+    stopping batch always leaves a resume point).  A True return breaks
+    the loop early; the partial results return as usual and ``info``
+    carries ``interrupted=True`` so the server can evacuate instead of
+    replying."""
     if not padded:
-        return [], {"rounds": 0, "evals": 0, "batch": 0, "occupancy": 0.0}
+        return [], {"rounds": 0, "evals": 0, "batch": 0, "occupancy": 0.0,
+                    "interrupted": False}
     first = padded[0]
     meta, params, dtype = first.meta, first.prob.params, first.prob.dtype
     shape = first.shape
@@ -240,6 +263,7 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
     gn_hist = [[] for _ in range(B_real)]
     term = ["max_iters"] * B_real
     iters = [max_iters] * B_real
+    interrupted = False
     run = obs.get_run()
 
     if verdict_every is not None:
@@ -300,6 +324,11 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
                 # Snapshot at the verdict boundary: the live batch state is
                 # on hand and the window's segments have already retired.
                 session_cb(it, _slice_states(state_b, B_real))
+            if should_stop is not None and should_stop():
+                # Stop AFTER the boundary snapshot: the batch leaves a
+                # resume point at exactly this iteration.
+                interrupted = True
+                break
             all_terminal = ((wv & 7) != rbcd.VERDICT_RUNNING).all()
             if it >= max_iters or bool(all_terminal):
                 break
@@ -321,7 +350,8 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
             cost_hist[b] = [float(hist_h[b, r, 0]) for r in range(n_keep)]
             gn_hist[b] = [float(hist_h[b, r, 1]) for r in range(n_keep)]
 
-    while verdict_every is None and it < max_iters and not all(done):
+    while verdict_every is None and it < max_iters and not all(done) \
+            and not interrupted:
         target = min(((it // eval_every) + 1) * eval_every, max_iters)
         t_d0 = time.monotonic() if run is not None else 0.0
         with span("device_dispatch", phase="serve", batch=B):
@@ -348,8 +378,14 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
                         "cumulative batched-dispatch wall-clock",
                         unit="s").inc(dt)
         evals += 1
-        if session_cb is not None and evals % max(int(session_every), 1) == 0:
+        stop = should_stop is not None and should_stop()
+        if session_cb is not None and (
+                stop or evals % max(int(session_every), 1) == 0):
+            # A stopping batch forces the boundary snapshot even when the
+            # cadence would skip it — migration needs the resume point.
             session_cb(it, _slice_states(state_b, B_real))
+        if stop:
+            interrupted = True
         for b in range(B_real):
             if done[b]:
                 continue
@@ -378,5 +414,6 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
             weights=jnp.asarray(w_b[b, :p.prob.num_meas]),
         ))
     info = {"rounds": it, "evals": evals, "batch": B,
-            "size": B_real, "occupancy": B_real / float(B)}
+            "size": B_real, "occupancy": B_real / float(B),
+            "interrupted": interrupted}
     return results, info

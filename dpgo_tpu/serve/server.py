@@ -47,11 +47,14 @@ exists even when their knobs are set.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import os
 import threading
 import time
 from collections import deque
 
+import jax
 import jax.numpy as jnp
 
 from .. import obs
@@ -207,6 +210,10 @@ class SolveTicket:
         #: set when this request was re-admitted from a session snapshot
         #: after a worker crash; stamped onto its result as ``recovered``.
         self._recovered = False
+        #: snapshot iteration this request resumed from (``serve.fleet``
+        #: migration: a drained session re-admitted on another replica
+        #: picks up mid-schedule); 0 = cold start.
+        self._resumed_from = 0
         # tracing context (set by submit() only when telemetry is on)
         self.trace_id: int | None = None
         self.span_admission: int | None = None
@@ -262,7 +269,11 @@ class SolveServer:
                  verdict_every: int | None = None,
                  session_store: "SessionStore | str | None" = None,
                  session_every: int = 1,
-                 worker_restarts: int = 2):
+                 worker_restarts: int = 2,
+                 replica_id: str | None = None,
+                 device=None,
+                 resume_sessions: bool = False,
+                 aot_cache_dir: str | None = None):
         if max_batch < 1 or max_queue < 1:
             raise ValueError("max_batch and max_queue must be >= 1")
         self.max_batch = int(max_batch)
@@ -291,7 +302,30 @@ class SolveServer:
         #: giving up and shedding the queue (a crash-looping device should
         #: fail loudly, not spin).
         self.worker_restarts = max(int(worker_restarts), 0)
-        self.cache = ExecutableCache()
+        #: Fleet identity (``serve.fleet``): which replica this server is,
+        #: and the ``jax.Device`` its dispatches bind to (None = default
+        #: device).  Identity is reported by ``status()``/``/healthz`` so
+        #: the router's health poll and ``report --live`` can tell
+        #: replicas apart.
+        self.replica_id = replica_id
+        self.device = device
+        #: Fleet migration: admit session-tagged requests from their
+        #: newest store snapshot (same bucket) instead of cold — the
+        #: receiving half of ``drain()``.  Off by default: the
+        #: single-replica crash-recovery path re-admits explicitly and
+        #: must not also resume retried requests implicitly.
+        self.resume_sessions = bool(resume_sessions)
+        #: One ``_run_batch`` sets this with the batch still stoppable;
+        #: ``drain()``/``kill()`` set it to break the in-flight batch at
+        #: its next eval boundary (after the boundary snapshot lands).
+        self._interrupt = threading.Event()
+        disk = None
+        if aot_cache_dir is not None:
+            # Lazy import: fleet's router/manager import this module.
+            from .fleet.aotcache import AOTDiskCache
+
+            disk = AOTDiskCache(aot_cache_dir)
+        self.cache = ExecutableCache(disk=disk)
         # One condition serializes ALL cross-thread server state: client
         # threads (submit/status/sidecar scrapes), the worker, and close.
         self._cond = threading.Condition()
@@ -302,7 +336,17 @@ class SolveServer:
         self._terminated = False                      # guarded-by: _cond
         self._active: list[SolveTicket] = []          # guarded-by: _cond
         self._crashes = 0                             # guarded-by: _cond
+        #: Live-migration mode: ``drain()`` collects interrupted and
+        #: still-queued tickets here instead of finishing them, so the
+        #: router can re-admit each on another replica.
+        self._evacuating = False                      # guarded-by: _cond
+        self._evacuated: list[SolveTicket] = []       # guarded-by: _cond
         self._t0_mono = time.monotonic()
+        self._t0_wall = time.time()
+        self._pid = os.getpid()
+        dev = device if device is not None else jax.devices()[0]
+        self._device_info = {"platform": str(dev.platform),
+                             "ordinal": int(dev.id)}
         # Plain-int liveness tallies for /statusz (server state, not obs).
         self._n_batches = 0                           # guarded-by: _cond
         self._n_requests = 0                          # guarded-by: _cond
@@ -424,7 +468,7 @@ class SolveServer:
         of distinct buckets warmed."""
         groups: dict[str, list] = {}
         for req in requests:
-            padded, key = self._prepare(req)
+            padded, key, _ = self._prepare(req)
             groups.setdefault(key, []).append((padded, req))
         for members in groups.values():
             padded_list = [p for p, _ in members][:self.max_batch]
@@ -467,6 +511,72 @@ class SolveServer:
         if self._profiler is not None:
             self._profiler.close()
 
+    def drain(self) -> "list[SolveTicket]":
+        """Live-migration drain (``serve.fleet``): stop admission, break
+        the in-flight batch at its next eval boundary (AFTER that
+        boundary's session snapshot lands), and return every unanswered
+        ticket — interrupted in-flight members plus still-queued requests
+        — for the caller to re-admit elsewhere.  Session-tagged tickets
+        leave fresh snapshots in the store, so re-admission on a
+        ``resume_sessions`` replica continues mid-schedule.  Unlike
+        ``close(drain=True)``, which lets the in-flight batch COMPLETE
+        and reply, this hands the work back; the server terminates either
+        way."""
+        queued = 0
+        with self._cond:
+            first = not self._closed
+            if first:
+                self._evacuating = True
+                self._draining = True
+                self._closed = True
+                self._interrupt.set()
+                self._cond.notify_all()
+                queued = len(self._pending)
+        run = obs.get_run()
+        if first and run is not None:
+            run.event("server_draining", phase="serve", migrate=True,
+                      queued=queued, replica=self.replica_id)
+        self._worker.join()
+        with self._cond:
+            evacuated = list(self._evacuated)
+            self._evacuated = []
+            term, self._terminated = self._terminated, True
+        if not term:
+            if self.sidecar is not None:
+                self.sidecar.close()
+            if self._profiler is not None:
+                self._profiler.close()
+        if run is not None:
+            run.event("server_drained", phase="serve",
+                      replica=self.replica_id, evacuated=len(evacuated))
+        return evacuated
+
+    def kill(self) -> None:
+        """Hard stop — the fleet bench's chaos lever and the manager's
+        last resort.  Admission stops immediately, the in-flight batch is
+        interrupted at its next eval boundary and shed with
+        ``reason="closed"``, queued requests shed the same way.  Session-
+        tagged requests keep their boundary snapshots, so a router retry
+        on another replica resumes instead of restarting."""
+        with self._cond:
+            if not self._closed:
+                self._closed = True
+                self._interrupt.set()
+                self._cond.notify_all()
+        self._worker.join()
+        with self._cond:
+            if self._terminated:
+                return
+            self._terminated = True
+        if self.sidecar is not None:
+            self.sidecar.close()
+        if self._profiler is not None:
+            self._profiler.close()
+        run = obs.get_run()
+        if run is not None:
+            run.event("replica_killed", phase="serve",
+                      replica=self.replica_id)
+
     def status(self) -> dict:
         """Live operational snapshot — the ``/statusz`` payload, shared
         with ``python -m dpgo_tpu.obs.report --live``.  Plain server
@@ -478,6 +588,10 @@ class SolveServer:
             # server is still finishing work and reports that instead.
             closed = self._terminated
             draining = self._draining and not self._terminated
+            # "accepting" is the fleet manager's liveness probe: False the
+            # moment admission stops (drain begun, kill, crash-loop
+            # give-up), before the terminal "closed" flips.
+            accepting = not self._closed
             crashes = self._crashes
             n_requests = self._n_requests
             n_batches = self._n_batches
@@ -500,6 +614,17 @@ class SolveServer:
             "uptime_s": time.monotonic() - self._t0_mono,
             "closed": closed,
             "draining": draining,
+            "accepting": accepting,
+            # Replica identity (fleet satellite): which process/device
+            # this server is, so a router health poll or report --live
+            # can tell replicas apart.  replica_id is None outside a
+            # fleet.
+            "replica": {
+                "replica_id": self.replica_id,
+                "pid": self._pid,
+                "start_time": self._t0_wall,
+                "device": dict(self._device_info),
+            },
             "worker_crashes": crashes,
             "queue_depth": queue_depth,
             "max_queue": self.max_queue,
@@ -524,17 +649,44 @@ class SolveServer:
 
     # -- worker -------------------------------------------------------------
 
+    def _dev_ctx(self):
+        """The replica's device-binding scope: inside it, every array the
+        prepare/dispatch path materializes commits to the bound device
+        instead of the process default (no-op for an unbound server)."""
+        return jax.default_device(self.device) if self.device is not None \
+            else contextlib.nullcontext()
+
     def _prepare(self, req: SolveRequest):
         """Problem build + bucket padding for one request; returns the
-        padded problem and its full batch-compatibility key."""
-        prob = prepare_problem(req.meas, req.num_robots, params=req.params,
-                               dtype=req.dtype, init=None, pallas_sel=False)
-        shape = bucket_shape_of(prob, quantum=self.quantum)
-        padded = pad_problem(prob, shape, init=self.init)
+        padded problem, its full batch-compatibility key, and the snapshot
+        iteration it resumes from (0 = cold start).
+
+        With ``resume_sessions`` on (the fleet migration path), a
+        session-tagged request whose store carries a snapshot of the SAME
+        bucket shape resumes from that exact state: ``state0`` is stamped
+        and the resume point folds into the batch key, so only requests
+        at the same schedule position batch together.  A shape-mismatched
+        or absent snapshot falls back to a cold solve — resume is an
+        optimization of correctness already guaranteed by re-solving."""
+        with self._dev_ctx():
+            prob = prepare_problem(req.meas, req.num_robots,
+                                   params=req.params, dtype=req.dtype,
+                                   init=None, pallas_sel=False)
+            shape = bucket_shape_of(prob, quantum=self.quantum)
+            padded = pad_problem(prob, shape, init=self.init)
         fp = problem_fingerprint(padded.meta, prob.params, req.dtype, shape)
         fp["termination"] = [req.max_iters or prob.params.max_num_iters,
                              req.grad_norm_tol, req.eval_every]
-        return padded, fingerprint_key(fp)
+        resumed_from = 0
+        if self.resume_sessions and self.session_store is not None \
+                and req.session_id is not None:
+            snap = self.session_store.load_newest(req.session_id)
+            if snap is not None and snap.meta.get("bucket") == list(shape):
+                padded = dataclasses.replace(padded, state0=snap.state)
+                resumed_from = int(snap.iteration)
+        if resumed_from:
+            fp["resume"] = resumed_from
+        return padded, fingerprint_key(fp), resumed_from
 
     def _release(self, tickets) -> None:
         with self._cond:
@@ -634,6 +786,11 @@ class SolveServer:
                 if self._closed:
                     leftovers = list(self._pending)
                     self._pending.clear()
+                    evacuate = self._evacuating
+                    if evacuate:
+                        # Migration drain: queued work is evacuated for
+                        # the router to re-admit, not shed.
+                        self._evacuated.extend(leftovers)
                     break
                 n_pending = len(self._pending)
             # Batching window: give concurrent submitters a moment to
@@ -643,9 +800,11 @@ class SolveServer:
                                     pending=n_pending):
                     time.sleep(self.batch_window_s)
             self._dispatch_once()
-        for t in leftovers:
-            t._finish(exception=OverCapacityError(
-                "server closed with request still queued", reason="closed"))
+        if not evacuate:
+            for t in leftovers:
+                t._finish(exception=OverCapacityError(
+                    "server closed with request still queued",
+                    reason="closed"))
         self._release(leftovers)
 
     def _dispatch_once(self) -> None:
@@ -669,7 +828,13 @@ class SolveServer:
                                         parent_id=t.span_admission)
                 try:
                     with sp or obs_trace.NULL_SPAN:
-                        t._padded, t._key = self._prepare(t.request)
+                        t._padded, t._key, t._resumed_from = \
+                            self._prepare(t.request)
+                    if t._resumed_from:
+                        # Migration resume is a recovery-from-snapshot:
+                        # the reply discloses it the same way the crash
+                        # path does.
+                        t._recovered = True
                 except Exception as e:  # bad request: report, don't die
                     t._finish(exception=e)
                     failed.append(t)
@@ -745,11 +910,28 @@ class SolveServer:
             ve = self.verdict_every
             if ve is not None and ve % max(req0.eval_every, 1) != 0:
                 ve = None  # incompatible cadence: legacy per-eval loop
-            results, info = run_bucket(
-                [t._padded for t in tickets], self.cache,
-                max_iters=req0.max_iters, grad_norm_tol=req0.grad_norm_tol,
-                eval_every=req0.eval_every, verdict_every=ve,
-                session_cb=session_cb, session_every=self.session_every)
+            max_iters = req0.max_iters
+            resume0 = tickets[0]._resumed_from
+            if resume0:
+                # Resumed sessions run their REMAINING budget: the batch
+                # key folds the resume point in, so every member agrees.
+                # Floored at one eval so the reply always carries a
+                # history row (extra rounds only polish — monotone under
+                # the plain schedule).
+                base = max_iters if max_iters is not None \
+                    else tickets[0]._padded.prob.params.max_num_iters
+                max_iters = max(base - resume0, max(req0.eval_every, 1))
+            # Per-replica device binding (serve.fleet): every array this
+            # batch materializes commits to the replica's device instead
+            # of the process default, so co-resident replicas don't fight
+            # over one default device's queue.
+            with self._dev_ctx():
+                results, info = run_bucket(
+                    [t._padded for t in tickets], self.cache,
+                    max_iters=max_iters, grad_norm_tol=req0.grad_norm_tol,
+                    eval_every=req0.eval_every, verdict_every=ve,
+                    session_cb=session_cb, session_every=self.session_every,
+                    should_stop=self._interrupt.is_set)
         except Exception as e:
             for t in tickets:
                 t._finish(exception=e)
@@ -758,6 +940,34 @@ class SolveServer:
                 self._active = []
             if dsp is not None:
                 dsp.__exit__(type(e), e, None)
+            if self._profiler is not None:
+                self._profiler.batch_end()
+            return
+        if info.get("interrupted"):
+            # drain()/kill() broke the batch at an eval boundary (the
+            # boundary snapshot already landed): nobody gets a reply from
+            # this partial solve.  Draining evacuates the tickets for the
+            # router to re-admit elsewhere; a kill sheds them (session-
+            # tagged requests resume from their snapshots on retry).
+            with self._cond:
+                self._active = []
+                evacuating = self._evacuating
+                if evacuating:
+                    self._evacuated.extend(tickets)
+            if not evacuating:
+                for t in tickets:
+                    t._finish(exception=OverCapacityError(
+                        "replica killed with the batch in flight; "
+                        "session-tagged requests resume from their last "
+                        "snapshot", reason="closed"))
+            self._release(tickets)
+            if run is not None:
+                run.event("batch_interrupted", phase="serve",
+                          size=len(tickets), evacuating=evacuating,
+                          replica=self.replica_id)
+            if dsp is not None:
+                dsp.add(interrupted=True)
+                dsp.__exit__(None, None, None)
             if self._profiler is not None:
                 self._profiler.batch_end()
             return
@@ -815,8 +1025,17 @@ class SolveServer:
 
         def cb(iteration, states):
             for i, sid in tagged:
-                store.save(sid, states[i], iteration=iteration,
-                           meta={"tenant": tickets[i].request.tenant})
+                t = tickets[i]
+                # Snapshot sequence numbers are ABSOLUTE session
+                # iterations: a resumed batch counts from zero, so its
+                # resume base is added back — a later migration of the
+                # same session budgets its remaining iterations right.
+                # The bucket shape rides the meta so only a same-shape
+                # server resumes the state (serve.fleet migration).
+                store.save(sid, states[i],
+                           iteration=int(iteration) + t._resumed_from,
+                           meta={"tenant": t.request.tenant,
+                                 "bucket": list(t._padded.shape)})
         return cb
 
     # -- telemetry (every site behind the zero-overhead fence) --------------

@@ -71,11 +71,17 @@ class MetricsSidecar:
                         # stop routing BEFORE the hard 503.
                         st = sidecar.server.status()
                         closed = st["closed"]
-                        body = json.dumps(
-                            {"ok": not closed,
-                             "draining": st.get("draining", False),
-                             "uptime_s": st["uptime_s"],
-                             "run": sidecar.run.run_id}).encode("utf-8")
+                        payload = {"ok": not closed,
+                                   "draining": st.get("draining", False),
+                                   "uptime_s": st["uptime_s"],
+                                   "run": sidecar.run.run_id}
+                        # Replica identity (serve.fleet): lets a prober
+                        # tell WHICH replica answered — id, pid, device —
+                        # the distinction the router/manager health loop
+                        # and rolling-restart tooling key on.
+                        if st.get("replica") is not None:
+                            payload["replica"] = st["replica"]
+                        body = json.dumps(payload).encode("utf-8")
                         ctype = "application/json"
                         code = 200 if not closed else 503
                     elif path == "/statusz":

@@ -1,0 +1,161 @@
+"""Persistent AOT executable cache (``serve.fleet.aotcache``): disk
+round-trips across fresh caches, identity-mismatch refusal, corrupt-entry
+quarantine with fail-open fallback, and the cold-start acceptance pin —
+a restarted server's first solve with ``serve_compile_seconds_total``
+exactly 0 (ISSUE 13).
+
+The server-level test carries no ``allow_leaks`` marker on purpose: a
+warm restart through the disk tier must tear down as cleanly as a cold
+one (leakcheck-enforced)."""
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpgo_tpu import obs
+from dpgo_tpu.config import AgentParams
+from dpgo_tpu.serve import SolveRequest, SolveServer
+from dpgo_tpu.serve.fleet import aotcache
+from dpgo_tpu.serve.fleet.aotcache import (AOTDiskCache, AOTExecutable,
+                                           entry_identity)
+from dpgo_tpu.utils.synthetic import make_measurements
+
+PARAMS = AgentParams(d=3, r=5, num_robots=2)
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_run():
+    obs.end_run()
+    yield
+    obs.end_run()
+
+
+def _problem(seed=0, n=24):
+    rng = np.random.default_rng(seed)
+    meas, _ = make_measurements(rng, n=n, d=3, num_lc=8, rot_noise=0.01,
+                                trans_noise=0.01)
+    return meas
+
+
+def _req(meas):
+    return SolveRequest(meas=meas, num_robots=2, params=PARAMS,
+                        max_iters=4, grad_norm_tol=1e-12, eval_every=2)
+
+
+def _compiled():
+    jitfn = jax.jit(lambda x: x * 2.0 + 1.0)
+    return jitfn, jitfn.lower(jnp.ones(4)).compile()
+
+
+# ---------------------------------------------------------------------------
+# AOTDiskCache mechanics
+# ---------------------------------------------------------------------------
+
+def test_disk_round_trip(tmp_path):
+    _, compiled = _compiled()
+    ident = entry_identity("fp-A", ())
+    ds = AOTDiskCache(str(tmp_path / "aot"))
+    assert ds.load(ident) is None  # plain miss first
+    assert ds.store(ident, compiled)
+    loaded = AOTDiskCache(str(tmp_path / "aot")).load(ident)  # fresh tier
+    assert loaded is not None
+    np.testing.assert_array_equal(np.asarray(loaded(jnp.ones(4))),
+                                  np.asarray(compiled(jnp.ones(4))))
+    st = ds.stats()
+    assert st["disk_misses"] == 1 and st["stores"] == 1
+
+
+def test_identity_mismatch_refused_and_quarantined(tmp_path):
+    """A stale/colliding entry whose embedded identity disagrees with the
+    requested one is never deserialized: quarantined aside, load returns
+    None (the caller recompiles)."""
+    _, compiled = _compiled()
+    ident = entry_identity("fp-A", ())
+    ds = AOTDiskCache(str(tmp_path / "aot"))
+    ds.store(ident, compiled)
+    path = ds._path(ident)
+    with open(path, "rb") as fh:
+        entry = pickle.load(fh)
+    entry["ident"] = dict(entry["ident"], fingerprint="fp-OTHER")
+    with open(path, "wb") as fh:
+        pickle.dump(entry, fh)
+    assert ds.load(ident) is None
+    assert ds.stats()["quarantined"] == 1
+    assert (tmp_path / "aot" / (path.split("/")[-1] + ".quarantined")).exists()
+
+
+def test_schema_version_keys_the_entry(tmp_path, monkeypatch):
+    """A schema bump changes the entry identity (and thus its path): old
+    entries become plain misses, never deserialization attempts."""
+    _, compiled = _compiled()
+    ds = AOTDiskCache(str(tmp_path / "aot"))
+    ds.store(entry_identity("fp-A", ()), compiled)
+    monkeypatch.setattr(aotcache, "AOT_CACHE_SCHEMA_VERSION",
+                        aotcache.AOT_CACHE_SCHEMA_VERSION + 1)
+    assert ds.load(entry_identity("fp-A", ())) is None
+    st = ds.stats()
+    assert st["disk_misses"] == 1 and st["quarantined"] == 0
+
+
+def test_corrupt_entry_quarantined_and_fail_open(tmp_path):
+    """Garbage bytes on disk: the executable wrapper quarantines the
+    entry, falls back to a fresh compile (fail-open — no exception ever
+    reaches the caller), and re-persists a good entry."""
+    jitfn, _ = _compiled()
+    ds = AOTDiskCache(str(tmp_path / "aot"))
+    ident = entry_identity("fp-K", ())
+    with open(ds._path(ident), "wb") as fh:
+        fh.write(b"\x00not a pickle")
+    ex = AOTExecutable(jitfn, ds, key="fp-K", label="test")
+    np.testing.assert_array_equal(np.asarray(ex(jnp.ones(4))),
+                                  np.full(4, 3.0))
+    st = ds.stats()
+    assert st["quarantined"] == 1 and st["stores"] == 1
+    # The re-persisted entry serves the next fresh process.
+    assert AOTDiskCache(str(tmp_path / "aot")).load(ident) is not None
+
+
+def test_store_failure_swallowed(tmp_path):
+    """An unserializable 'executable' must not raise out of store()."""
+    ds = AOTDiskCache(str(tmp_path / "aot"))
+    assert ds.store(entry_identity("fp-B", ()), object()) is False
+    assert ds.stats()["store_errors"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Server-level cold-start pin (the ISSUE 13 acceptance)
+# ---------------------------------------------------------------------------
+
+def test_warm_restart_first_solve_skips_xla(tmp_path):
+    """Cold server compiles + persists; a FRESH server on the same cache
+    root serves its first solve with ``serve_compile_seconds_total``
+    exactly 0 and only disk hits — XLA never ran on the restart."""
+    meas = _problem()
+    aot = str(tmp_path / "aot")
+    with SolveServer(max_batch=2, batch_window_s=0.0,
+                     aot_cache_dir=aot) as srv:
+        base = srv.solve(_req(meas), timeout=600)
+        assert srv.cache.stats()["disk"]["stores"] >= 1
+    with obs.run_scope(str(tmp_path / "run")) as run:
+        with SolveServer(max_batch=2, batch_window_s=0.0,
+                         aot_cache_dir=aot) as srv:
+            res = srv.solve(_req(meas), timeout=600)
+            disk = srv.cache.stats()["disk"]
+        compile_s = sum(run.counter(
+            "serve_compile_seconds_total",
+            "wall-clock spent in XLA compiles of serving executables",
+            unit="s").series().values())
+        lookups = run.counter("serve_cache_requests_total",
+                              "executable-cache lookups by outcome")
+        disk_hit_lookups = lookups.value(outcome="disk_hit")
+    assert compile_s == 0.0
+    assert disk["disk_hits"] >= 1 and disk["disk_misses"] == 0
+    assert disk["quarantined"] == 0
+    assert disk_hit_lookups >= 1
+    np.testing.assert_allclose(np.asarray(res.T), np.asarray(base.T),
+                               rtol=1e-12)
+    np.testing.assert_array_equal(np.asarray(res.cost_history),
+                                  np.asarray(base.cost_history))

@@ -445,8 +445,8 @@ def test_seeded_violations_fail_with_rule_and_location(tmp_path):
     serve.mkdir(parents=True)
     src = (REPO / "dpgo_tpu" / "serve" / "server.py").read_text()
     bad = src.replace(
-        "self.cache = ExecutableCache()",
-        "self.cache = ExecutableCache()\n"
+        "self.cache = ExecutableCache(disk=disk)",
+        "self.cache = ExecutableCache(disk=disk)\n"
         "        from ..obs.health import HealthMonitor\n"
         "        self._boom = HealthMonitor(None)")
     bad = bad.replace(
@@ -646,3 +646,19 @@ def test_sanctioned_verdict_fetches_stay_suppressed(monkeypatch):
                             project_config())
     assert any(f.rule == "DPG003" and "_host_fetch" in f.message
                for f in findings), findings
+
+
+def test_project_policy_covers_fleet_subpackage():
+    """The serve/fleet sub-subpackage (ISSUE 13) sits one directory level
+    deeper than the rest of the tree: pin that the project policy's
+    DPG002 globs reach it and that DPG004 (run-everywhere) applies, and
+    that the real fleet modules lint clean under the full policy."""
+    cfg = project_config()
+    for rel in ("dpgo_tpu/serve/fleet/router.py",
+                "dpgo_tpu/serve/fleet/manager.py",
+                "dpgo_tpu/serve/fleet/aotcache.py"):
+        assert cfg.applies("DPG002", rel), rel
+        assert cfg.applies("DPG004", rel), rel
+    findings = run_lint([str(REPO / "dpgo_tpu" / "serve" / "fleet")],
+                        project_config())
+    assert findings == [], findings

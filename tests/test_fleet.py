@@ -1,0 +1,346 @@
+"""Serve-fleet scale-out (``serve.fleet``): rendezvous session affinity,
+replica kill with zero-loss live migration, drain-migration bitwise
+parity, queue-wait autoscaling, the frontend drain race, and the fleet
+report section (ISSUE 13).
+
+None of these tests carry ``allow_leaks``: a fleet that killed and
+respawned replicas mid-solve must still tear down to zero orphan
+threads/sockets (leakcheck-enforced — the monitor thread, worker
+threads, and sidecars all die with ``router.close()``)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dpgo_tpu import obs
+from dpgo_tpu.config import AgentParams
+from dpgo_tpu.serve import (FleetRouter, ReplicaManager, SolveRequest,
+                            SolveServer)
+from dpgo_tpu.utils.synthetic import make_measurements
+
+#: Consensus unreachable (rel_change_tol < 0) + grad_norm_tol 0: solves
+#: run their full iteration budget, so long solves stay in flight long
+#: enough to kill/drain mid-schedule.
+PARAMS = AgentParams(d=3, r=5, num_robots=2, rel_change_tol=-1.0)
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_run():
+    obs.end_run()
+    yield
+    obs.end_run()
+
+
+def _problem(seed=0, n=24):
+    rng = np.random.default_rng(seed)
+    meas, _ = make_measurements(rng, n=n, d=3, num_lc=8, rot_noise=0.01,
+                                trans_noise=0.01)
+    return meas
+
+
+def _req(meas, sid=None, iters=2, eval_every=2):
+    return SolveRequest(meas=meas, num_robots=2, params=PARAMS,
+                        max_iters=iters, grad_norm_tol=0.0,
+                        eval_every=eval_every, session_id=sid)
+
+
+@pytest.fixture(scope="module")
+def meas():
+    return _problem()
+
+
+@pytest.fixture(scope="module")
+def aot_root(tmp_path_factory, meas):
+    """Shared persistent AOT cache: the first solve pays the compile,
+    every fleet test after that disk-loads in milliseconds."""
+    root = str(tmp_path_factory.mktemp("aot"))
+    with SolveServer(max_batch=2, batch_window_s=0.0,
+                     aot_cache_dir=root) as srv:
+        srv.solve(_req(meas), timeout=600)
+    return root
+
+
+def _fleet(n, aot_root, sess_root=None, max_replicas=None,
+           batch_window_s=0.0, **mgr_kw):
+    def make_server(rid):
+        return SolveServer(max_batch=2, batch_window_s=batch_window_s,
+                           replica_id=rid, aot_cache_dir=aot_root,
+                           session_store=sess_root, session_every=1,
+                           resume_sessions=sess_root is not None)
+
+    mgr = ReplicaManager(make_server, min_replicas=n,
+                         max_replicas=max_replicas,
+                         monitor_interval_s=0.05, **mgr_kw)
+    return FleetRouter(mgr)
+
+
+def _wait_for_snapshot(sess_root, sid, timeout=30.0):
+    """Block until the session has persisted at least one snapshot (the
+    state a migration will resume from)."""
+    import os
+
+    deadline = time.monotonic() + timeout
+    sdir = os.path.join(str(sess_root), sid)
+    while time.monotonic() < deadline:
+        if os.path.isdir(sdir) and any(
+                f.startswith("snap-") for f in os.listdir(sdir)):
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"no snapshot for {sid} within {timeout}s")
+
+
+# ---------------------------------------------------------------------------
+# Router: rendezvous affinity + status
+# ---------------------------------------------------------------------------
+
+def test_session_affinity_and_status(meas, aot_root):
+    with _fleet(2, aot_root) as router:
+        t1 = router.submit(_req(meas, sid="sess-A"))
+        t2 = router.submit(_req(meas, sid="sess-A"))
+        t3 = router.submit(_req(meas, sid="sess-B"))
+        for t in (t1, t2, t3):
+            t.result(timeout=600)
+        # Same session -> same replica, every time.
+        assert t1._replica is t2._replica
+        st = router.status()
+        assert st["n_replicas"] == 2 and st["accepting"]
+        rids = {row["replica_id"] for row in st["replicas"]}
+        assert rids == {"r0", "r1"}
+        assert sum(row["requests_served"] for row in st["replicas"]) >= 3
+        assert st["migrations"] == 0 and st["requests_routed"] == 3
+    assert router.status()["closed"]
+
+
+def test_affinity_survives_fleet_rebuild(meas, aot_root):
+    """Rendezvous hashing is a pure function of (key, replica ids): a
+    rebuilt fleet with the same replica ids routes the same sessions to
+    the same members — the property live migration relies on."""
+    owners = []
+    for _ in range(2):
+        with _fleet(2, aot_root) as router:
+            t = router.submit(_req(meas, sid="stable-sess"))
+            t.result(timeout=600)
+            owners.append(t._replica.replica_id)
+    assert owners[0] == owners[1]
+
+
+# ---------------------------------------------------------------------------
+# Kill + zero-loss migration (the chaos-soak acceptance, in miniature)
+# ---------------------------------------------------------------------------
+
+def test_kill_mid_solve_migrates_and_recovers(meas, aot_root, tmp_path):
+    sess_root = str(tmp_path / "sess")
+    with _fleet(2, aot_root, sess_root=sess_root) as router:
+        mgr = router.manager
+        t = router.submit(_req(meas, sid="live-1", iters=2500,
+                               eval_every=1))
+        _wait_for_snapshot(sess_root, "live-1")
+        victim = t._replica
+        mgr.kill_replica(victim.replica_id)
+        res = t.result(timeout=600)
+        # The solve completed on another replica, resumed from the
+        # snapshot (not restarted): fewer local iterations than the
+        # budget, flagged recovered.
+        assert t.migrations >= 1 and router.migrations >= 1
+        assert t._replica is not victim
+        assert res.recovered
+        assert res.terminated_by == "max_iters"
+        assert 0 < res.iterations < 2500
+        # The pool healed: the manager respawned to min_replicas.
+        assert mgr.status()["respawns"] >= 1
+        assert len(mgr.replicas()) == 2
+
+
+# ---------------------------------------------------------------------------
+# Drain-migration bitwise parity (satellite: migration must not perturb
+# the trajectory)
+# ---------------------------------------------------------------------------
+
+def test_drain_migration_bitwise_parity(meas, aot_root, tmp_path):
+    """A session drained from replica A and resumed on replica B produces
+    BITWISE-identical history rows to an undisturbed run: same compiled
+    programs (shared AOT cache), lossless npz snapshot round-trip, and a
+    resume that continues the exact iteration schedule."""
+    iters = 1500
+    with _fleet(1, aot_root, sess_root=str(tmp_path / "base")) as router:
+        base = router.submit(
+            _req(meas, sid="par", iters=iters, eval_every=1)).result(
+                timeout=600)
+    assert len(base.cost_history) == iters
+
+    sess_root = str(tmp_path / "mig")
+    with _fleet(2, aot_root, sess_root=sess_root) as router:
+        t = router.submit(_req(meas, sid="par", iters=iters, eval_every=1))
+        _wait_for_snapshot(sess_root, "par")
+        moved = router.migrate_from(t._replica)
+        assert moved == 1 and t.migrations == 1
+        res = t.result(timeout=600)
+    assert res.recovered
+    # The migrated run's histories are the suffix of the undisturbed
+    # run's, bit for bit — from its resume iteration to the end.
+    m = len(res.cost_history)
+    assert 0 < m < iters
+    np.testing.assert_array_equal(np.asarray(res.cost_history),
+                                  np.asarray(base.cost_history)[-m:])
+    np.testing.assert_array_equal(np.asarray(res.grad_norm_history),
+                                  np.asarray(base.grad_norm_history)[-m:])
+    np.testing.assert_array_equal(np.asarray(res.T), np.asarray(base.T))
+
+
+# ---------------------------------------------------------------------------
+# Autoscaling
+# ---------------------------------------------------------------------------
+
+def test_autoscale_up_on_queue_wait_burn_then_scale_down(meas, aot_root):
+    router = _fleet(1, aot_root, max_replicas=2, queue_wait_slo_s=0.0,
+                    min_scale_observations=2, scale_cooldown_s=0.2,
+                    scale_window_s=60.0, batch_window_s=0.01)
+    mgr = router.manager
+    try:
+        # Every completed request burns the (zero) wait budget; the
+        # monitor must bring up a second replica.
+        deadline = time.monotonic() + 15.0
+        while mgr.status()["scale_ups"] < 1:
+            router.submit(_req(meas)).result(timeout=600)
+            assert time.monotonic() < deadline, "autoscaler never tripped"
+        assert len(mgr.replicas()) == 2
+        # Graceful scale-down retires the newest replica (no live
+        # tickets -> nothing to migrate) and the pool shrinks to min.
+        assert mgr.scale_down()
+        assert len(mgr.replicas()) == 1
+        st = mgr.status()
+        assert st["scale_downs"] == 1
+        # At min_replicas a further scale-down is refused.
+        assert not mgr.scale_down()
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# Frontend drain race (satellite: send-lock + structured draining reply)
+# ---------------------------------------------------------------------------
+
+def test_frontend_draining_server_reply_carries_draining_flag(
+        meas, aot_root, tmp_path):
+    """A request shed while a drain is IN PROGRESS (in-flight batch
+    still finishing) comes back with the structured ``draining`` flag —
+    reconnect to the fleet's next replica, don't back off."""
+    from dpgo_tpu.serve.frontend import ServeFrontend, solve_g2o
+    from dpgo_tpu.utils.g2o import write_g2o
+
+    path = str(tmp_path / "p.g2o")
+    write_g2o(meas, path)
+    server = SolveServer(max_batch=2, batch_window_s=0.0,
+                         aot_cache_dir=aot_root)
+    try:
+        with ServeFrontend(server) as fe:
+            t = server.submit(_req(meas, iters=2000, eval_every=1))
+            deadline = time.monotonic() + 30.0
+            while server.status()["queue_depth"] > 0:  # dispatched yet?
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            closer = threading.Thread(
+                target=lambda: server.close(drain=True))
+            closer.start()
+            while not server.status()["draining"]:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            out = solve_g2o("127.0.0.1", fe.port, path, num_robots=2,
+                            timeout=30)
+            res = t.result(timeout=600)  # in-flight work still completes
+            closer.join(timeout=60)
+    finally:
+        server.close()
+    assert not out["ok"] and out["shed"] and out["reason"] == "closed"
+    assert out["draining"] is True
+    assert res.terminated_by == "max_iters"
+
+
+def test_frontend_close_races_inflight_reply_cleanly():
+    """A reply in flight when ``close()`` begins is either delivered
+    whole or skipped entirely — the handler's send serializes with the
+    teardown on the per-connection send lock and never writes into a
+    closing socket."""
+    from dpgo_tpu.comms.transport import (TcpTransport, TransportClosed,
+                                          connect_tcp)
+    from dpgo_tpu.serve import frontend as frontend_mod
+    from dpgo_tpu.serve.frontend import ServeFrontend, _pack_str
+
+    entered, release = threading.Event(), threading.Event()
+    real_handle = frontend_mod.handle_request
+
+    def slow_handle(server, frame):
+        entered.set()
+        release.wait(timeout=30)
+        return real_handle(server, frame)
+
+    server = SolveServer(max_batch=2, batch_window_s=0.0)
+    try:
+        with pytest.MonkeyPatch.context() as mp:
+            mp.setattr(frontend_mod, "handle_request", slow_handle)
+            fe = ServeFrontend(server)
+            tr = TcpTransport(connect_tcp("127.0.0.1", fe.port),
+                              src="test-client")
+            try:
+                tr.send({"op": _pack_str("ping")})
+                assert entered.wait(timeout=10)
+                # Teardown begins while the request is in flight: close()
+                # must return without waiting for the handler...
+                fe.close()
+                release.set()
+                # ...and the client sees a clean close, never a torn or
+                # interleaved frame.
+                with pytest.raises(TransportClosed):
+                    tr.recv(timeout=10)
+            finally:
+                tr.close()
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# Report section (obs.report fleet_serve_stats)
+# ---------------------------------------------------------------------------
+
+def test_fleet_serve_stats_and_lines():
+    from dpgo_tpu.obs.report import _fleet_serve_lines, fleet_serve_stats
+
+    evs = [
+        {"event": "replica_spawn", "phase": "fleet", "replica": "r0",
+         "reason": "initial", "pool": 1, "t_mono": 0.0},
+        {"event": "replica_spawn", "phase": "fleet", "replica": "r1",
+         "reason": "scale_up", "pool": 2, "t_mono": 1.0},
+        {"event": "replica_death", "phase": "fleet", "replica": "r0",
+         "pool": 1, "t_mono": 2.0},
+        {"event": "fleet_scale", "phase": "fleet", "direction": "up",
+         "burn": 12.5, "pool": 2, "t_mono": 3.0},
+        {"event": "session_migrated", "phase": "fleet", "kind": "death",
+         "ok": True, "session": "s1", "t_mono": 4.0},
+        {"event": "session_migrated", "phase": "fleet", "kind": "drain",
+         "ok": True, "session": "s2", "t_mono": 5.0},
+        {"event": "compile_profile", "phase": "serve", "disk_hit": True,
+         "t_mono": 6.0},
+        {"event": "compile_profile", "phase": "serve", "t_mono": 7.0},
+        {"event": "metric", "metric": "serve_cold_start_seconds",
+         "value": 0.124, "arm": "warm", "compile_seconds_total": 0.0,
+         "disk_hits": 3, "t_mono": 8.0},
+    ]
+    st = fleet_serve_stats(evs)
+    assert st["replicas"]["spawned"] == 2 and st["replicas"]["deaths"] == 1
+    assert st["replicas"]["spawn_reasons"] == {"initial": 1, "scale_up": 1}
+    assert st["migrations"]["count"] == 2
+    assert st["migrations"]["by_kind"] == {"death": 1, "drain": 1}
+    assert st["migrations"]["failed"] == 0
+    assert st["scale"]["by_direction"] == {"up": 1}
+    assert st["aot"] == {"disk_hits": 1, "compiles": 1, "quarantined": 0,
+                         "store_failures": 0}
+    assert st["cold_start"][0]["compile_seconds_total"] == 0.0
+    text = "\n".join(_fleet_serve_lines(st))
+    assert "2 replicas spawned" in text and "death 1, drain 1" in text
+    assert "cold start [warm]" in text
+    # No fleet-phase events -> no section (the serve plane alone must not
+    # grow a fleet block).
+    assert fleet_serve_stats([{"event": "metric", "t_mono": 0.0}]) is None
+    assert _fleet_serve_lines(None) == []

@@ -165,3 +165,36 @@ def test_fingerprint_persisted_into_run_json(tmp_path):
     assert fp["num_robots"] == 2 and fp["rank"] == 5
     assert fp["dtype"] == "float64"
     assert "version" in fp
+
+
+def _qps_run_into(run_dir, values):
+    """A minimal run whose only gated trajectory is ``fleet_qps`` —
+    fingerprint-free (no solve), so any two such runs are comparable."""
+    with obs.run_scope(run_dir) as run:
+        for v in values:
+            run.metric("fleet_qps", float(v), unit="1/s")
+
+
+def test_higher_direction_metric_regresses_on_drop(tmp_path, capsys):
+    """``fleet_qps`` gates the OTHER way: run B's final value falling
+    below run A's band MIN (beyond rtol) regresses; matching or beating
+    the band does not (ISSUE 13)."""
+    a = str(tmp_path / "runA")
+    _qps_run_into(a, [4.0, 4.2, 4.1, 4.3, 4.2])
+
+    ok = str(tmp_path / "runOK")
+    _qps_run_into(ok, [4.0, 4.1, 4.4, 4.5, 4.6])  # higher: never regresses
+    assert report_main(["--compare", a, ok]) == 0
+    capsys.readouterr()
+
+    bad = str(tmp_path / "runBAD")
+    _qps_run_into(bad, [4.0, 4.1, 4.2, 4.1, 2.0])  # final far below band min
+    assert report_main(["--compare", a, bad]) == 2
+    text = capsys.readouterr().out
+    assert "fleet_qps" in text and "REGRESSED" in text
+    cmp = compare_runs(a, bad)
+    assert "fleet_qps" in cmp["regressions"]
+    assert "below band min" in cmp["metrics"]["fleet_qps"]["reason"]
+    # The same drop as baseline-vs-improvement does not regress.
+    assert report_main(["--compare", bad, a]) == 0
+    capsys.readouterr()

@@ -27,6 +27,14 @@ syncs at <= 100/K, the overlap A/B and GN-tail parity blocks are sane
 (tail parity <= 1e-6 when the arm ran), and a scale_test block (when
 present) actually completed through the sharded verdict path.
 
+For a ``bench_fleet.py`` FLEET record (``record == "FLEET"``; ISSUE 13):
+the QPS arms ascend in replica count with positive QPS, throughput
+scales >= FLEET_MIN_SCALING (default 1.7) from 1 to 2 replicas, the
+chaos soak lost ZERO sessions while migrating at least one ticket and
+autoscaling at least once, and the cold-start arm served its warm first
+solve with serve_compile_seconds_total exactly 0 (disk hits only — XLA
+never ran on the restarted replica).
+
 Exit 0 on pass, 1 on any violation, 2 on an unreadable record.
 """
 from __future__ import annotations
@@ -36,6 +44,7 @@ import os
 import sys
 
 FLOOR = float(os.environ.get("BENCH_FLOOR_ROUNDS_PER_S", "1146"))
+FLEET_MIN_SCALING = float(os.environ.get("FLEET_MIN_SCALING", "1.7"))
 PARITY_BOUND = float(os.environ.get("BENCH_PARITY_BOUND", "7.7e-6"))
 MIN_VERDICT_K = int(os.environ.get("BENCH_MIN_VERDICT_K", "4"))
 GN_TAIL_PARITY_BOUND = float(
@@ -104,6 +113,59 @@ def check_multichip(rec: dict) -> None:
              if scale and not scale.get("skipped") else "") + ")")
 
 
+def check_fleet(rec: dict) -> None:
+    """FLEET-record schema + scaling/chaos/cold-start gate
+    (``bench_fleet.py`` output)."""
+    for key in ("ok", "backend", "qps", "soak", "cold_start"):
+        if key not in rec:
+            fail(f"FLEET record missing {key!r}: {sorted(rec)}")
+    if rec["ok"] is not True:
+        fail(f"record reports ok={rec['ok']!r}")
+    qps = rec["qps"]
+    if not (isinstance(qps, list) and qps):
+        fail("empty qps arm")
+    prev = 0
+    for arm in qps:
+        for key in ("replicas", "qps"):
+            if not _num(arm.get(key)) or arm[key] <= 0:
+                fail(f"qps arm field {key!r} bad: {arm}")
+        if arm["replicas"] <= prev:
+            fail(f"qps replica counts must ascend: {qps}")
+        prev = arm["replicas"]
+    scaling = rec.get("scaling_1_to_2")
+    if scaling is not None:
+        if not _num(scaling) or scaling < FLEET_MIN_SCALING:
+            fail(f"1->2 replica scaling {scaling!r} < required "
+                 f"{FLEET_MIN_SCALING}")
+    elif {a["replicas"] for a in qps} >= {1, 2}:
+        fail("qps arms cover 1 and 2 replicas but scaling_1_to_2 missing")
+    soak = rec["soak"]
+    if not soak.get("skipped"):
+        if soak.get("lost") != 0:
+            fail(f"soak lost sessions: {soak}")
+        if not _num(soak.get("migrations")) or soak["migrations"] < 1:
+            fail(f"soak recorded no migrations: {soak}")
+        if not _num(soak.get("scale_ups")) or soak["scale_ups"] < 1:
+            fail(f"soak recorded no autoscale-up: {soak}")
+    cold = rec["cold_start"]
+    if not cold.get("skipped"):
+        if cold.get("compile_seconds_total") != 0:
+            fail("restarted replica spent "
+                 f"{cold.get('compile_seconds_total')!r}s in XLA "
+                 "(persistent AOT cache must make it exactly 0)")
+        if not _num(cold.get("disk_hits")) or cold["disk_hits"] < 1:
+            fail(f"cold-start arm shows no disk hits: {cold}")
+    print("bench floor gate: PASS — FLEET ok ("
+          + ", ".join(f"{a['replicas']}r={a['qps']}/s" for a in qps)
+          + (f", scaling {scaling}" if scaling is not None else "")
+          + ("" if soak.get("skipped") else
+             f", soak lost={soak['lost']} migrations={soak['migrations']} "
+             f"scale_ups={soak['scale_ups']}")
+          + ("" if cold.get("skipped") else
+             f", warm restart compile_s={cold['compile_seconds_total']} "
+             f"disk_hits={cold['disk_hits']}") + ")")
+
+
 def main() -> None:
     try:
         if len(sys.argv) > 1:
@@ -119,6 +181,10 @@ def main() -> None:
 
     if rec.get("record") == "MULTICHIP":
         check_multichip(rec)
+        return
+
+    if rec.get("record") == "FLEET":
+        check_fleet(rec)
         return
 
     # 1. Schema (all platforms).
