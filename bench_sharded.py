@@ -19,6 +19,11 @@ Arms (each skippable):
   probe (final-cost parity, transfer count).
 * **scale test** — a synthetic large solve (the 1M-pose / 256-agent
   configuration) driven end to end through the sharded verdict loop.
+* **resilience (chaos)** — a device is killed mid-solve under the
+  ``parallel.resilience`` supervisor: the solve must recover from the
+  last verdict-boundary checkpoint on a halved mesh and land within
+  rtol of the fault-free reference (``tools/check_bench_floor.py``
+  enforces recoveries >= 1 and bounded recovery overhead).
 
 Runs FUNCTIONALLY on CPU via the virtual device mesh
 (``--xla_force_host_platform_device_count``); absolute TPU readings are
@@ -57,6 +62,12 @@ def parse_args(argv=None):
     ap.add_argument("--scale-robots", type=int, default=256)
     ap.add_argument("--scale-rounds", type=int, default=8)
     ap.add_argument("--scale-verdict-k", type=int, default=4)
+    ap.add_argument("--chaos-poses", type=int, default=0,
+                    help="pose count for the resilience chaos arm "
+                         "(0 skips; kills a device mid-solve and gates "
+                         "the recovery)")
+    ap.add_argument("--chaos-rounds", type=int, default=24)
+    ap.add_argument("--chaos-verdict-k", type=int, default=4)
     ap.add_argument("--telemetry", metavar="RUN_DIR", default=None,
                     help="also emit the obs event stream (sharded report "
                          "section) into RUN_DIR")
@@ -394,6 +405,61 @@ def scale_arm(dtype=jnp.float32):
             "dtype": str(np.dtype(dtype))}
 
 
+def resilience_arm(dtype):
+    """Chaos arm (ISSUE 14): kill a device mid-solve under the rewind
+    supervisor and gate the recovery against the fault-free run."""
+    import tempfile
+
+    from dpgo_tpu.parallel import (CollectiveFaultInjector, MeshFaultSpec,
+                                   ResilienceConfig, make_mesh,
+                                   solve_rbcd_sharded)
+
+    if ARGS.chaos_poses <= 0:
+        return {"skipped": "disabled (--chaos-poses 0)"}
+    n = ARGS.chaos_poses
+    robots = ARGS.agents_per_dev * _MAX_DEV
+    k, rounds = ARGS.chaos_verdict_k, ARGS.chaos_rounds
+    meas, params, part, *_ = build_problem(n, robots, dtype, seed=13,
+                                           noise=0.1, lc_frac=0.2)
+    common = dict(num_robots=robots, part=part, params=params,
+                  max_iters=rounds, verdict_every=k, grad_norm_tol=0.0,
+                  eval_every=k, dtype=dtype)
+    t0 = time.perf_counter()
+    ref = solve_rbcd_sharded(meas, mesh=make_mesh(_MAX_DEV), **common)
+    t_ref = time.perf_counter() - t0
+    # Kill a device just past the midpoint so at least one checkpoint
+    # exists; the supervisor resumes on a halved mesh.
+    inj = CollectiveFaultInjector(
+        MeshFaultSpec(device_loss_rounds=(rounds // 2 + 1,),
+                      lost_device=_MAX_DEV - 1), seed=13)
+    with tempfile.TemporaryDirectory() as td:
+        t0 = time.perf_counter()
+        res = solve_rbcd_sharded(
+            meas, mesh=make_mesh(_MAX_DEV),
+            resilience=ResilienceConfig(checkpoint_dir=td, injector=inj),
+            **common)
+        t_chaos = time.perf_counter() - t0
+    rz = res.resilience
+    rel = abs(res.cost_history[-1] - ref.cost_history[-1]) \
+        / max(abs(ref.cost_history[-1]), 1e-300)
+    log(f"  [chaos] device {_MAX_DEV - 1} killed after "
+        f"{rounds // 2 + 1} rounds: {rz['recoveries']} recoveries, "
+        f"mesh {rz['mesh_sizes']}, overhead "
+        f"{rz['recovery_overhead_s']:.2f}s, final-cost rel err {rel:.2e} "
+        f"({t_ref:.1f}s fault-free vs {t_chaos:.1f}s chaos)")
+    return {"n_poses": n, "num_robots": robots, "devices": _MAX_DEV,
+            "rounds": rounds, "verdict_every": k,
+            "recoveries": rz["recoveries"],
+            "checkpoints": rz["checkpoints"],
+            "cold_restarts": rz["cold_restarts"],
+            "mesh_sizes": rz["mesh_sizes"],
+            "fault_kinds": rz["fault_kinds"],
+            "recovery_overhead_s": rz["recovery_overhead_s"],
+            "final_cost_rel_err": rel,
+            "fault_free_s": round(t_ref, 2),
+            "chaos_s": round(t_chaos, 2)}
+
+
 def main():
     from dpgo_tpu import obs
 
@@ -416,6 +482,7 @@ def main():
         comm = comm_arm(dtype, obs_run=run)
         gn = gn_tail_arm(dtype)
         scale = scale_arm()
+        rz = resilience_arm(dtype)
     finally:
         if scope is not None:
             scope.__exit__(None, None, None)
@@ -436,6 +503,7 @@ def main():
         "comm": comm,
         "gn_tail": gn,
         "scale_test": scale,
+        "resilience": rz,
     }
     if backend != "tpu":
         rec["notes"] = ("functional CPU run on the virtual device mesh; "

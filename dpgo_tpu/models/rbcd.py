@@ -365,6 +365,13 @@ def _ppermute_exchange(Xl: jax.Array, graph: MultiAgentGraph,
     return Z * graph.nbr_mask[:, :, None, None]
 
 
+#: Collective fault-injection hook (``parallel.resilience``): when set,
+#: every exchange closure built below is passed through it before use, so
+#: chaos tests can corrupt halo payloads at the seam itself.  Trace-time —
+#: only programs compiled while the hook is installed are affected.
+_exchange_wrap = None
+
+
 def _exchange_for(graph: MultiAgentGraph, A_tot: int, axis_name,
                   plan: PPermutePlan | None, shifts: tuple):
     """The pose-exchange closure of a round: neighbor buffer resolved from
@@ -383,13 +390,16 @@ def _exchange_for(graph: MultiAgentGraph, A_tot: int, axis_name,
         gather = lambda t: jax.lax.all_gather(t, axis_name, axis=0,
                                               tiled=True)
     if plan is None:
-        return lambda Xl: neighbor_buffer(gather(public_table(Xl, graph)),
-                                          graph)
+        exchange = lambda Xl: neighbor_buffer(
+            gather(public_table(Xl, graph)), graph)
+    else:
+        def exchange(Xl):
+            n_dev = A_tot // Xl.shape[0]
+            return _ppermute_exchange(Xl, graph, plan, shifts, axis_name,
+                                      n_dev)
 
-    def exchange(Xl):
-        n_dev = A_tot // Xl.shape[0]
-        return _ppermute_exchange(Xl, graph, plan, shifts, axis_name, n_dev)
-
+    if _exchange_wrap is not None:
+        exchange = _exchange_wrap(exchange)
     return exchange
 
 
@@ -1368,8 +1378,13 @@ class RBCDResult:
     #: (their states ride the session store instead).
     state: "RBCDState | None" = None
     #: True when the serving plane completed this request by re-admitting it
-    #: from a crash-recovery session snapshot (``serve.session``).
+    #: from a crash-recovery session snapshot (``serve.session``), or when
+    #: the sharded supervisor rewound it at least once mid-solve.
     recovered: bool = False
+    #: Pod-scale resilience summary (``parallel.resilience``): recoveries,
+    #: checkpoint counts, fault kinds, injector stats.  None for solves
+    #: run without a ``ResilienceConfig``.
+    resilience: dict | None = None
 
 
 def global_weights(weights: jax.Array, graph: MultiAgentGraph,
@@ -1751,6 +1766,9 @@ def run_rbcd(
     segment=None,
     verdict_every: int | None = None,
     metrics_body_factory=None,
+    start_iteration: int = 0,
+    start_num_weight_updates: int = 0,
+    boundary_cb=None,
 ) -> RBCDResult:
     """The driver loop shared by the single-device and mesh-sharded solvers —
     the analog of the ``multi-robot-example`` loop
@@ -1800,7 +1818,21 @@ def run_rbcd(
     ``make_verdict_program`` as its ``metrics_body`` — how the sharded
     solver runs the centralized evals as a shard_map program with psum
     reductions while sharing every downstream line of this driver.
-    """
+
+    ``start_iteration`` / ``start_num_weight_updates`` resume the verdict
+    loop mid-schedule from a checkpointed state (``parallel.resilience``):
+    the schedule arithmetic is a pure function of the ABSOLUTE round
+    index, so a resumed solve replays the exact flag sequence of the
+    uninterrupted one.  ``boundary_cb(it, nwu, state, word, terminal)``
+    fires at every verdict boundary with the pre-speculation state — the
+    checkpoint/rewind hook; it may raise to abort the attempt.  All three
+    require the verdict loop."""
+    if verdict_every is None and (start_iteration or start_num_weight_updates
+                                  or boundary_cb is not None):
+        raise ValueError(
+            "start_iteration / start_num_weight_updates / boundary_cb "
+            "are resilience hooks of the verdict loop; pass "
+            "verdict_every=K to use them")
     n_total = part.meas_global.num_poses
     num_meas = len(part.meas_global)
     edges_g = edge_set_from_measurements(part.meas_global, dtype=dtype)
@@ -1964,7 +1996,10 @@ def run_rbcd(
             telemetry=telemetry, obs_run=obs_run, health_mon=health_mon,
             flight_rec=flight_rec, emit_eval=_emit_eval,
             bounds=_bounds, robust_on=robust_on,
-            metrics_body=metrics_body)
+            metrics_body=metrics_body,
+            start_iteration=start_iteration,
+            start_nwu=start_num_weight_updates,
+            boundary_cb=boundary_cb)
 
     # Pipelined driver: advance to each eval boundary, ENQUEUE the metrics
     # program, dispatch one speculative segment past the boundary, and only
@@ -2069,7 +2104,8 @@ def _run_verdict_loop(state, graph, meta, segment, *, max_iters,
                       grad_norm_tol, eval_every, verdict_every, dtype,
                       params, edges_g, n_total, num_meas, telemetry,
                       obs_run, health_mon, flight_rec, emit_eval, bounds,
-                      robust_on, metrics_body=None):
+                      robust_on, metrics_body=None, start_iteration=0,
+                      start_nwu=0, boundary_cb=None):
     """Body of ``run_rbcd``'s device-resident mode (see its docstring).
 
     Per verdict boundary (every K rounds): dispatch the schedule segments
@@ -2078,7 +2114,13 @@ def _run_verdict_loop(state, graph, meta, segment, *, max_iters,
     execution), then fetch ONE packed int32.  The full per-eval history is
     fetched lazily — per boundary with telemetry on (feeding the identical
     gauge/event/health/recorder calls as the per-eval path), once at
-    termination otherwise."""
+    termination otherwise.
+
+    Resumption (``start_iteration``/``start_nwu``) re-enters at an
+    absolute round index: every schedule quantity below is already a pure
+    function of it, so the flag sequence is identical to an uninterrupted
+    run's.  A resumed attempt gets a fresh verdict state — anomaly
+    latches clear, and its history rows cover only the resumed suffix."""
     if verdict_every <= 0 or verdict_every % eval_every != 0:
         raise ValueError(
             f"verdict_every={verdict_every} must be a positive multiple "
@@ -2112,15 +2154,16 @@ def _run_verdict_loop(state, graph, meta, segment, *, max_iters,
         return st, it, nwu, vs
 
     t_solve0 = t_window = time.perf_counter()
-    it_window = fed = 0
+    it_window = int(start_iteration)
+    fed = 0
     hist_rows = None
     terminated_by = "max_iters"
     n_keep = it_final = 0
     with _crash_dump_scope(flight_rec):
-        it, nwu, vs = 0, 0, vs0
+        it, nwu, vs = int(start_iteration), int(start_nwu), vs0
         bound = lambda i: min(((i // verdict_every) + 1) * verdict_every,
                               max_iters)
-        state, it, nwu, vs = advance(state, it, nwu, vs, bound(0))
+        state, it, nwu, vs = advance(state, it, nwu, vs, bound(it))
         n_pre = len(eval_its)
         while True:
             state_pre, it_pre, nwu_pre, vs_pre = state, it, nwu, vs
@@ -2138,6 +2181,13 @@ def _run_verdict_loop(state, graph, meta, segment, *, max_iters,
             fetches += 1
             status = word & 7
             terminal = status != VERDICT_RUNNING or it_pre >= max_iters
+            if boundary_cb is not None:
+                # Resilience hook (parallel.resilience): checkpoint the
+                # pre-speculation state, or raise to rewind on a latched
+                # anomaly.  The word fetch above already drained this
+                # boundary, so a checkpoint gather here adds no new
+                # synchronization point.
+                boundary_cb(it_pre, nwu_pre, state_pre, word, terminal)
             if telemetry or terminal:
                 # Lazy full-stack fetch: the per-eval scalar rows the
                 # telemetry/health/recorder consumers see.  Recurring
@@ -2166,7 +2216,8 @@ def _run_verdict_loop(state, graph, meta, segment, *, max_iters,
                 it_window = it_pre
                 per_round = dt / rounds_w
                 for r in range(fed, feed_to):
-                    rounds_r = eval_its[r] - (eval_its[r - 1] if r else 0)
+                    rounds_r = eval_its[r] - (eval_its[r - 1] if r
+                                              else int(start_iteration))
                     emit_eval(eval_its[r], hist_rows[r], max(rounds_r, 1),
                               per_round)
                 fed = feed_to
@@ -2194,7 +2245,8 @@ def _run_verdict_loop(state, graph, meta, segment, *, max_iters,
 
     T, w_glob = _finalize(state.X, state.weights)
     if telemetry:
-        _emit_sync_rate(obs_run, fetches, max(it_pre, 1))
+        _emit_sync_rate(obs_run, fetches,
+                        max(it_pre - int(start_iteration), 1))
         obs_run.event(
             "solve_end", phase="solve", iterations=it_final,
             terminated_by=terminated_by,

@@ -52,10 +52,16 @@ from .run import EVENTS_FILE, META_FILE
 #: (``fleet_qps`` — the first higher-is-better metric, mirrored band
 #: check against A's tail MIN) and a warm restart must not get slower
 #: (``serve_cold_start_seconds``).
+#: Resilience records (ISSUE 14) gate the rewind tax: a change that
+#: makes a mesh recovery (checkpoint restore + re-shard + recompile)
+#: slower regresses ``mesh_recovery_overhead_s`` even when the solve
+#: itself is untouched.  Absent on fault-free runs, so only chaos-arm
+#: baselines ever compare it.
 GATED_METRICS = {"solver_cost": "lower", "solver_grad_norm": "lower",
                  "host_syncs_per_100_rounds": "lower",
                  "fleet_qps": "higher",
-                 "serve_cold_start_seconds": "lower"}
+                 "serve_cold_start_seconds": "lower",
+                 "mesh_recovery_overhead_s": "lower"}
 #: Fingerprint keys that never gate (recorded for the report only).
 NON_GATING_KEYS = {"version"}
 

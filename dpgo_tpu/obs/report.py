@@ -261,8 +261,10 @@ def sharded_stats(events: list[dict]) -> dict | None:
     interconnect bytes per round (``sharded_comm_bytes_measured`` metric,
     measured = parsed from the compiled program's collectives), halo
     overlap efficiency (``sharded_overlap_efficiency`` metric, 1 -
-    t_overlap/t_lockstep), the verdict sync rate, and the sharded GN-CG
-    tail summary (``gn_tail`` events with ``sharded=True``)."""
+    t_overlap/t_lockstep), the verdict sync rate, the sharded GN-CG
+    tail summary (``gn_tail`` events with ``sharded=True``), and the
+    pod-scale resilience story (``mesh_checkpoint`` / ``mesh_fault`` /
+    ``mesh_rewind`` events from ``parallel.resilience``)."""
     setup = [ev for ev in events if ev.get("event") == "sharded_solve"]
     overlap = [ev for ev in events if ev.get("event") == "metric"
                and ev.get("metric") == "sharded_overlap_efficiency"]
@@ -270,7 +272,12 @@ def sharded_stats(events: list[dict]) -> dict | None:
             and ev.get("metric") == "sharded_comm_bytes_measured"]
     tails = [ev for ev in events if ev.get("event") == "gn_tail"
              and ev.get("sharded")]
-    if not (setup or overlap or comm or tails):
+    checkpoints = [ev for ev in events
+                   if ev.get("event") == "mesh_checkpoint"]
+    faults = [ev for ev in events if ev.get("event") == "mesh_fault"]
+    rewinds = [ev for ev in events if ev.get("event") == "mesh_rewind"]
+    if not (setup or overlap or comm or tails or checkpoints or faults
+            or rewinds):
         return None
     out: dict = {"solves": [], "gn_tails": []}
     syncs = [ev for ev in events if ev.get("event") == "metric"
@@ -302,6 +309,24 @@ def sharded_stats(events: list[dict]) -> dict | None:
             "outer_iterations": ev.get("outer_iterations"),
             "cg_iterations": ev.get("cg_iterations"),
             "cost": ev.get("cost"), "grad_norm": ev.get("grad_norm")})
+    if checkpoints or rewinds or faults:
+        overhead = [ev for ev in events if ev.get("event") == "metric"
+                    and ev.get("metric") == "mesh_recovery_overhead_s"]
+        out["resilience"] = {
+            "checkpoints": len(checkpoints),
+            "last_checkpoint_iteration":
+                checkpoints[-1].get("iteration") if checkpoints else None,
+            "faults": [{"kind": ev.get("kind"),
+                        "phase": ev.get("fault_phase"),
+                        "device": ev.get("device")} for ev in faults],
+            "rewinds": [{"kind": ev.get("kind"),
+                         "mesh_from": ev.get("mesh_from"),
+                         "mesh_to": ev.get("mesh_to"),
+                         "resume_iteration": ev.get("resume_iteration"),
+                         "cold": ev.get("cold")} for ev in rewinds],
+            "recovery_overhead_s":
+                overhead[-1].get("value") if overhead else None,
+        }
     return out
 
 
@@ -349,6 +374,25 @@ def _sharded_lines(stats: dict | None) -> list[str]:
             f"{t['outer_iterations']} outer / {t['cg_iterations']} CG "
             f"iters, cost {_fmt(t.get('cost'))}, "
             f"gn {_fmt(t.get('grad_norm'))}")
+    rz = stats.get("resilience")
+    if rz:
+        head = f"  resilience: {rz['checkpoints']} checkpoint(s)"
+        if rz.get("last_checkpoint_iteration") is not None:
+            head += f" (last at round {rz['last_checkpoint_iteration']})"
+        if rz.get("recovery_overhead_s") is not None:
+            head += f", recovery overhead {rz['recovery_overhead_s']:.2f}s"
+        lines.append(head)
+        for f in rz["faults"]:
+            dev = f" device {f['device']}" if f.get("device") is not None \
+                else ""
+            lines.append(f"  mesh fault: {f['kind']} in phase "
+                         f"{f['phase']}{dev}")
+        for r in rz["rewinds"]:
+            dest = "cold restart" if r.get("cold") \
+                else f"round {r['resume_iteration']}"
+            lines.append(
+                f"  rewind [{r['kind']}]: mesh {r['mesh_from']} -> "
+                f"{r['mesh_to']} devices, resumed from {dest}")
     return lines
 
 

@@ -1,13 +1,24 @@
-"""Distributed (device-mesh) execution layer — see ``sharded.py``."""
+"""Distributed (device-mesh) execution layer — see ``sharded.py``.
 
+``resilience.py`` adds the pod-scale fault story: mesh-elastic verdict-
+boundary checkpoints, a deterministic collective fault injector, and the
+anomaly-triggered rewind supervisor behind
+``solve_rbcd_sharded(resilience=...)``.
+"""
+
+from .resilience import (CollectiveFaultInjector, DeviceLostError,
+                         MeshFaultError, MeshFaultSpec, ResilienceConfig,
+                         Watchdog, shrink_mesh_size)
 from .sharded import (AXIS, comm_bytes_per_round, gn_tail_sharded,
                       make_mesh, make_multislice_mesh,
                       make_sharded_metrics_body,
                       make_sharded_multi_step, make_sharded_segment,
                       make_sharded_step, shard_problem, solve_rbcd_sharded)
 
-__all__ = ["AXIS", "comm_bytes_per_round", "gn_tail_sharded", "make_mesh",
-           "make_multislice_mesh", "make_sharded_metrics_body",
-           "make_sharded_multi_step", "make_sharded_segment",
-           "make_sharded_step", "shard_problem",
-           "solve_rbcd_sharded"]
+__all__ = ["AXIS", "CollectiveFaultInjector", "DeviceLostError",
+           "MeshFaultError", "MeshFaultSpec", "ResilienceConfig",
+           "Watchdog", "comm_bytes_per_round", "gn_tail_sharded",
+           "make_mesh", "make_multislice_mesh",
+           "make_sharded_metrics_body", "make_sharded_multi_step",
+           "make_sharded_segment", "make_sharded_step", "shard_problem",
+           "shrink_mesh_size", "solve_rbcd_sharded"]

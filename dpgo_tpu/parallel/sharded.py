@@ -27,7 +27,9 @@ to XLA reduction order.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import time
 from functools import partial
 
 import jax
@@ -44,6 +46,7 @@ from ..utils.profiling import RoundTimer
 from ..models import rbcd, refine
 from ..models.rbcd import (GraphMeta, MultiAgentGraph, RBCDState,
                            init_state)
+from . import resilience as resilience_mod
 
 AXIS = "agent"
 
@@ -274,13 +277,22 @@ def comm_bytes_per_round(meta: GraphMeta, mesh_size: int,
 # Sharded verdict program (the device-resident loop under shard_map)
 # ---------------------------------------------------------------------------
 
+#: Collective fault-injection hook (``parallel.resilience``) — the
+#: shard_map twin of ``rbcd._exchange_wrap``: when set, every exchange
+#: closure built below passes through it at trace time.
+_gather_wrap = None
+
+
 def _gather_exchange(graph: MultiAgentGraph, ax):
     """Neighbor-buffer exchange inside a shard_map body: all_gather of the
     public table over the mesh axes, then the slot resolve — the same v1
     exchange as the solver round (``rbcd.neighbor_buffer``)."""
     gather = lambda t: jax.lax.all_gather(t, ax, axis=0, tiled=True)
-    return lambda Vl: rbcd.neighbor_buffer(
+    exchange = lambda Vl: rbcd.neighbor_buffer(
         gather(rbcd.public_table(Vl, graph)), graph)
+    if _gather_wrap is not None:
+        exchange = _gather_wrap(exchange)
+    return exchange
 
 
 def local_grad_rows(V, Vz, graph: MultiAgentGraph):
@@ -561,7 +573,8 @@ def _gn_programs(mesh: Mesh, meta: GraphMeta, cfg):
 def gn_tail_sharded(X, graph: MultiAgentGraph, meta: GraphMeta,
                     mesh: Mesh | None = None,
                     cfg: "refine.GNTailConfig | None" = None,
-                    weights=None, log=None):
+                    weights=None, log=None,
+                    fetch_deadline_s: float | None = None):
     """Sharded, device-resident Gauss-Newton-CG polish of an
     agent-partitioned iterate — ``refine.gn_tail`` without the host-f64
     scipy round-trip.
@@ -572,6 +585,12 @@ def gn_tail_sharded(X, graph: MultiAgentGraph, meta: GraphMeta,
     GNC weights when polishing a robust solve.  Per outer step ONE small
     stats vector crosses the link (through ``rbcd._host_fetch``); the CG
     loop and the backtracking retraction run entirely on device.
+
+    ``fetch_deadline_s`` arms a ``parallel.resilience.Watchdog`` around
+    those blocking reads: a dead mesh raises a phase-naming
+    ``MeshFaultError`` instead of hanging the caller forever.  (Inside
+    ``solve_rbcd_sharded(resilience=...)`` the solve's own guard already
+    covers this tail — leave it None there.)
 
     Returns ``(X_agents, refine.GNTailResult)`` — the polished iterate in
     the sharded per-agent layout plus the host result record (global
@@ -592,36 +611,44 @@ def gn_tail_sharded(X, graph: MultiAgentGraph, meta: GraphMeta,
     cg_total = 0
     outer_done = 0
     terminated_by = "max_outer"
-    for k in range(int(cfg.max_outer) + 1):
-        # One scalar per outer step: the gate quantity.  The stats fetch
-        # below is the only other transfer — the CG loop itself never
-        # touches the host.
-        # dpgolint: disable=DPG003 -- sanctioned GN-tail gate fetch
-        gn = float(rbcd._host_fetch(gradnorm(X, graph)))
-        gn_hist.append(gn)
-        if log is not None:
-            cst = cost_hist[-1] if cost_hist else float("nan")
-            log(f"  gn_tail_sharded outer {k}: cost {cst:.9g} gn {gn:.4g}")
-        if gn < cfg.grad_norm_tol:
-            terminated_by = "grad_norm"
-            break
-        if k == int(cfg.max_outer):
-            break  # budget exhausted; final point's gate value recorded
-        X_new, stats = outer(X, graph)
-        # dpgolint: disable=DPG003 -- sanctioned per-outer stats fetch
-        st = rbcd._host_fetch(stats)
-        f0, _gn_s, cg_iters, _neg, accepted, f_new, _step = \
-            (float(v) for v in st)
-        if not cost_hist:
-            cost_hist.append(f0)
-        cg_total += int(cg_iters)
-        outer_done = k + 1
-        if accepted <= 0:
-            cost_hist.append(f0)
-            terminated_by = "no_decrease"
-            break
-        cost_hist.append(f_new)
-        X = X_new
+    with contextlib.ExitStack() as stack:
+        if fetch_deadline_s is not None:
+            # Watchdog scope: the two sanctioned fetches below route
+            # through rbcd._host_fetch, which the guard deadline-wraps.
+            stack.enter_context(resilience_mod.fetch_guard(
+                resilience_mod.Watchdog(fetch_deadline_s), None,
+                ["gn_tail"], close=True))
+        for k in range(int(cfg.max_outer) + 1):
+            # One scalar per outer step: the gate quantity.  The stats
+            # fetch below is the only other transfer — the CG loop itself
+            # never touches the host.
+            # dpgolint: disable=DPG003 -- sanctioned GN-tail gate fetch
+            gn = float(rbcd._host_fetch(gradnorm(X, graph)))
+            gn_hist.append(gn)
+            if log is not None:
+                cst = cost_hist[-1] if cost_hist else float("nan")
+                log(f"  gn_tail_sharded outer {k}: cost {cst:.9g} "
+                    f"gn {gn:.4g}")
+            if gn < cfg.grad_norm_tol:
+                terminated_by = "grad_norm"
+                break
+            if k == int(cfg.max_outer):
+                break  # budget exhausted; final point's gate value recorded
+            X_new, stats = outer(X, graph)
+            # dpgolint: disable=DPG003 -- sanctioned per-outer stats fetch
+            st = rbcd._host_fetch(stats)
+            f0, _gn_s, cg_iters, _neg, accepted, f_new, _step = \
+                (float(v) for v in st)
+            if not cost_hist:
+                cost_hist.append(f0)
+            cg_total += int(cg_iters)
+            outer_done = k + 1
+            if accepted <= 0:
+                cost_hist.append(f0)
+                terminated_by = "no_decrease"
+                break
+            cost_hist.append(f_new)
+            X = X_new
 
     n_total = int(np.asarray(graph.global_index).max()) + 1
     Xg = np.asarray(rbcd.gather_to_global(X, graph, n_total), np.float64)
@@ -647,6 +674,7 @@ def solve_rbcd_sharded(
     verdict_every: int | None = None,
     overlap: bool = True,
     gn_tail: "refine.GNTailConfig | None" = None,
+    resilience: "resilience_mod.ResilienceConfig | None" = None,
 ) -> rbcd.RBCDResult:
     """Distributed solve over a device mesh — the deployment path of the
     framework (``models.rbcd.solve_rbcd`` is the single-device debug path).
@@ -668,7 +696,18 @@ def solve_rbcd_sharded(
     appends the sharded device-resident Gauss-Newton-CG polish
     (``gn_tail_sharded``) after the BCD loop, extending the returned
     histories with the tail's trajectory and re-finalizing the rounded
-    trajectory from the polished iterate."""
+    trajectory from the polished iterate.
+
+    ``resilience`` (a ``resilience_mod.ResilienceConfig``, requires the
+    verdict loop) arms the pod-scale fault story: mesh-elastic
+    checkpoints at verdict boundaries, watchdog deadlines on every
+    blocking fetch, and a supervisor that catches latched verdict
+    anomalies and ``MeshFaultError``\\ s, rewinds to the last good
+    checkpoint — on a smaller mesh after a device loss — and resumes at
+    the exact absolute round index.  The returned result then carries a
+    ``resilience`` summary dict and ``recovered=True`` if any rewind
+    happened; its histories cover the final (resumed) attempt — a
+    numerically-pinned suffix of the undisturbed run's."""
     mesh = mesh or make_mesh()
     mesh_size = int(mesh.devices.size)
     if num_robots % mesh_size != 0:
@@ -681,6 +720,11 @@ def solve_rbcd_sharded(
             f"contiguous blocks per device.  Pick num_robots as a "
             f"multiple of {mesh_size}, or build a smaller mesh "
             f"(make_mesh(n) with n dividing {num_robots}).")
+    if resilience is not None and verdict_every is None:
+        raise ValueError(
+            "resilience=ResilienceConfig(...) rides the verdict-boundary "
+            "contract (checkpoints at word-fetch boundaries); pass "
+            "verdict_every=K to use it")
     params = params or AgentParams(d=meas.d, r=5, num_robots=num_robots)
     max_iters = params.max_num_iters if max_iters is None else max_iters
 
@@ -693,97 +737,193 @@ def solve_rbcd_sharded(
     part = part or partition_contiguous(meas, num_robots)
     if timer is not None:
         timer.start("build_graph")
-    graph, meta = rbcd.build_graph(
+    graph_host, meta = rbcd.build_graph(
         part, params.r, dtype, sel_mode=rbcd.resolved_sel_mode(params))
     if timer is not None:
         timer.stop("build_graph")
         timer.start("init")
-    X0 = rbcd.initial_state_for(init, part, meta, graph, params, dtype)
-    state = init_state(graph, meta, X0, params=params)
+    X0 = rbcd.initial_state_for(init, part, meta, graph_host, params, dtype)
+    state_host0 = init_state(graph_host, meta, X0, params=params)
     if timer is not None:
         # The init chord/odometry solve runs on device; the obs-owned fence
         # materializes it so the phase boundary is trustworthy (telemetry-on
         # only — the off path never reaches this transfer).
-        timer.stop("init", sync=obs.materialize(state.X))
+        timer.stop("init", sync=obs.materialize(state_host0.X))
         timer.start("shard")
-    state, graph = shard_problem(mesh, state, graph)
-
-    shifts, plan = _exchange_plan(mesh, meta, graph, exchange)
+    state, graph = shard_problem(mesh, state_host0, graph_host)
     if timer is not None:
         timer.stop("shard")
-    sharded_step = make_sharded_step(mesh, meta, params, shifts, plan)
-    sharded_multi = make_sharded_multi_step(mesh, meta, params, shifts, plan,
-                                            overlap=overlap)
-    sharded_seg = make_sharded_segment(mesh, meta, params, shifts, plan,
-                                       overlap=overlap)
-    step = lambda s, uw, rs: sharded_step(s, graph, update_weights=uw, restart=rs)
-    multi = lambda s, k: sharded_multi(s, graph, k)
-    seg = lambda s, k, uw, rs: sharded_seg(s, graph, k, update_weights=uw,
-                                           restart=rs)
-    metrics_factory = None
-    if verdict_every is not None:
-        # The device-resident verdict loop under sharding: the same driver
-        # (run_rbcd -> _run_verdict_loop), with the stacked-metrics body
-        # traced inside shard_map and its reductions as psums.
-        edges_g = edge_set_from_measurements(part.meas_global, dtype=dtype)
-        n_total = part.meas_global.num_poses
-        num_meas = len(part.meas_global)
-        metrics_factory = lambda telemetry: make_sharded_metrics_body(
-            mesh, graph, edges_g, n_total, num_meas, telemetry)
-    if run is not None:
-        bytes_round = comm_bytes_per_round(
-            meta, mesh_size, shifts=shifts if exchange == "ppermute" else None,
-            accel=params.acceleration,
-            itemsize=np.dtype(dtype).itemsize,
-            greedy=params.schedule.value == "greedy")
-        run.event("sharded_solve", phase="setup", mesh_size=mesh_size,
-                  mesh_axes=list(mesh.axis_names), exchange=exchange,
-                  num_robots=num_robots,
-                  agents_per_shard=num_robots // mesh_size,
-                  comm_bytes_per_round=bytes_round,
-                  overlap=overlap, verdict_every=verdict_every)
-        run.gauge("sharded_comm_bytes_per_round",
-                  "modeled per-device interconnect bytes per round",
-                  unit="bytes").set(bytes_round)
         run.event("phase_timings", phase="setup", timings=timer.as_dict())
-        # Mesh identity into the run fingerprint: a 1-device and an
-        # 8-device solve of the same problem are not comparable runs for
-        # the convergence regression gate (report --compare).
-        run.set_fingerprint(solver="solve_rbcd_sharded",
-                            mesh_size=mesh_size, exchange=exchange)
-    res = rbcd.run_rbcd(state, graph, meta, step, part, max_iters,
-                        grad_norm_tol, eval_every, dtype, params=params,
-                        multi_step=multi, segment=seg,
-                        verdict_every=verdict_every,
-                        metrics_body_factory=metrics_factory)
-    if gn_tail is None:
-        return res
-    # Device-resident GN-CG polish on the terminal iterate (the sharded
-    # stall-breaker): same weighted objective the solve minimized.
-    Xa, tail = gn_tail_sharded(res.state.X, graph, meta, mesh=mesh,
-                               cfg=gn_tail, weights=res.state.weights)
-    if run is not None:
-        run.event("gn_tail", phase="refine", sharded=True,
-                  outer_iterations=tail.outer_iterations,
-                  cg_iterations=tail.cg_iterations,
-                  terminated_by=tail.terminated_by,
-                  cost=tail.cost_history[-1] if tail.cost_history else None,
-                  grad_norm=tail.grad_norm_history[-1]
-                  if tail.grad_norm_history else None)
+
     n_total = part.meas_global.num_poses
     num_meas = len(part.meas_global)
+    edges_g = edge_set_from_measurements(part.meas_global, dtype=dtype) \
+        if verdict_every is not None else None
 
-    @jax.jit
-    def _finalize(Xf, weights):
-        Xg = rbcd.gather_to_global(Xf, graph, n_total)
-        return (rbcd.round_global(Xg, rbcd.lifting_matrix(meta, Xg.dtype)),
-                rbcd.global_weights(weights, graph, num_meas))
+    def _attempt(mesh_a, state_a, graph_a, start_it, start_nwu,
+                 boundary_cb, injector):
+        """One driver entry on one mesh: build the compiled step/segment
+        wrappers for ``mesh_a`` and run ``rbcd.run_rbcd`` from the given
+        absolute round index.  The supervisor loop below re-invokes this
+        after a rewind — possibly on a smaller mesh."""
+        size_a = int(mesh_a.devices.size)
+        shifts, plan = _exchange_plan(mesh_a, meta, graph_a, exchange)
+        sharded_step = make_sharded_step(mesh_a, meta, params, shifts, plan)
+        sharded_multi = make_sharded_multi_step(mesh_a, meta, params, shifts,
+                                                plan, overlap=overlap)
+        sharded_seg = make_sharded_segment(mesh_a, meta, params, shifts,
+                                           plan, overlap=overlap)
+        if injector is not None:
+            # Chaos seam (parallel.resilience): the injector counts
+            # dispatched rounds and may poison a seeded public pose —
+            # an async device op, never a host sync.
+            step = lambda s, uw, rs: sharded_step(
+                injector.before_dispatch(s, 1), graph_a,
+                update_weights=uw, restart=rs)
+            multi = lambda s, k: sharded_multi(
+                injector.before_dispatch(s, k), graph_a, k)
+            seg = lambda s, k, uw, rs: sharded_seg(
+                injector.before_dispatch(s, k), graph_a, k,
+                update_weights=uw, restart=rs)
+        else:
+            step = lambda s, uw, rs: sharded_step(s, graph_a,
+                                                  update_weights=uw,
+                                                  restart=rs)
+            multi = lambda s, k: sharded_multi(s, graph_a, k)
+            seg = lambda s, k, uw, rs: sharded_seg(s, graph_a, k,
+                                                   update_weights=uw,
+                                                   restart=rs)
+        metrics_factory = None
+        if verdict_every is not None:
+            # The device-resident verdict loop under sharding: the same
+            # driver (run_rbcd -> _run_verdict_loop), with the stacked-
+            # metrics body traced inside shard_map, reductions as psums.
+            metrics_factory = lambda telemetry: make_sharded_metrics_body(
+                mesh_a, graph_a, edges_g, n_total, num_meas, telemetry)
+        if run is not None:
+            bytes_round = comm_bytes_per_round(
+                meta, size_a,
+                shifts=shifts if exchange == "ppermute" else None,
+                accel=params.acceleration,
+                itemsize=np.dtype(dtype).itemsize,
+                greedy=params.schedule.value == "greedy")
+            run.event("sharded_solve", phase="setup", mesh_size=size_a,
+                      mesh_axes=list(mesh_a.axis_names), exchange=exchange,
+                      num_robots=num_robots,
+                      agents_per_shard=num_robots // size_a,
+                      comm_bytes_per_round=bytes_round,
+                      overlap=overlap, verdict_every=verdict_every,
+                      start_iteration=int(start_it))
+            run.gauge("sharded_comm_bytes_per_round",
+                      "modeled per-device interconnect bytes per round",
+                      unit="bytes").set(bytes_round)
+            # Mesh identity into the run fingerprint: a 1-device and an
+            # 8-device solve of the same problem are not comparable runs
+            # for the convergence regression gate (report --compare).
+            run.set_fingerprint(solver="solve_rbcd_sharded",
+                                mesh_size=size_a, exchange=exchange)
+        return rbcd.run_rbcd(state_a, graph_a, meta, step, part, max_iters,
+                             grad_norm_tol, eval_every, dtype, params=params,
+                             multi_step=multi, segment=seg,
+                             verdict_every=verdict_every,
+                             metrics_body_factory=metrics_factory,
+                             start_iteration=start_it,
+                             start_num_weight_updates=start_nwu,
+                             boundary_cb=boundary_cb)
 
-    T, w_glob = _finalize(Xa, res.state.weights)
+    def _append_gn_tail(res, graph_a, mesh_a):
+        """Device-resident GN-CG polish on the terminal iterate (the
+        sharded stall-breaker): same weighted objective the solve
+        minimized."""
+        Xa, tail = gn_tail_sharded(res.state.X, graph_a, meta, mesh=mesh_a,
+                                   cfg=gn_tail, weights=res.state.weights)
+        if run is not None:
+            run.event("gn_tail", phase="refine", sharded=True,
+                      outer_iterations=tail.outer_iterations,
+                      cg_iterations=tail.cg_iterations,
+                      terminated_by=tail.terminated_by,
+                      cost=tail.cost_history[-1]
+                      if tail.cost_history else None,
+                      grad_norm=tail.grad_norm_history[-1]
+                      if tail.grad_norm_history else None)
+
+        @jax.jit
+        def _finalize(Xf, weights):
+            Xg = rbcd.gather_to_global(Xf, graph_a, n_total)
+            return (rbcd.round_global(Xg,
+                                      rbcd.lifting_matrix(meta, Xg.dtype)),
+                    rbcd.global_weights(weights, graph_a, num_meas))
+
+        T, w_glob = _finalize(Xa, res.state.weights)
+        return dataclasses.replace(
+            res, T=T, X=Xa, weights=w_glob,
+            cost_history=res.cost_history + tail.cost_history,
+            grad_norm_history=res.grad_norm_history
+            + tail.grad_norm_history,
+            terminated_by=tail.terminated_by if tail.converged
+            else res.terminated_by,
+            state=res.state._replace(X=Xa))
+
+    if resilience is None:
+        res = _attempt(mesh, state, graph, 0, 0, None, None)
+        return res if gn_tail is None else _append_gn_tail(res, graph, mesh)
+
+    # -- the rewind supervisor (parallel.resilience) ------------------------
+    cfg = resilience
+    store = cfg.resolve_store()
+    sup = resilience_mod.CheckpointSupervisor(cfg, store, graph_host)
+    injector = cfg.injector
+    if injector is not None:
+        injector.arm(graph_host)
+    watchdog = resilience_mod.Watchdog(cfg.fetch_deadline_s) \
+        if cfg.fetch_deadline_s is not None else None
+    phase = ["sharded_verdict"]
+    mesh_cur, state_cur, graph_cur = mesh, state, graph
+    start_it = start_nwu = 0
+    sup.attach_mesh(mesh_size)
+    try:
+        with resilience_mod.fetch_guard(watchdog, injector, phase):
+            while True:
+                try:
+                    res = _attempt(mesh_cur, state_cur, graph_cur,
+                                   start_it, start_nwu, sup.boundary_cb,
+                                   injector)
+                    break
+                except (resilience_mod.AnomalyRewind,
+                        resilience_mod.MeshFaultError) as e:
+                    t0 = time.perf_counter()
+                    if injector is not None:
+                        # Unblock any simulated hang so abandoned
+                        # watchdog workers can exit.
+                        injector.release_hangs()
+                    new_size, host_state, start_it, start_nwu = \
+                        sup.recover(e, int(mesh_cur.devices.size),
+                                    num_robots)
+                    if new_size != int(mesh_cur.devices.size):
+                        mesh_cur = make_mesh(new_size)
+                    if host_state is None:
+                        # Cold restart: no usable snapshot — back to the
+                        # initial guess (factors already baked).
+                        host_state = state_host0
+                    else:
+                        # Rebake the factors from the stored weights
+                        # BEFORE sharding — the same host-then-shard
+                        # order as the initial build, so a same-mesh
+                        # resume is bitwise.
+                        host_state = rbcd.refresh_problem(
+                            host_state, graph_host, meta, params)
+                    state_cur, graph_cur = shard_problem(
+                        mesh_cur, host_state, graph_host)
+                    sup.attach_mesh(new_size)
+                    sup.note_overhead(time.perf_counter() - t0)
+            if gn_tail is not None:
+                phase[0] = "gn_tail"
+                res = _append_gn_tail(res, graph_cur, mesh_cur)
+    finally:
+        if injector is not None:
+            injector.release_hangs()
+        if watchdog is not None:
+            watchdog.close()
     return dataclasses.replace(
-        res, T=T, X=Xa, weights=w_glob,
-        cost_history=res.cost_history + tail.cost_history,
-        grad_norm_history=res.grad_norm_history + tail.grad_norm_history,
-        terminated_by=tail.terminated_by if tail.converged
-        else res.terminated_by,
-        state=res.state._replace(X=Xa))
+        res, recovered=res.recovered or sup.recoveries > 0,
+        resilience=sup.finish(injector))

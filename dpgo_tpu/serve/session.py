@@ -44,10 +44,20 @@ from ..models.incremental import state_from_arrays, state_to_arrays
 from ..models.rbcd import RBCDState
 
 #: Bump on any incompatible change to the snapshot array set.  A loader
-#: finding a different major version quarantines the file — resuming a
-#: solver from arrays with silently different semantics is worse than a
-#: cold re-solve.
-SESSION_SCHEMA_VERSION = 1
+#: finding an unknown version quarantines the file — resuming a solver
+#: from arrays with silently different semantics is worse than a cold
+#: re-solve.  v2 (the pod-scale resilience round) adds the OPTIONAL
+#: mesh tags ``__mesh_shape__`` / ``__global_index__``: the mesh the
+#: snapshot was taken on and the agent->global-pose layout it assumes,
+#: so a mesh-elastic restore can verify the layout before resuming.
+SESSION_SCHEMA_VERSION = 2
+
+#: Schema versions this reader accepts.  v1 snapshots are a strict
+#: subset of v2 (no mesh tags), so old single-device snapshots keep
+#: loading; v1-era readers see ``2 != 1`` and quarantine mesh-tagged
+#: snapshots (fail-open: recovery degrades to an older snapshot or a
+#: cold re-solve, never a mis-resumed one).
+_COMPAT_SCHEMAS = (1, 2)
 
 _SNAP_RE = re.compile(r"^snap-(\d{8})\.npz$")
 #: RBCDState fields every valid snapshot must carry (the optional
@@ -66,6 +76,10 @@ class SessionSnapshot:
     num_weight_updates: int
     state: RBCDState
     meta: dict
+    #: Mesh tags (schema v2, ``parallel.resilience``); None on v1
+    #: snapshots and single-device saves.
+    mesh_shape: tuple | None = None
+    global_index: "np.ndarray | None" = None
 
 
 def _sanitize(session_id: str) -> str:
@@ -111,15 +125,24 @@ class SessionStore:
     # -- writing -------------------------------------------------------------
 
     def save(self, session_id: str, state: RBCDState, iteration: int,
-             num_weight_updates: int = 0, meta: dict | None = None) -> str:
+             num_weight_updates: int = 0, meta: dict | None = None,
+             mesh_shape: tuple | None = None,
+             global_index=None) -> str:
         """Persist one snapshot atomically; prune to the ``keep`` newest.
         ``iteration`` doubles as the snapshot sequence number, so saves on
-        the solver's K-boundaries land in replayable order."""
+        the solver's K-boundaries land in replayable order.
+        ``mesh_shape`` / ``global_index`` are the v2 mesh tags
+        (``parallel.resilience``): the mesh the state was gathered from
+        and the agent->global-pose layout the arrays assume."""
         sdir = self._dir(session_id)
         arrays = state_to_arrays(state)
         arrays["__schema__"] = np.asarray(SESSION_SCHEMA_VERSION, np.int64)
         arrays["__iteration__"] = np.asarray(int(iteration), np.int64)
         arrays["__nwu__"] = np.asarray(int(num_weight_updates), np.int64)
+        if mesh_shape is not None:
+            arrays["__mesh_shape__"] = np.asarray(mesh_shape, np.int64)
+        if global_index is not None:
+            arrays["__global_index__"] = np.asarray(global_index)
         if meta:
             arrays["__meta__"] = np.frombuffer(
                 json.dumps(meta, sort_keys=True).encode("utf-8"), np.uint8)
@@ -152,9 +175,9 @@ class SessionStore:
         """Parse + validate one snapshot file; raises on any defect."""
         arrays = dict(np.load(path, allow_pickle=False))
         schema = int(np.asarray(arrays.pop("__schema__")))
-        if schema != SESSION_SCHEMA_VERSION:
-            raise ValueError(f"schema version {schema} != "
-                             f"{SESSION_SCHEMA_VERSION}")
+        if schema not in _COMPAT_SCHEMAS:
+            raise ValueError(f"schema version {schema} not in "
+                             f"{_COMPAT_SCHEMAS}")
         for f in _REQUIRED:
             if f not in arrays:
                 raise ValueError(f"missing state field {f!r}")
@@ -166,6 +189,11 @@ class SessionStore:
             "iteration": int(np.asarray(arrays.pop("__iteration__", 0))),
             "num_weight_updates": int(np.asarray(arrays.pop("__nwu__", 0))),
         }
+        mesh_shape = arrays.pop("__mesh_shape__", None)
+        book["mesh_shape"] = tuple(int(v) for v in np.asarray(mesh_shape)) \
+            if mesh_shape is not None else None
+        gidx = arrays.pop("__global_index__", None)
+        book["global_index"] = np.asarray(gidx) if gidx is not None else None
         raw_meta = arrays.pop("__meta__", None)
         book["meta"] = json.loads(bytes(np.asarray(raw_meta, np.uint8))
                                   .decode("utf-8")) \
@@ -202,7 +230,9 @@ class SessionStore:
                 session_id=str(session_id), path=path,
                 iteration=book["iteration"],
                 num_weight_updates=book["num_weight_updates"],
-                state=state_from_arrays(arrays), meta=book["meta"])
+                state=state_from_arrays(arrays), meta=book["meta"],
+                mesh_shape=book["mesh_shape"],
+                global_index=book["global_index"])
         return None
 
     # -- maintenance ---------------------------------------------------------

@@ -150,6 +150,15 @@ SLOW_TESTS = {
     "test_sharded_gn_tail_zero_transfers_inside_cg",
     "test_solve_sharded_with_gn_tail_extends_histories",
     "test_sharded_verdict_telemetry_and_report",
+    # ISSUE 14: the mesh-chaos acceptance suite re-solves the 8-device
+    # problem several times (fault-free reference + chaos runs, with
+    # recompiles on the shrunken 4/2-device meshes) — CI's `sharded`
+    # job runs it unfiltered under leakcheck.
+    "test_device_loss_resumes_on_smaller_mesh",
+    "test_nan_halo_trips_anomaly_rewind",
+    "test_double_device_loss_reshards_8_4_2",
+    "test_resilience_sync_rate_unchanged",
+    "test_hung_fetch_watchdog_rewind",
 }
 
 

@@ -591,9 +591,10 @@ def test_seeded_sharded_gn_tail_sync_violation(tmp_path):
     pdir.mkdir(parents=True)
     src = (REPO / "dpgo_tpu" / "parallel" / "sharded.py").read_text()
     bad = src.replace(
-        "        cost_hist.append(f_new)\n        X = X_new",
-        "        cost_hist.append(f_new)\n"
-        "        _dbg = rbcd._host_fetch(X_new)\n        X = X_new")
+        "            cost_hist.append(f_new)\n            X = X_new",
+        "            cost_hist.append(f_new)\n"
+        "            _dbg = rbcd._host_fetch(X_new)\n"
+        "            X = X_new")
     assert bad != src
     (pdir / "sharded.py").write_text(bad)
     findings = run_lint([str(tmp_path / "dpgo_tpu")], project_config())
@@ -610,10 +611,10 @@ def test_sanctioned_sharded_gn_tail_fetches_stay_suppressed(tmp_path):
     suppression makes DPG003 fire at that site."""
     src = (REPO / "dpgo_tpu" / "parallel" / "sharded.py").read_text()
     for marker in (
-            "        # dpgolint: disable=DPG003 -- sanctioned GN-tail "
-            "gate fetch\n",
-            "        # dpgolint: disable=DPG003 -- sanctioned per-outer "
-            "stats fetch\n"):
+            "            # dpgolint: disable=DPG003 -- sanctioned "
+            "GN-tail gate fetch\n",
+            "            # dpgolint: disable=DPG003 -- sanctioned "
+            "per-outer stats fetch\n"):
         stripped = src.replace(marker, "")
         assert stripped != src, marker
         pdir = tmp_path / marker.split()[-2] / "dpgo_tpu" / "parallel"
@@ -623,6 +624,47 @@ def test_sanctioned_sharded_gn_tail_fetches_stay_suppressed(tmp_path):
                             project_config())
         assert any(f.rule == "DPG003" and "_host_fetch" in f.message
                    for f in findings), (marker, findings)
+
+
+def test_seeded_resilience_checkpoint_sync_violation(tmp_path):
+    """ISSUE-14 seam: the checkpoint gather is the resilience layer's
+    ONE sanctioned device->host transfer — a NEW ``_host_fetch`` call
+    seeded into the ``checkpoint_arrays`` field loop must be flagged by
+    DPG003 via the configured ``sync_calls`` list, with file:line."""
+    pdir = tmp_path / "dpgo_tpu" / "parallel"
+    pdir.mkdir(parents=True)
+    src = (REPO / "dpgo_tpu" / "parallel" / "resilience.py").read_text()
+    bad = src.replace(
+        "        host[f] = _host_fetch(v)",
+        "        host[f] = _host_fetch(v)\n"
+        "        _dbg = _host_fetch(v)")
+    assert bad != src
+    (pdir / "resilience.py").write_text(bad)
+    findings = run_lint([str(tmp_path / "dpgo_tpu")], project_config())
+    hits = [f for f in findings if f.rule == "DPG003"
+            and "sync seam" in f.message]
+    assert hits, findings
+    assert all(f.path.endswith("parallel/resilience.py") and f.line > 0
+               for f in hits)
+
+
+def test_sanctioned_resilience_checkpoint_gather_stays_suppressed(
+        tmp_path):
+    """The reviewed checkpoint-gather fetch must remain suppressed on
+    the real tree: stripping the suppression makes DPG003 fire at that
+    site, and the real module lints clean under the full policy."""
+    src = (REPO / "dpgo_tpu" / "parallel" / "resilience.py").read_text()
+    marker = ("        # dpgolint: disable=DPG003 -- sanctioned mesh "
+              "checkpoint gather\n")
+    stripped = src.replace(marker, "")
+    assert stripped != src
+    pdir = tmp_path / "dpgo_tpu" / "parallel"
+    pdir.mkdir(parents=True)
+    (pdir / "resilience.py").write_text(stripped)
+    findings = run_lint([str(tmp_path / "dpgo_tpu")], project_config())
+    assert any(f.rule == "DPG003" and "_host_fetch" in f.message
+               and f.path.endswith("parallel/resilience.py")
+               for f in findings), findings
 
 
 def test_sanctioned_verdict_fetches_stay_suppressed(monkeypatch):

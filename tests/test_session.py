@@ -105,6 +105,65 @@ def test_corrupt_newest_falls_back_to_previous(tmp_path, corrupt):
     assert store.load_newest("sess").iteration == 10
 
 
+def test_v1_snapshot_loads_under_v2_reader(tmp_path):
+    """Schema back-compat (ISSUE 14): a v1-era snapshot (no mesh tags)
+    is a strict subset of v2 and must keep loading — mesh_shape /
+    global_index simply come back None."""
+    meas = _problem()
+    st = _solved_state(meas)
+    store = SessionStore(str(tmp_path / "s"))
+    arrays = state_to_arrays(st)
+    arrays["__schema__"] = np.asarray(1, np.int64)
+    arrays["__iteration__"] = np.asarray(40, np.int64)
+    arrays["__nwu__"] = np.asarray(3, np.int64)
+    sdir = tmp_path / "s" / "sess"
+    sdir.mkdir(parents=True)
+    with open(sdir / "snap-00000040.npz", "wb") as fh:
+        np.savez_compressed(fh, **arrays)
+    snap = store.load_newest("sess")
+    assert snap is not None and snap.iteration == 40
+    assert snap.num_weight_updates == 3
+    assert snap.mesh_shape is None and snap.global_index is None
+    for f, v in state_to_arrays(st).items():
+        np.testing.assert_array_equal(np.asarray(getattr(snap.state, f)), v)
+
+
+def test_mesh_tagged_snapshot_round_trips_and_old_reader_fails_open(
+        tmp_path, monkeypatch):
+    """Mesh-tagged v2 snapshots (parallel.resilience) round-trip the
+    mesh shape + global-index layout; a v1-era reader (emulated by
+    pinning _COMPAT_SCHEMAS back to (1,)) refuses them — quarantined,
+    then fail-open to an older v1 snapshot rather than mis-resuming."""
+    from dpgo_tpu.serve import session as session_mod
+
+    meas = _problem()
+    st = _solved_state(meas)
+    store = SessionStore(str(tmp_path / "s"), keep=3)
+    # An old v1 snapshot underneath...
+    arrays = state_to_arrays(st)
+    arrays["__schema__"] = np.asarray(1, np.int64)
+    arrays["__iteration__"] = np.asarray(10, np.int64)
+    sdir = tmp_path / "s" / "sess"
+    sdir.mkdir(parents=True)
+    with open(sdir / "snap-00000010.npz", "wb") as fh:
+        np.savez_compressed(fh, **arrays)
+    # ...then a newer mesh-tagged v2 one.
+    gidx = np.arange(48).reshape(2, 24)
+    store.save("sess", st, iteration=20, mesh_shape=(8,),
+               global_index=gidx)
+    snap = store.load_newest("sess")
+    assert snap.iteration == 20 and snap.mesh_shape == (8,)
+    np.testing.assert_array_equal(snap.global_index, gidx)
+
+    # The v1-era reader: quarantines the v2 file, falls back to v1.
+    monkeypatch.setattr(session_mod, "_COMPAT_SCHEMAS", (1,))
+    old = store.load_newest("sess")
+    assert old is not None and old.iteration == 10
+    names = sorted(p.name for p in sdir.iterdir())
+    assert "snap-00000020.npz.quarantined" in names
+    assert "snap-00000020.npz" not in names
+
+
 def test_all_snapshots_corrupt_yields_none(tmp_path):
     meas = _problem()
     st = _solved_state(meas)

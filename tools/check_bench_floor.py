@@ -24,8 +24,12 @@ For a ``bench_sharded.py`` MULTICHIP record (``record == "MULTICHIP"``):
 the weak-scaling arm is present, device counts ascend, every arm carries
 positive rounds/s + poses/s, the sharded verdict cadence keeps host
 syncs at <= 100/K, the overlap A/B and GN-tail parity blocks are sane
-(tail parity <= 1e-6 when the arm ran), and a scale_test block (when
-present) actually completed through the sharded verdict path.
+(tail parity <= 1e-6 when the arm ran), a scale_test block (when
+present) actually completed through the sharded verdict path, and a
+resilience block (the ISSUE-14 chaos arm, when present and not skipped)
+recovered at least once, matched the fault-free cost within
+RESILIENCE_MAX_COST_REL (default 1e-2), and kept the recovery overhead
+under RESILIENCE_MAX_RECOVERY_S (default 120s per recovery).
 
 For a ``bench_fleet.py`` FLEET record (``record == "FLEET"``; ISSUE 13):
 the QPS arms ascend in replica count with positive QPS, throughput
@@ -49,6 +53,10 @@ PARITY_BOUND = float(os.environ.get("BENCH_PARITY_BOUND", "7.7e-6"))
 MIN_VERDICT_K = int(os.environ.get("BENCH_MIN_VERDICT_K", "4"))
 GN_TAIL_PARITY_BOUND = float(
     os.environ.get("BENCH_GN_TAIL_PARITY_BOUND", "1e-6"))
+RESILIENCE_MAX_RECOVERY_S = float(
+    os.environ.get("RESILIENCE_MAX_RECOVERY_S", "120"))
+RESILIENCE_MAX_COST_REL = float(
+    os.environ.get("RESILIENCE_MAX_COST_REL", "1e-2"))
 
 
 def fail(msg: str) -> None:
@@ -106,11 +114,32 @@ def check_multichip(rec: dict) -> None:
         for key in ("n_poses", "num_robots", "rounds"):
             if not _num(scale.get(key)) or scale[key] <= 0:
                 fail(f"scale_test field {key!r} bad: {scale}")
+    rz = rec.get("resilience")
+    if rz and not rz.get("skipped"):
+        # The chaos arm injected a fault on purpose: zero recoveries
+        # means the injector/supervisor wiring is dead, not that the
+        # mesh was lucky.
+        if not _num(rz.get("recoveries")) or rz["recoveries"] < 1:
+            fail(f"resilience arm recorded no recoveries: {rz}")
+        if not _num(rz.get("final_cost_rel_err")) \
+                or rz["final_cost_rel_err"] > RESILIENCE_MAX_COST_REL:
+            fail(f"resilience final cost off by "
+                 f"{rz.get('final_cost_rel_err')!r} "
+                 f"(> {RESILIENCE_MAX_COST_REL}) vs fault-free")
+        overhead = rz.get("recovery_overhead_s")
+        if not _num(overhead) \
+                or overhead > RESILIENCE_MAX_RECOVERY_S * rz["recoveries"]:
+            fail(f"recovery overhead {overhead!r}s exceeds "
+                 f"{RESILIENCE_MAX_RECOVERY_S}s per recovery "
+                 f"x{rz['recoveries']}")
     print(f"bench floor gate: PASS — MULTICHIP schema ok "
           f"({rec['n_devices']} devices, {len(ws)} weak-scaling arms, "
           f"{syncs} syncs/100 rounds at K={k}"
           + (f", scale_test {scale['n_poses']} poses ok"
-             if scale and not scale.get("skipped") else "") + ")")
+             if scale and not scale.get("skipped") else "")
+          + (f", chaos arm {rz['recoveries']} recoveries "
+             f"({rz['recovery_overhead_s']:.1f}s overhead)"
+             if rz and not rz.get("skipped") else "") + ")")
 
 
 def check_fleet(rec: dict) -> None:

@@ -61,6 +61,7 @@ def project_config() -> Config:
                 "dpgo_tpu/models/incremental.py",
                 "dpgo_tpu/serve/runner.py",
                 "dpgo_tpu/parallel/sharded.py",
+                "dpgo_tpu/parallel/resilience.py",
             ],
             # DPG002: obs-owned constructions anywhere in the package must
             # sit behind the telemetry fence; the obs internals that ARE
@@ -75,6 +76,7 @@ def project_config() -> Config:
                 "dpgo_tpu/models/incremental.py",
                 "dpgo_tpu/serve/runner.py",
                 "dpgo_tpu/parallel/sharded.py",
+                "dpgo_tpu/parallel/resilience.py",
             ],
             # DPG004 is annotation-driven (# guarded-by) — run everywhere;
             # files without annotations produce nothing.
@@ -146,6 +148,20 @@ def project_config() -> Config:
                     "dpgo_tpu/parallel/sharded.py": {
                         "hot_functions": ["gn_tail_sharded",
                                           "solve_rbcd_sharded"],
+                        "sync_calls": ["_host_fetch"],
+                    },
+                    # The resilience layer (ISSUE 14): the checkpoint
+                    # gather is the ONE sanctioned device->host transfer
+                    # of the whole subsystem — it runs only at a verdict
+                    # boundary the driver already paid a word-fetch for,
+                    # through resilience.py's own _host_fetch seam (so
+                    # the driver's sync-rate contract is untouched), and
+                    # carries a reviewed suppression.  Any other fetch in
+                    # the checkpoint/boundary loop is a new steady-state
+                    # sync and is flagged.
+                    "dpgo_tpu/parallel/resilience.py": {
+                        "hot_functions": ["checkpoint_arrays",
+                                          "boundary_cb"],
                         "sync_calls": ["_host_fetch"],
                     },
                 },
