@@ -200,9 +200,9 @@ def time_verdict_loop(device, dtype, rounds, k):
             assert all(np.isfinite(c) for c in res.cost_history), \
                 "non-finite cost in verdict history"
             fetches = counted[0]
-            # 2-call terminal epilogue (history + latched indices) is
-            # once-per-solve, like _finalize — excluded from the rate.
-            sync_rates.append(100.0 * max(fetches - 2, 0) / rounds)
+            # The single fused terminal-epilogue fetch is once-per-solve,
+            # like _finalize — excluded from the rate.
+            sync_rates.append(100.0 * max(fetches - 1, 0) / rounds)
             rates.append(rounds / dt)
             log(f"  [{device.platform}] verdict trial: "
                 f"{rounds / dt:.1f} rounds/s, {fetches} host fetches")

@@ -90,6 +90,13 @@ def main(argv=None) -> int:
     ap.add_argument("--profile-dir", metavar="DIR", default=None,
                     help="jax.profiler window over the first batches "
                          "(requires --telemetry)")
+    ap.add_argument("--certified", action="store_true",
+                    help="add the certified arm: the same batch with "
+                         "certify_mode='device' (the dual certificate "
+                         "fused into the terminal epilogue), recording "
+                         "certified p50/p99 latency alongside the plain "
+                         "arm's")
+    ap.add_argument("--certify-eta", type=float, default=1e-5)
     args = ap.parse_args(argv)
 
     from dpgo_tpu.serve import ServeSLO, SolveRequest, SolveServer
@@ -165,6 +172,53 @@ def main(argv=None) -> int:
         p99 = lat[min(len(lat) - 1, int(round(0.99 * (len(lat) - 1))))] \
             if lat else None
 
+        # --- Arm 3 (--certified): the same batch, certified replies ------
+        cert_fields = {}
+        if args.certified:
+            import dataclasses as _dc
+
+            params_c = _dc.replace(params, certify_mode="device",
+                                   certify_eta=args.certify_eta)
+            t0 = time.perf_counter()
+            with SolveServer(max_batch=args.max_batch, batch_window_s=0.02,
+                             quantum=args.quantum) as srv_c:
+                tickets_c = [
+                    srv_c.submit(SolveRequest(
+                        meas=m, num_robots=args.robots, params=params_c,
+                        tenant=f"tenant{k % max(1, args.tenants)}",
+                        max_iters=args.max_iters, grad_norm_tol=gtol,
+                        eval_every=args.eval_every))
+                    for k, m in enumerate(problems)
+                ]
+                cert_results = [t.result(timeout=3600) for t in tickets_c]
+                lat_c = sorted(t.latency_s for t in tickets_c
+                               if t.latency_s is not None)
+            t_cert = time.perf_counter() - t0
+            certs = [r.certificate for r in cert_results]
+            if any(c is None for c in certs):
+                log("CERTIFIED ARM FAIL: a result came back without a "
+                    "certificate")
+                return 1
+            n_acc = sum(bool(c.certified) for c in certs)
+            cp50 = lat_c[len(lat_c) // 2] if lat_c else None
+            cp99 = lat_c[min(len(lat_c) - 1,
+                             int(round(0.99 * (len(lat_c) - 1))))] \
+                if lat_c else None
+            log(f"[certified] {t_cert:.2f}s "
+                f"({args.n_problems / t_cert:.3f} problems/s), "
+                f"{n_acc}/{len(certs)} accepted, p99 "
+                f"{cp99 if cp99 is not None else float('nan'):.4f}s")
+            cert_fields = dict(
+                certified_qps=round(args.n_problems / t_cert, 4),
+                certified_latency_p50_s=round(cp50, 4)
+                if cp50 is not None else None,
+                certified_latency_p99_s=round(cp99, 4)
+                if cp99 is not None else None,
+                certified_accepted=n_acc,
+                certified_total=len(certs),
+                certify_eta=args.certify_eta,
+            )
+
         rec = metric_record(
             "serving_batched_qps",
             round(qps_batch, 4),
@@ -180,6 +234,7 @@ def main(argv=None) -> int:
             cache_hits=cache["hits"],
             max_batch=args.max_batch,
             quantum=args.quantum,
+            **cert_fields,
         )
         if run is not None:
             # The bench record rides the run's event stream too, so the

@@ -224,8 +224,8 @@ def weak_scaling_arm(dev_list, dtype):
         finally:
             rbcd._host_fetch = orig
         rps = float(np.median(rates))
-        # 2-call terminal epilogue excluded, as in bench.py.
-        syncs_last = 100.0 * max(counted[0] - 2, 0) / rounds
+        # The one fused terminal-epilogue fetch excluded, as in bench.py.
+        syncs_last = 100.0 * max(counted[0] - 1, 0) / rounds
         arms.append({"devices": n_dev, "num_robots": robots, "n_poses": n,
                      "rounds_per_s": round(rps, 3),
                      "poses_per_s": round(rps * n, 1),
@@ -369,8 +369,17 @@ def gn_tail_arm(dtype):
 def scale_arm(dtype=jnp.float32):
     """The functional large-scale solve, end to end through the sharded
     verdict loop (odometry init — chordal at this scale is a bench of the
-    init, not the loop)."""
-    from dpgo_tpu.parallel import make_mesh
+    init, not the loop), then the CERTIFIED row: the terminal iterate
+    polished by the sharded GN-CG tail and judged by the fused device
+    certificate (``rbcd.make_terminal_epilogue(certify_mode="device")``)
+    — a true dual certificate at the 1M-pose scale, not a proxy.  The
+    host-f64 REFUSE fallback is deliberately NOT run here (a sparse
+    million-pose eigensolve on the bench host is its own benchmark); a
+    REFUSE is recorded as refused."""
+    from dpgo_tpu.models import certify as certify_mod
+    from dpgo_tpu.models import rbcd, refine
+    from dpgo_tpu.parallel import gn_tail_sharded, make_mesh
+    from dpgo_tpu.types import edge_set_from_measurements
 
     if ARGS.scale_poses <= 0:
         return {"skipped": "disabled (--scale-poses 0)"}
@@ -382,8 +391,9 @@ def scale_arm(dtype=jnp.float32):
     t_build = time.perf_counter() - t_build0
     log(f"  [scale] built {n} poses / {robots} agents in {t_build:.1f}s")
     mesh = make_mesh(_MAX_DEV)
-    drive, *_ = sharded_driver(mesh, part, graph, meta, state, params,
-                               dtype, ARGS.scale_verdict_k)
+    drive, _state_s, graph_s, _ = sharded_driver(
+        mesh, part, graph, meta, state, params, dtype,
+        ARGS.scale_verdict_k)
     t0 = time.perf_counter()
     res = drive(ARGS.scale_rounds)
     wall = time.perf_counter() - t0
@@ -393,6 +403,27 @@ def scale_arm(dtype=jnp.float32):
     log(f"  [scale] {res.iterations} rounds through the sharded verdict "
         f"loop in {wall:.1f}s; cost {res.cost_history[0]:.4g} -> "
         f"{res.cost_history[-1]:.4g}")
+
+    # Certified row: GN-CG polish + device certificate, one terminal
+    # fetch through the fused epilogue.
+    t_c0 = time.perf_counter()
+    Xa, tail = gn_tail_sharded(res.state.X, graph_s, meta, mesh=mesh,
+                               cfg=refine.GNTailConfig(max_outer=4),
+                               weights=res.state.weights)
+    edges_g = edge_set_from_measurements(part.meas_global, dtype=dtype)
+    epilogue = rbcd.make_terminal_epilogue(
+        graph_s, edges_g, part.meas_global.num_poses,
+        len(part.meas_global), meta, certify_mode="device")
+    eta = 1e-3 if np.dtype(dtype) == np.float32 else 1e-5
+    fin = rbcd._host_fetch(epilogue(Xa, res.state.weights, {}))
+    cert = certify_mod.decide_device_certificate(
+        fin["cert"], eta, float(np.finfo(np.dtype(dtype)).eps),
+        f64_solve=None, source="bench_scale")
+    t_cert = time.perf_counter() - t_c0
+    log(f"  [scale] certificate: "
+        f"{certify_mod.CERT_STATUS[cert.device_verdict]} "
+        f"(lam_min {cert.lambda_min:.3g}, tol {cert.tol:.3g}) "
+        f"in {t_cert:.1f}s")
     return {"n_poses": n, "num_robots": robots,
             "devices": _MAX_DEV, "rounds": int(res.iterations),
             "verdict_every": ARGS.scale_verdict_k,
@@ -402,6 +433,13 @@ def scale_arm(dtype=jnp.float32):
             "poses_per_s": round(n * res.iterations / wall, 1),
             "cost_first_eval": res.cost_history[0],
             "cost_last_eval": res.cost_history[-1],
+            "certified": bool(cert.certified),
+            "cert_status": certify_mod.CERT_STATUS[cert.device_verdict],
+            "cert_lambda_min": float(cert.lambda_min),
+            "cert_tol": float(cert.tol),
+            "cert_eta": eta,
+            "gn_tail_terminated_by": tail.terminated_by,
+            "certify_s": round(t_cert, 1),
             "dtype": str(np.dtype(dtype))}
 
 

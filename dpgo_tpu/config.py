@@ -171,6 +171,18 @@ class AgentParams:
     # iterate (the exact pre-verdict behavior); telemetry-on runs always
     # fetch per iterate regardless (the events carry the scalar).
     status_fetch_every: int = 1
+    # Terminal certification (ROADMAP item 3): "off" returns no
+    # certificate; "device" folds a gauge-deflated LOBPCG on the dual
+    # operator S = Q - Lambda into the solve's terminal epilogue so the
+    # certificate rides the single terminal fetch (the host sparse/f64
+    # path runs only when the f32 verdict lands in the disagreement band
+    # and is REFUSEd); "host" runs the legacy post-hoc
+    # ``certify.certify_solution`` host round-trip on the rounded result.
+    certify_mode: str = "off"
+    # Relative suboptimality tolerance for the terminal certificate
+    # (same eta as ``certify.certify_solution``; the acceptance threshold
+    # is eta * weight_scale(edges)).
+    certify_eta: float = 1e-5
     # Schedule for the TPU step function
     schedule: Schedule = Schedule.JACOBI
     # Probability that an agent fires in a given ASYNC round (Poisson-clock

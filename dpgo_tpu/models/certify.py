@@ -49,6 +49,20 @@ from .local_pgo import make_problem, round_solution
 # Dual certificate operator
 # ---------------------------------------------------------------------------
 
+# Latched verdict codes of the DEVICE certificate stage (the f32
+# eigensolve fused into the solve's terminal epilogue).  The f32-vs-f64
+# disagreement band is an explicit verdict — CERT_REFUSE — not a silent
+# recheck: a REFUSE hands the decision to the host sparse/f64 path, and
+# no solve is ever certified by f32 alone inside the band.
+CERT_NONE = 0      # certify_mode off / certificate not evaluated
+CERT_ACCEPT = 1    # f32 verdict decisive and PSD within tolerance
+CERT_REFUSE = 2    # disagreement band: host f64 must decide
+CERT_FAIL = 3      # decisively negative (sound without f64)
+
+CERT_STATUS = {CERT_NONE: "none", CERT_ACCEPT: "accept",
+               CERT_REFUSE: "refuse", CERT_FAIL: "fail"}
+
+
 def dual_blocks(X: jax.Array, edges: EdgeSet) -> jax.Array:
     """Block-diagonal dual multipliers Lambda [n, d, d] at a critical point.
 
@@ -90,6 +104,9 @@ class CertificateResult:
     weight_scale: float = float("nan")
     decidable: bool = True
     lambda_min_f64: float | None = None
+    # Device-epilogue verdict (CERT_* code) when the certificate rode the
+    # fused terminal fetch; CERT_NONE for the legacy post-hoc paths.
+    device_verdict: int = CERT_NONE
 
 
 def weight_scale(edges: EdgeSet) -> float:
@@ -115,6 +132,19 @@ def weight_scale(edges: EdgeSet) -> float:
     if k.size == 0:
         return 1.0
     return float(max(np.median(w * k), np.median(w * t), 1.0))
+
+
+def weight_scale_device(edges: EdgeSet) -> jax.Array:
+    """Device twin of ``weight_scale``: same median-of-weighted-
+    concentrations yardstick, computed with jnp so it can ride the fused
+    terminal epilogue (masked-out edges become NaN and ``nanmedian``
+    skips them; an all-masked edge set degrades to the same 1.0 floor)."""
+    m = edges.mask > 0
+    w = edges.weight * edges.mask
+    med_k = jnp.nanmedian(jnp.where(m, w * edges.kappa, jnp.nan))
+    med_t = jnp.nanmedian(jnp.where(m, w * edges.tau, jnp.nan))
+    scale = jnp.maximum(jnp.maximum(med_k, med_t), 1.0)
+    return jnp.where(jnp.isnan(scale), 1.0, scale)
 
 
 @partial(jax.jit, static_argnames=("num_probe", "power_iters", "lobpcg_iters"))
@@ -286,29 +316,260 @@ def decide_certificate(lam_eig: float, sigma: float, tol: float,
         # within 50 ulps of the tolerance goes to f64.
         return False, True, lam_eig, None, None
     if not decidable and f64_solve is not None:
-        lam_f64, vec64, resid = f64_solve(0.25 * tol)
-        lam_used = lam_f64
-        # Two-sided interval decision on the f64 eigenpair: the residual
-        # places a true eigenvalue within ``resid`` of ``lam_f64``, so
-        #   lam_f64 + resid < -tol  => an eigenvalue below -tol exists
-        #                              (sound FAIL), and
-        #   lam_f64 - resid >= -tol => the targeted bottom eigenvalue
-        #                              clears -tol (PASS — trusting the
-        #                              warm-started, gauge-deflated solve
-        #                              targeted the minimal subspace,
-        #                              the same trust assumption every
-        #                              Krylov certificate makes).
-        # Anything in between is refused.  This replaces the round-5
-        # draft rule ``resid <= tol/2`` which refused a CONVERGED-to-0
-        # eigenvalue whose residual (2e-4) merely missed an arbitrary
-        # threshold while the verdict itself was unambiguous.
-        certified = lam_f64 - resid >= -tol
-        decidable = certified or (lam_f64 + resid < -tol)
-        return (bool(certified), bool(decidable), lam_used, lam_f64,
-                vec64)
+        certified, decidable, lam_f64, vec64 = f64_recheck(f64_solve, tol)
+        return certified, decidable, lam_f64, lam_f64, vec64
     lam_used = lam_eig
     return (bool(decidable and lam_used >= -tol), bool(decidable),
             lam_used, lam_f64, vec64)
+
+
+def f64_recheck(f64_solve, tol: float):
+    """REFUSE-band fallback: the host f64 eigensolve decides.
+
+    Two-sided interval decision on the f64 eigenpair (shared by
+    ``decide_certificate`` and the device-epilogue path): the residual
+    places a true eigenvalue within ``resid`` of ``lam_f64``, so
+      lam_f64 + resid < -tol  => an eigenvalue below -tol exists
+                                 (sound FAIL), and
+      lam_f64 - resid >= -tol => the targeted bottom eigenvalue
+                                 clears -tol (PASS — trusting the
+                                 warm-started, gauge-deflated solve
+                                 targeted the minimal subspace,
+                                 the same trust assumption every
+                                 Krylov certificate makes).
+    Anything in between is refused.  This replaces the round-5 draft
+    rule ``resid <= tol/2`` which refused a CONVERGED-to-0 eigenvalue
+    whose residual (2e-4) merely missed an arbitrary threshold while
+    the verdict itself was unambiguous.
+
+    Returns ``(certified, decidable, lam_f64, vec64)``.
+    """
+    lam_f64, vec64, resid = f64_solve(0.25 * tol)
+    certified = lam_f64 - resid >= -tol
+    decidable = certified or (lam_f64 + resid < -tol)
+    return bool(certified), bool(decidable), lam_f64, vec64
+
+
+# ---------------------------------------------------------------------------
+# Device-resident certificate (fused terminal epilogue, ROADMAP item 3)
+# ---------------------------------------------------------------------------
+
+def device_certificate_payload(X: jax.Array, edges: EdgeSet, key,
+                               num_probe: int = 4, power_iters: int = 30,
+                               lobpcg_iters: int = 300) -> dict:
+    """Everything the HOST needs to decide the certificate, computed as
+    one traceable program so it can ride the solve's fused terminal
+    epilogue (a single blocking fetch).
+
+    Unlike ``_min_eig_jit`` this eigensolve is GAUGE-DEFLATED on device:
+    at a stationary point the r rows of X span exact zero-eigenvalue
+    directions of S, a cluster that stalls LOBPCG's convergence to the
+    bottom of the spectrum.  The probes are constrained to the
+    complement via the projector ``P = I - Yc Yc^T`` (Yc = orthonormal
+    basis of the rows), the LOBPCG runs on ``P (sigma I - S) P``, and
+    the full-space minimum is ``min(lambda_complement, 0)`` since the
+    deflated directions contribute exact zeros.
+
+    The payload also carries the two soundness probes the host decision
+    needs (``decide_device_certificate``):
+
+    * ``defl_resid`` — max column norm of ``S Yc``: the deflation is
+      only valid near stationarity; a PASS with an invalid deflation
+      basis is unsound and must be refused (same ``0.1 * tol`` bound as
+      ``lambda_min_f64_shift_invert``).
+    * ``rq`` — the explicit Rayleigh quotient of the returned unit
+      direction on S: ``RQ(v) >= lambda_min`` for ANY v, so a decisively
+      negative RQ is an unconditional FAIL even if the eigensolve itself
+      did not converge.
+
+    All outputs are scalars (plus the ``[n, d+1]`` direction), cheap to
+    fetch; no decision happens here — f32 never certifies alone.
+    """
+    from jax.experimental.sparse.linalg import lobpcg_standard
+
+    n, r, dh = X.shape
+    dtype = X.dtype
+    dim = n * dh
+    # lobpcg_standard requires 5*k < dim; shapes are static at trace
+    # time, so the tiny-problem clamp is Python int math.
+    num_probe = max(1, min(num_probe, (dim - 1) // 5))
+    lam = dual_blocks(X, edges)
+
+    def S(V):  # [n, k, d+1] -> [n, k, d+1]
+        return certificate_matvec(V, edges, lam)
+
+    def S_flat(Vf):  # [n(d+1), k]
+        k = Vf.shape[1]
+        V = Vf.T.reshape(k, n, dh).transpose(1, 0, 2)
+        return S(V).transpose(1, 0, 2).reshape(k, dim).T
+
+    # Spectral upper bound: power iteration on S (symmetric, so dominant
+    # |eigenvalue|); sigma slightly above max(|lambda|_max, 0).
+    def power_body(_, v):
+        w = S(v)
+        return w / jnp.maximum(jnp.linalg.norm(w), 1e-30)
+
+    v0 = jax.random.normal(key, (n, 1, dh), dtype)
+    v0 = v0 / jnp.linalg.norm(v0)
+    v = jax.lax.fori_loop(0, power_iters, power_body, v0)
+    lam_dom = jnp.sum(v * S(v))
+    sigma = 1.1 * jnp.abs(lam_dom) + 1e-3
+
+    # Gauge basis: the SIGNIFICANT left-singular directions of X's rows.
+    # At (near-)optimality X itself is low-rank (rank ~ d+1 < r), and a
+    # plain QR of the rank-deficient row basis manufactures arbitrary
+    # complement directions that are NOT near-kernel — deflating along
+    # them would blind the eigensolve, and the defl_resid guard below
+    # would (correctly) veto every ACCEPT.  Insignificant directions are
+    # instead left in the complement where the LOBPCG sees them like any
+    # other; the soundness guard only needs the directions we actually
+    # remove to be near-kernel.
+    Yf = X.transpose(1, 0, 2).reshape(r, dim).T           # [dim, r]
+    U_g, sv, _ = jnp.linalg.svd(Yf, full_matrices=False)
+    keep = (sv > jnp.max(sv) * jnp.sqrt(jnp.finfo(dtype).eps)
+            ).astype(dtype)                               # [r]
+    Yc = U_g * keep[None, :]
+    SYc = S_flat(U_g)
+    defl_resid = jnp.max(jnp.linalg.norm(SYc, axis=0) * keep)
+
+    def project(Vf):
+        return Vf - Yc @ (Yc.T @ Vf)
+
+    def A_flat(Vf):  # P (sigma I - S) P
+        Pv = project(Vf)
+        return project(sigma * Pv - S_flat(Pv))
+
+    key2 = jax.random.fold_in(key, 1)
+    V0 = project(jax.random.normal(key2, (dim, num_probe), dtype))
+    theta, U, _ = lobpcg_standard(A_flat, V0, m=lobpcg_iters)
+    lam_comp = sigma - theta[0]
+    # Gauge zeros complete the spectrum: full-space minimum.
+    lam_min = jnp.minimum(lam_comp, 0.0)
+
+    vec_f = U[:, 0]
+    vec_f = vec_f / jnp.maximum(jnp.linalg.norm(vec_f), 1e-30)
+    # Explicit Rayleigh quotient of the unit direction on the TRUE
+    # operator — the sound one-sided FAIL bound.
+    rq = jnp.sum(vec_f * S_flat(vec_f[:, None])[:, 0])
+    vec = vec_f.reshape(n, dh)
+
+    XS = certificate_matvec(X, edges, lam)
+    stat = jnp.sqrt(jnp.sum(XS * XS))
+    return {
+        "lam_min": lam_min,
+        "sigma": sigma,
+        "stat": stat,
+        "wscale": weight_scale_device(edges),
+        "defl_resid": defl_resid,
+        "rq": rq,
+        "direction": vec,
+    }
+
+
+def decide_device_certificate(payload: dict, eta: float, dtype_eps: float,
+                              f64_solve=None,
+                              source: str = "device_epilogue",
+                              ) -> CertificateResult:
+    """HOST decision on an already-fetched device certificate payload.
+
+    Mirrors ``decide_certificate``'s ladder exactly, with the deflation
+    validity bound gating only the ACCEPT side (a FAIL via the Rayleigh
+    quotient is sound regardless of deflation):
+
+    * decidable (``10 ulps of sigma`` resolves tol) and lam >= -tol and
+      the deflation basis is near-kernel  -> CERT_ACCEPT;
+    * decidable and lam < -tol            -> CERT_FAIL (f32 decides);
+    * undecidable but lam or rq is below ``-tol`` by 50x the error
+      band                                 -> CERT_FAIL (sound shortcut,
+      same asymmetric rule as ``decide_certificate``);
+    * anything else                        -> CERT_REFUSE, and the host
+      f64 path (``f64_solve``) decides via ``f64_recheck`` when
+      provided — never the f32 value.
+
+    The payload values arrive as 0-d arrays from the fused terminal
+    fetch; everything here is host float math (no device sync).
+    """
+    run = obs.get_run()
+    t0 = time.perf_counter() if run is not None else 0.0
+    lam = float(payload["lam_min"])
+    sigma = float(payload["sigma"])
+    rq = float(payload["rq"])
+    wscale = float(payload["wscale"])
+    defl_resid = float(payload["defl_resid"])
+    stat = float(payload["stat"])
+    direction = payload["direction"]
+    tol = eta * wscale
+    err_est = 10.0 * dtype_eps * sigma
+    defl_ok = defl_resid <= 0.1 * tol
+    decidable = err_est <= 0.5 * tol
+
+    verdict = CERT_REFUSE
+    certified = False
+    lam_used = lam
+    lam_f64 = None
+    if decidable and lam < -tol:
+        verdict, decidable = CERT_FAIL, True
+    elif decidable and defl_ok and lam >= -tol:
+        verdict, certified = CERT_ACCEPT, True
+    elif min(lam, rq) + 50.0 * err_est < -tol:
+        # Decisively negative even through the undecidable band — the
+        # RQ bound makes this sound without f64 (under-certify only).
+        verdict, decidable, lam_used = CERT_FAIL, True, min(lam, rq)
+    elif f64_solve is not None:
+        certified, decidable, lam_f64, vec64 = f64_recheck(f64_solve, tol)
+        lam_used = lam_f64
+        if vec64 is not None:
+            direction = jnp.asarray(vec64, payload["direction"].dtype)
+    else:
+        decidable = False
+    if run is not None:
+        gap = lam_used + tol
+        run.gauge("certificate_eigenvalue_gap",
+                  "lambda_min + tol of the dual certificate").set(gap)
+        run.gauge("certificate_lambda_min",
+                  "minimum eigenvalue of the certificate operator").set(
+            lam_used)
+        run.counter("certificates_evaluated",
+                    "certify_solution calls").inc()
+        run.event("certificate", phase="certify",
+                  certified=certified, decidable=decidable,
+                  lambda_min=lam, lambda_min_f64=lam_f64,
+                  eigenvalue_gap=gap, tol=tol, sigma=sigma,
+                  stationarity_gap=stat,
+                  device_verdict=CERT_STATUS[verdict], source=source,
+                  duration_s=time.perf_counter() - t0)
+        from ..obs.health import monitor_for as _monitor_for
+
+        _monitor_for(run).observe_certificate(
+            certified=certified, decidable=decidable, lambda_min=lam_used,
+            source=source)
+    return CertificateResult(
+        certified=bool(certified),
+        lambda_min=lam,
+        direction=direction,
+        stationarity_gap=stat,
+        sigma=sigma,
+        tol=tol,
+        weight_scale=wscale,
+        decidable=bool(decidable),
+        lambda_min_f64=lam_f64,
+        device_verdict=verdict,
+    )
+
+
+def host_f64_solve(X, edges: EdgeSet, tol_cert: float, warm=None):
+    """Closure adapting ``lambda_min_f64`` to the
+    ``f64_solve(t) -> (lam, vec, resid)`` shape the decision ladders
+    consume — the REFUSE fallback of both the post-hoc and the
+    device-epilogue certificate paths."""
+    import numpy as np
+
+    def f64_solve(t):
+        return lambda_min_f64(
+            np.asarray(X, np.float64), edges,
+            warm=None if warm is None else np.asarray(warm, np.float64),
+            tol=t, tol_cert=tol_cert)
+    return f64_solve
 
 
 def sparse_certificate(X64, edges: EdgeSet):

@@ -299,10 +299,14 @@ def solve_staircase_sharded(meas, num_robots: int, mesh=None,
         while left > 0:
             k = min(100, left)
             state = steps(state, graph_s, k)
+            # Keeps each device program under the tunnel's ~35 s ceiling.
+            # dpgolint: disable=DPG003 -- sanctioned chunk-boundary sync
             jax.block_until_ready(state.X)
             left -= k
         Xa = state.X
 
+        # One readback per staircase rank, after rounds_per_rank rounds.
+        # dpgolint: disable=DPG003 -- sanctioned rank-boundary readback
         Xg = np.asarray(rbcd.gather_to_global(Xa, graph, n_total),
                         np.float64)
         # Stationarity polish before certifying: lambda_min(S) at a
@@ -351,9 +355,12 @@ def solve_staircase_sharded(meas, num_robots: int, mesh=None,
         # 5: cost moved 2.8e-4 of 3946 in 400 rounds).  Normalize to unit
         # MAX per-pose row norm and take the best alpha of a sweep, so the
         # escape amplitude is scale-free.
+        # Per failed certificate, not per round; the sweep is host math.
+        # dpgolint: disable=DPG003 -- sanctioned escape-side readback
         v = np.asarray(cert.direction, np.float64)        # [A, n, dh]
         vmax = np.sqrt((v * v).sum(-1).max())
         v = v / max(vmax, 1e-30)
+        # dpgolint: disable=DPG003 -- sanctioned escape-side readback
         Xa_np = np.asarray(Xa, np.float64)
         f0 = f
 
@@ -366,6 +373,8 @@ def solve_staircase_sharded(meas, num_robots: int, mesh=None,
         best_alpha, best_f = 0.0, f0
         for p in range(22):
             alpha = 2.0 ** (-p)                           # 1.0 ... ~2.4e-7
+            # 22 host cost evals per escape; escapes are rank transitions.
+            # dpgolint: disable=DPG003 -- sanctioned escape-sweep eval
             Xg_p = np.asarray(rbcd.gather_to_global(
                 jnp.asarray(lifted(alpha)), graph, n_total), np.float64)
             f_p = refine.global_cost(Xg_p, edges_g)

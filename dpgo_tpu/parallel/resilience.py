@@ -423,6 +423,15 @@ class ResilienceConfig:
     #: that still divides the agent count.
     reshard_on_fault: bool = True
     min_mesh_size: int = 1
+    #: Off-thread checkpoint writes (default on): the boundary npz
+    #: compression + fsync runs on the store's writer thread,
+    #: double-buffered last-writer-wins, so checkpoint overhead hides
+    #: under the next K-round device segment.  The device->host gather
+    #: stays synchronous at the boundary either way (the snapshot must
+    #: capture THIS boundary's state), so the solve's
+    #: host_syncs_per_100_rounds is unchanged.  ``recover`` flushes the
+    #: writer before reading snapshots back.
+    async_checkpoint: bool = True
     #: Deterministic chaos source (tests / chaos arms); None in prod.
     injector: CollectiveFaultInjector | None = None
 
@@ -451,7 +460,8 @@ class ResilienceConfig:
     def resolve_store(self) -> SessionStore:
         if self.store is not None:
             return self.store
-        return SessionStore(self.checkpoint_dir, keep=self.keep)
+        return SessionStore(self.checkpoint_dir, keep=self.keep,
+                            async_write=self.async_checkpoint)
 
 
 def shrink_mesh_size(cur: int, num_robots: int, min_size: int = 1) -> int:
@@ -544,7 +554,12 @@ class CheckpointSupervisor:
     def save(self, state, it: int, nwu: int) -> str:
         host = checkpoint_arrays(state)
         mesh_shape = (self.mesh_sizes[-1],) if self.mesh_sizes else None
-        path = self.store.save(
+        # The gather above is synchronous (the snapshot pins THIS
+        # boundary's state); the npz write itself lands off-thread when
+        # the store was built with async_write, hiding the compression +
+        # fsync under the next K-round segment.
+        save = getattr(self.store, "save_async", self.store.save)
+        path = save(
             self.session_id, _host_state(host), iteration=int(it),
             num_weight_updates=int(nwu), mesh_shape=mesh_shape,
             global_index=self._gidx)
@@ -578,6 +593,11 @@ class CheckpointSupervisor:
         if isinstance(exc, MeshFaultError) and self.cfg.reshard_on_fault:
             new_size = shrink_mesh_size(mesh_size, num_robots,
                                         self.cfg.min_mesh_size)
+        flush = getattr(self.store, "flush", None)
+        if flush is not None:
+            # Drain the async writer before reading back: the freshest
+            # boundary snapshot may still be in the pending slot.
+            flush()
         snap = self.store.load_newest(self.session_id)
         usable = snap is not None and (
             snap.global_index is None

@@ -757,8 +757,9 @@ def solve_rbcd_sharded(
 
     n_total = part.meas_global.num_poses
     num_meas = len(part.meas_global)
+    certify_mode = getattr(params, "certify_mode", "off")
     edges_g = edge_set_from_measurements(part.meas_global, dtype=dtype) \
-        if verdict_every is not None else None
+        if (verdict_every is not None or certify_mode != "off") else None
 
     def _attempt(mesh_a, state_a, graph_a, start_it, start_nwu,
                  boundary_cb, injector):
@@ -847,14 +848,21 @@ def solve_rbcd_sharded(
                       grad_norm=tail.grad_norm_history[-1]
                       if tail.grad_norm_history else None)
 
-        @jax.jit
-        def _finalize(Xf, weights):
-            Xg = rbcd.gather_to_global(Xf, graph_a, n_total)
-            return (rbcd.round_global(Xg,
-                                      rbcd.lifting_matrix(meta, Xg.dtype)),
-                    rbcd.global_weights(weights, graph_a, num_meas))
-
-        T, w_glob = _finalize(Xa, res.state.weights)
+        # Re-finalize from the polished iterate through the shared fused
+        # epilogue: with a certify mode on, the certificate is recomputed
+        # on the POLISHED iterate (superseding the loop's) and rides the
+        # same single terminal fetch.
+        epilogue = rbcd.make_terminal_epilogue(
+            graph_a, edges_g, n_total, num_meas, meta,
+            certify_mode=certify_mode)
+        fin = epilogue(Xa, res.state.weights, {})
+        certificate = res.certificate
+        if certify_mode != "off":
+            # dpgolint: disable=DPG003 -- sanctioned terminal epilogue fetch
+            fin = rbcd._host_fetch(fin)
+            certificate = rbcd._epilogue_certificate(fin, edges_g, params,
+                                                     dtype)
+        T, w_glob = fin["T"], fin["w_glob"]
         return dataclasses.replace(
             res, T=T, X=Xa, weights=w_glob,
             cost_history=res.cost_history + tail.cost_history,
@@ -862,7 +870,8 @@ def solve_rbcd_sharded(
             + tail.grad_norm_history,
             terminated_by=tail.terminated_by if tail.converged
             else res.terminated_by,
-            state=res.state._replace(X=Xa))
+            state=res.state._replace(X=Xa),
+            certificate=certificate)
 
     if resilience is None:
         res = _attempt(mesh, state, graph, 0, 0, None, None)

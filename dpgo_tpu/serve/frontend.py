@@ -72,27 +72,38 @@ def _handle_request(server: SolveServer, frame: dict, ctx) -> dict:
     if op != "solve":
         return {"ok": np.int8(0), "error": _pack_str(f"unknown op {op!r}")}
     try:
-        meas = read_g2o(bytes(np.asarray(frame["g2o"], np.uint8)))
-        num_robots = int(np.asarray(frame["num_robots"]))
-        rank = int(np.asarray(frame["rank"])) if "rank" in frame else 5
-        req = SolveRequest(
-            meas=meas,
-            num_robots=num_robots,
-            params=AgentParams(d=meas.d, r=rank, num_robots=num_robots),
-            tenant=_unpack_str(frame["tenant"]) if "tenant" in frame
-            else "default",
-            deadline_s=float(np.asarray(frame["deadline_s"]))
-            if "deadline_s" in frame else None,
-            max_iters=int(np.asarray(frame["max_iters"]))
-            if "max_iters" in frame else None,
-            grad_norm_tol=float(np.asarray(frame["grad_norm_tol"]))
-            if "grad_norm_tol" in frame else 0.1,
-            eval_every=int(np.asarray(frame["eval_every"]))
-            if "eval_every" in frame else 1,
-            trace_ctx=ctx,
-            session_id=_unpack_str(frame["session"])
-            if "session" in frame else None,
-        )
+        # The decode stage as its own span: g2o parse + request build,
+        # so a certified request's timeline reads decode -> admission ->
+        # dispatch -> certified reply with no unattributed gap.
+        with obs_trace.span("decode", phase="serve",
+                            bytes=int(np.asarray(frame["g2o"]).size)):
+            meas = read_g2o(bytes(np.asarray(frame["g2o"], np.uint8)))
+            num_robots = int(np.asarray(frame["num_robots"]))
+            rank = int(np.asarray(frame["rank"])) if "rank" in frame else 5
+            certify_mode = _unpack_str(frame["certify_mode"]) \
+                if "certify_mode" in frame else "off"
+            certify_eta = float(np.asarray(frame["certify_eta"])) \
+                if "certify_eta" in frame else 1e-5
+            req = SolveRequest(
+                meas=meas,
+                num_robots=num_robots,
+                params=AgentParams(d=meas.d, r=rank, num_robots=num_robots,
+                                   certify_mode=certify_mode,
+                                   certify_eta=certify_eta),
+                tenant=_unpack_str(frame["tenant"]) if "tenant" in frame
+                else "default",
+                deadline_s=float(np.asarray(frame["deadline_s"]))
+                if "deadline_s" in frame else None,
+                max_iters=int(np.asarray(frame["max_iters"]))
+                if "max_iters" in frame else None,
+                grad_norm_tol=float(np.asarray(frame["grad_norm_tol"]))
+                if "grad_norm_tol" in frame else 0.1,
+                eval_every=int(np.asarray(frame["eval_every"]))
+                if "eval_every" in frame else 1,
+                trace_ctx=ctx,
+                session_id=_unpack_str(frame["session"])
+                if "session" in frame else None,
+            )
         res = server.submit(req).result()
     except OverCapacityError as e:
         reply = {"ok": np.int8(0), "shed": np.int8(1),
@@ -108,7 +119,7 @@ def _handle_request(server: SolveServer, frame: dict, ctx) -> dict:
         return reply
     except Exception as e:  # bad payload, solver failure: structured reply
         return {"ok": np.int8(0), "error": _pack_str(f"{type(e).__name__}: {e}")}
-    return {
+    reply = {
         "ok": np.int8(1),
         "T": np.asarray(res.T),
         "cost_history": np.asarray(res.cost_history, np.float64),
@@ -119,6 +130,16 @@ def _handle_request(server: SolveServer, frame: dict, ctx) -> dict:
         # snapshot after a worker death (serve.session).
         "recovered": np.int8(bool(getattr(res, "recovered", False))),
     }
+    cert = getattr(res, "certificate", None)
+    if cert is not None:
+        from ..models.certify import CERT_STATUS
+
+        reply["certified"] = np.int8(bool(cert.certified))
+        reply["cert_status"] = _pack_str(
+            CERT_STATUS.get(cert.device_verdict, "none"))
+        reply["cert_lambda_min"] = np.float64(cert.lambda_min)
+        reply["cert_tol"] = np.float64(cert.tol)
+    return reply
 
 
 class ServeFrontend:
@@ -238,12 +259,19 @@ def solve_g2o(host: str, port: int, g2o, num_robots: int,
               timeout: float | None = None,
               max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
               wire_format: str = "packed",
-              session_id: str | None = None) -> dict:
+              session_id: str | None = None,
+              certify_mode: str = "off",
+              certify_eta: float = 1e-5) -> dict:
     """Submit one g2o problem to a remote front-end and wait for the
     result.  ``g2o`` is the file's bytes or a path.  Returns a dict with
     ``ok`` plus either the result arrays (``T``, ``cost_history``,
     ``grad_norm_history``, ``iterations``, ``terminated_by``) or the
-    structured error (``error``, ``shed``, ``reason``)."""
+    structured error (``error``, ``shed``, ``reason``).
+
+    ``certify_mode="device"`` requests a certified reply: the server
+    folds the dual certificate into the solve's terminal epilogue and the
+    reply carries ``certified`` / ``cert_status`` / ``cert_lambda_min`` /
+    ``cert_tol``."""
     if isinstance(g2o, str):
         with open(g2o, "rb") as fh:
             g2o = fh.read()
@@ -262,6 +290,9 @@ def solve_g2o(host: str, port: int, g2o, num_robots: int,
         frame["deadline_s"] = np.float64(deadline_s)
     if session_id is not None:
         frame["session"] = _pack_str(session_id)
+    if certify_mode != "off":
+        frame["certify_mode"] = _pack_str(certify_mode)
+        frame["certify_eta"] = np.float64(certify_eta)
     # Request-scoped trace context: with telemetry on in the CLIENT
     # process, the whole round-trip is one span and its ids ride the
     # frame, so the server's spans join this trace (telemetry off:
@@ -289,6 +320,12 @@ def solve_g2o(host: str, port: int, g2o, num_robots: int,
         out["iterations"] = int(np.asarray(reply["iterations"]))
         out["terminated_by"] = _unpack_str(reply["terminated_by"])
         out["recovered"] = bool(int(np.asarray(reply.get("recovered", 0))))
+        if "certified" in reply:
+            out["certified"] = bool(int(np.asarray(reply["certified"])))
+            out["cert_status"] = _unpack_str(reply["cert_status"])
+            out["cert_lambda_min"] = float(np.asarray(
+                reply["cert_lambda_min"]))
+            out["cert_tol"] = float(np.asarray(reply["cert_tol"]))
     else:
         out["error"] = _unpack_str(reply.get("error", _pack_str("")))
         out["shed"] = bool(int(np.asarray(reply.get("shed", 0))))

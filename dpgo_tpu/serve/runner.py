@@ -15,7 +15,10 @@ occupancy instead of one per exact batch size.
 
 Executables come from the caller's ``ExecutableCache`` keyed by the
 config fingerprint (``cache.problem_fingerprint``): segment, metrics, and
-finalize programs are each cached independently.  With telemetry on, the
+terminal-epilogue programs are each cached independently; with
+``params.certify_mode="device"`` the epilogue program also computes the
+per-member dual-certificate payload so the certificate rides the batch's
+single terminal fetch.  With telemetry on, the
 cached entries are ``obs.profile.ProfiledExecutable``\\ s (AOT compile
 wall-time + XLA cost/memory analysis recorded per fingerprint key), each
 dispatch window times itself into ``serve_dispatch_device_seconds``, and
@@ -125,11 +128,32 @@ def _make_verdict_exec(meta: rbcd.GraphMeta, n_total: int, num_meas: int,
     return jax.jit(jax.vmap(one))
 
 
-def _make_finalize_exec(meta: rbcd.GraphMeta, n_total: int, num_meas: int):
-    def one(Xa, weights, graph):
+def _make_epilogue_exec(meta: rbcd.GraphMeta, n_total: int, num_meas: int,
+                        certify_mode: str = "off", certify_seed: int = 0):
+    """Batched fused terminal epilogue (the vmap analog of
+    ``rbcd.make_terminal_epilogue``): rounding/anchoring + the weight
+    collapse, plus — with ``certify_mode="device"`` — the gauge-deflated
+    device-certificate eigensolve per batch member.  Padded members are
+    benign: a padded pose contributes zero rows to the dual operator,
+    whose zero eigenvalue is clamped by the payload's ``min(lam, 0)``."""
+    device_cert = certify_mode == "device"
+    want_xg = certify_mode in ("device", "host")
+    if device_cert:
+        from ..models import certify as certify_mod
+
+    def one(Xa, weights, graph, eg):
         Xg = rbcd.gather_to_global(Xa, graph, n_total)
-        T = rbcd.round_global(Xg, rbcd.lifting_matrix(meta, Xg.dtype))
-        return T, rbcd.global_weights(weights, graph, num_meas)
+        w = rbcd.global_weights(weights, graph, num_meas)
+        out = {"T": rbcd.round_global(Xg, rbcd.lifting_matrix(meta,
+                                                              Xg.dtype)),
+               "w": w}
+        if want_xg:
+            out["Xg"] = Xg
+        if device_cert:
+            out["cert"] = certify_mod.device_certificate_payload(
+                Xg, eg._replace(weight=w),
+                jax.random.PRNGKey(certify_seed))
+        return out
 
     return jax.jit(jax.vmap(one))
 
@@ -248,9 +272,12 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
     met = _cached_exec(
         cache, problem_fingerprint(meta, params, dtype, shape, B, "metrics"),
         lambda: _make_metrics_exec(meta, shape.n_total, shape.num_meas))
+    certify_mode = getattr(params, "certify_mode", "off")
     fin = _cached_exec(
-        cache, problem_fingerprint(meta, params, dtype, shape, B, "finalize"),
-        lambda: _make_finalize_exec(meta, shape.n_total, shape.num_meas))
+        cache, problem_fingerprint(meta, params, dtype, shape, B,
+                                   f"epilogue:{certify_mode}"),
+        lambda: _make_epilogue_exec(meta, shape.n_total, shape.num_meas,
+                                    certify_mode))
 
     robust_on = params.robust.cost_type != RobustCostType.L2
     accel_on = params.acceleration
@@ -332,23 +359,6 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
             all_terminal = ((wv & 7) != rbcd.VERDICT_RUNNING).all()
             if it >= max_iters or bool(all_terminal):
                 break
-        # Terminal epilogue: the full per-eval histories and latched
-        # terminal indices, one transfer each (lazy — never per eval).
-        hist_h = rbcd._host_fetch(hist)
-        te_h = rbcd._host_fetch(jnp.stack([term_eval, term_it]))
-        for b in range(B_real):
-            te, ti = int(te_h[0, b]), int(te_h[1, b])
-            status = int(wv[b]) & 7
-            if te >= 0:
-                n_keep = te + 1
-                iters[b] = ti
-                term[b] = rbcd._VERDICT_STATUS.get(status, "max_iters")
-            else:
-                n_keep = len(eval_its)
-                iters[b] = it
-                term[b] = "max_iters"
-            cost_hist[b] = [float(hist_h[b, r, 0]) for r in range(n_keep)]
-            gn_hist[b] = [float(hist_h[b, r, 1]) for r in range(n_keep)]
 
     while verdict_every is None and it < max_iters and not all(done) \
             and not interrupted:
@@ -397,13 +407,47 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
             elif consensus > 0:
                 done[b], term[b], iters[b] = True, "consensus", it
 
-    with span("slice", phase="serve", batch=B):
-        T_b, w_b = fin(state_b.X, state_b.weights, graph_b)
-        T_b = np.asarray(T_b)
-        w_b = np.asarray(w_b)
-        X_b = np.asarray(state_b.X)
+    with span("slice", phase="serve", batch=B, certify=certify_mode):
+        # The batch's ONE terminal blocking read: rounded trajectories,
+        # collapsed weights, the raw batch iterate, the verdict mode's
+        # device-side histories + latched indices, and (certify on) the
+        # per-member certificate payload — a single fused pytree fetch
+        # through the sanctioned seam.
+        ep = {"fin": fin(state_b.X, state_b.weights, graph_b, eg_b),
+              "X": state_b.X}
+        if verdict_every is not None:
+            ep["hist"] = hist
+            ep["te"] = jnp.stack([term_eval, term_it])
+        # dpgolint: disable=DPG003 -- sanctioned terminal epilogue fetch
+        ep = rbcd._host_fetch(ep)
+    if verdict_every is not None:
+        hist_h, te_h = ep["hist"], ep["te"]
+        for b in range(B_real):
+            te, ti = int(te_h[0, b]), int(te_h[1, b])
+            status = int(wv[b]) & 7
+            if te >= 0:
+                n_keep = te + 1
+                iters[b] = ti
+                term[b] = rbcd._VERDICT_STATUS.get(status, "max_iters")
+            else:
+                n_keep = len(eval_its)
+                iters[b] = it
+                term[b] = "max_iters"
+            cost_hist[b] = [float(hist_h[b, r, 0]) for r in range(n_keep)]
+            gn_hist[b] = [float(hist_h[b, r, 1]) for r in range(n_keep)]
+    T_b, w_b, X_b = ep["fin"]["T"], ep["fin"]["w"], ep["X"]
     results = []
     for b, p in enumerate(padded):
+        certificate = None
+        if certify_mode != "off":
+            # Host decision per member on the already-fetched payload —
+            # the f64 REFUSE fallback reads the fetched Xg, never the
+            # device.
+            with span("certify_decide", phase="serve", member=b):
+                fin_b = jax.tree.map(lambda a: a[b], ep["fin"])
+                fin_b["w_glob"] = fin_b.pop("w")
+                certificate = rbcd._epilogue_certificate(
+                    fin_b, p.edges_g, params, dtype)
         results.append(rbcd.RBCDResult(
             T=jnp.asarray(T_b[b, :p.prob.n_total]),
             X=jnp.asarray(X_b[b, :, :p.prob.meta.n_max]),
@@ -412,6 +456,7 @@ def run_bucket(padded: list[PaddedProblem], cache: ExecutableCache,
             iterations=iters[b],
             terminated_by=term[b],
             weights=jnp.asarray(w_b[b, :p.prob.num_meas]),
+            certificate=certificate,
         ))
     info = {"rounds": it, "evals": evals, "batch": B,
             "size": B_real, "occupancy": B_real / float(B),

@@ -990,16 +990,23 @@ class SolveServer:
             dsp.__exit__(None, None, None)
             dispatch_ctx = (dsp.trace_id, dsp.span_id,
                             ORIGIN_SERVE_SERVER, t0, t0_wall)
-            for t in tickets:
+            for t, res in zip(tickets, results):
                 if t.trace_id is None:
                     continue
                 # Reply span closes the request's trace, with a flow
-                # arrow in from the shared dispatch span.
+                # arrow in from the shared dispatch span.  A certified
+                # request's reply span carries the verdict, so the trace
+                # reads decode -> admission -> dispatch -> certified
+                # reply end to end.
+                cert = getattr(res, "certificate", None)
+                cert_attrs = {} if cert is None else {
+                    "certified": bool(cert.certified),
+                    "cert_lambda_min": float(cert.lambda_min)}
                 obs_trace.emit_span(
                     run, "reply", t.t_done, time.time(), 0.0,
                     phase="serve", trace_id=t.trace_id,
                     parent_id=t.span_admission, tenant=t.request.tenant,
-                    latency_s=t.latency_s, link=dispatch_ctx)
+                    latency_s=t.latency_s, link=dispatch_ctx, **cert_attrs)
         with self._cond:
             self._n_batches += 1
             self._n_requests += len(tickets)

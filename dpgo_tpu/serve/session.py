@@ -96,12 +96,23 @@ class SessionStore:
     Thread-safe: the server worker saves while client threads may list or
     discard; one lock serializes directory mutations per store."""
 
-    def __init__(self, root: str, keep: int = 2):
+    def __init__(self, root: str, keep: int = 2,
+                 async_write: bool = False):
         if keep < 1:
             raise ValueError("keep must be >= 1")
         self.root = str(root)
         self.keep = int(keep)
         self._lock = threading.Lock()
+        #: Off-thread write mode (``save_async``): one daemon writer and
+        #: a ONE-SLOT pending buffer — last writer wins, so a slow disk
+        #: never queues a backlog of stale snapshots; the freshest state
+        #: is always the one that lands.  ``flush()`` drains it.
+        self.async_write = bool(async_write)
+        self._wcond = threading.Condition()
+        self._wpending: dict | None = None
+        self._winflight = False
+        self._wthread: threading.Thread | None = None
+        self.last_write_error: Exception | None = None
         os.makedirs(self.root, exist_ok=True)
 
     # -- paths ---------------------------------------------------------------
@@ -134,8 +145,17 @@ class SessionStore:
         ``mesh_shape`` / ``global_index`` are the v2 mesh tags
         (``parallel.resilience``): the mesh the state was gathered from
         and the agent->global-pose layout the arrays assume."""
-        sdir = self._dir(session_id)
-        arrays = state_to_arrays(state)
+        arrays = self._snapshot_arrays(state, iteration, num_weight_updates,
+                                       meta, mesh_shape, global_index)
+        return self._write(session_id, arrays, int(iteration))
+
+    def _snapshot_arrays(self, state, iteration, num_weight_updates, meta,
+                         mesh_shape, global_index) -> dict:
+        """Materialize the snapshot payload on the CALLER'S thread — any
+        device arrays in the state transfer here, so the async writer
+        only ever touches host memory and the filesystem."""
+        arrays = {k: np.asarray(v)
+                  for k, v in state_to_arrays(state).items()}
         arrays["__schema__"] = np.asarray(SESSION_SCHEMA_VERSION, np.int64)
         arrays["__iteration__"] = np.asarray(int(iteration), np.int64)
         arrays["__nwu__"] = np.asarray(int(num_weight_updates), np.int64)
@@ -146,6 +166,10 @@ class SessionStore:
         if meta:
             arrays["__meta__"] = np.frombuffer(
                 json.dumps(meta, sort_keys=True).encode("utf-8"), np.uint8)
+        return arrays
+
+    def _write(self, session_id: str, arrays: dict, iteration: int) -> str:
+        sdir = self._dir(session_id)
         with self._lock:
             os.makedirs(sdir, exist_ok=True)
             path = os.path.join(sdir, f"snap-{int(iteration):08d}.npz")
@@ -168,6 +192,69 @@ class SessionStore:
                       session=str(session_id), iteration=int(iteration),
                       path=path)
         return path
+
+    # -- off-thread writes ---------------------------------------------------
+
+    def save_async(self, session_id: str, state: RBCDState, iteration: int,
+                   num_weight_updates: int = 0, meta: dict | None = None,
+                   mesh_shape: tuple | None = None,
+                   global_index=None) -> str:
+        """``save`` with the npz compression + fsync moved to the store's
+        writer thread (``async_write=True``; otherwise falls back to the
+        synchronous ``save``).  The state materializes on the caller's
+        thread, so the enqueued payload is immutable host memory; the
+        pending slot is last-writer-wins — a newer boundary snapshot
+        replaces an unwritten older one rather than queueing behind it.
+        Returns the path the snapshot WILL land at; call ``flush()``
+        before reading it back."""
+        if not self.async_write:
+            return self.save(session_id, state, iteration,
+                             num_weight_updates, meta, mesh_shape,
+                             global_index)
+        arrays = self._snapshot_arrays(state, iteration, num_weight_updates,
+                                       meta, mesh_shape, global_index)
+        path = os.path.join(self._dir(session_id),
+                            f"snap-{int(iteration):08d}.npz")
+        with self._wcond:
+            self._wpending = {"session_id": session_id, "arrays": arrays,
+                              "iteration": int(iteration)}
+            if self._wthread is None or not self._wthread.is_alive():
+                self._wthread = threading.Thread(
+                    target=self._writer_loop, daemon=True,
+                    name="dpgo-session-writer")
+                self._wthread.start()
+            self._wcond.notify_all()
+        return path
+
+    def _writer_loop(self) -> None:
+        while True:
+            with self._wcond:
+                while self._wpending is None:
+                    self._wcond.wait()
+                job, self._wpending = self._wpending, None
+                self._winflight = True
+            try:
+                self._write(job["session_id"], job["arrays"],
+                            job["iteration"])
+                err = None
+            except Exception as e:  # fail-open: recovery degrades to an
+                err = e             # older snapshot, never a crash here
+            with self._wcond:
+                self._winflight = False
+                if err is not None:
+                    self.last_write_error = err
+                self._wcond.notify_all()
+
+    def flush(self, timeout: float | None = None) -> bool:
+        """Block until the async writer has drained (no pending slot, no
+        write in flight).  Call before ``load_newest`` on a store that
+        saves asynchronously, so recovery sees the freshest snapshot.
+        Returns False on timeout; a writer error is surfaced on
+        ``last_write_error`` (the store itself stays fail-open)."""
+        with self._wcond:
+            return self._wcond.wait_for(
+                lambda: self._wpending is None and not self._winflight,
+                timeout=timeout)
 
     # -- reading / recovery --------------------------------------------------
 
@@ -215,7 +302,10 @@ class SessionStore:
     def load_newest(self, session_id: str) -> SessionSnapshot | None:
         """The newest VALID snapshot, quarantining corrupt ones on the way
         down; None when no valid snapshot remains.  Never raises on bad
-        data — the recovery path must not kill the worker a second time."""
+        data — the recovery path must not kill the worker a second time.
+        Drains the async writer first, so a read-after-save always sees
+        the snapshot the save promised."""
+        self.flush()
         sdir = self._dir(session_id)
         with self._lock:
             candidates = [os.path.join(sdir, name)

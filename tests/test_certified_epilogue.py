@@ -1,0 +1,184 @@
+"""ISSUE 15 tests: the device-resident dual certificate and the fused
+terminal epilogue.
+
+The contract under test: with ``certify_mode="device"`` the certificate
+payload rides the solve's ONE terminal blocking fetch (the verdict-word
+cadence of 100/K host syncs per 100 rounds is unchanged), the f32 device
+eigensolve never certifies alone outside its decidable band (REFUSE
+falls back to the host f64 path), a decisively negative Rayleigh
+quotient is a sound FAIL without f64, and the device lambda_min agrees
+with the host dense/f64 eigensolves at pinned tolerance."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dpgo_tpu.config import AgentParams
+from dpgo_tpu.models import certify, local_pgo, rbcd
+from dpgo_tpu.types import edge_set_from_measurements
+from synthetic import make_measurements
+from test_certify import dense_certificate
+
+
+def _optimum(rng, n=12, num_lc=6):
+    meas, _ = make_measurements(rng, n=n, d=3, num_lc=num_lc,
+                                rot_noise=0.05, trans_noise=0.05)
+    res = local_pgo.solve_local(meas, rank=5, grad_norm_tol=1e-9,
+                                max_iters=500)
+    return meas, res.X
+
+
+def test_device_payload_matches_dense_and_host_f64(rng):
+    """Parity pin: the gauge-deflated device LOBPCG's lambda_min agrees
+    with the dense f64 eigensolve AND the host f64 LOBPCG within 1e-6 on
+    a problem small enough to assemble, and its soundness probes hold
+    (deflation basis near-kernel, RQ an upper bound on lambda_min)."""
+    meas, X = _optimum(rng)
+    edges = edge_set_from_measurements(meas, dtype=jnp.float64)
+    S = dense_certificate(X, edges)
+    lam_dense = float(np.linalg.eigvalsh(S)[0])
+
+    payload = certify.device_certificate_payload(
+        X, edges, jax.random.PRNGKey(0))
+    lam_dev = float(payload["lam_min"])
+    assert abs(lam_dev - lam_dense) < 1e-6 * max(1.0, abs(lam_dense))
+    lam64, _, _ = certify.lambda_min_f64(np.asarray(X, np.float64), edges)
+    assert abs(lam_dev - lam64) < 1e-6
+    tol = 1e-5 * float(payload["wscale"])
+    assert float(payload["defl_resid"]) <= 0.1 * tol
+    assert float(payload["rq"]) >= lam_dense - 1e-9
+
+
+def test_device_f64_accepts_and_wound_fails(rng):
+    """An f64 device payload is decidable at the default eta: ACCEPT at
+    the optimum, and a decisively wound configuration is a sound FAIL —
+    both WITHOUT the host f64 fallback."""
+    from dpgo_tpu.utils.synthetic import make_stitched_winding
+
+    meas, X = _optimum(rng)
+    edges = edge_set_from_measurements(meas, dtype=jnp.float64)
+    payload = certify.device_certificate_payload(
+        X, edges, jax.random.PRNGKey(0))
+    eps = float(jnp.finfo(jnp.float64).eps)
+    cert = certify.decide_device_certificate(payload, 1e-5, eps,
+                                             f64_solve=None)
+    assert cert.device_verdict == certify.CERT_ACCEPT
+    assert cert.certified and cert.decidable
+    assert cert.lambda_min_f64 is None  # f64 fallback never consulted
+
+    measw, Xw = make_stitched_winding(3, 12)
+    edgesw = edge_set_from_measurements(measw, dtype=jnp.float64)
+    pw = certify.device_certificate_payload(
+        jnp.asarray(Xw, jnp.float64), edgesw, jax.random.PRNGKey(0))
+    certw = certify.decide_device_certificate(pw, 1e-5, eps, f64_solve=None)
+    assert certw.device_verdict == certify.CERT_FAIL
+    assert not certw.certified and certw.decidable
+
+
+def test_f32_refuses_then_host_f64_decides(rng):
+    """f32 never certifies alone at the default eta: the disagreement
+    band is an explicit REFUSE, and providing the host f64 solve turns
+    the same payload into a decided (certified) result."""
+    meas, X = _optimum(rng)
+    e32 = edge_set_from_measurements(meas, dtype=jnp.float32)
+    X32 = jnp.asarray(X, jnp.float32)
+    payload = certify.device_certificate_payload(
+        X32, e32, jax.random.PRNGKey(0))
+    eps = float(jnp.finfo(jnp.float32).eps)
+
+    cert = certify.decide_device_certificate(payload, 1e-5, eps,
+                                             f64_solve=None)
+    assert cert.device_verdict == certify.CERT_REFUSE
+    assert not cert.certified and not cert.decidable
+
+    e64 = edge_set_from_measurements(meas, dtype=jnp.float64)
+    solve = certify.host_f64_solve(np.asarray(X, np.float64), e64,
+                                   tol_cert=cert.tol,
+                                   warm=payload["direction"])
+    cert64 = certify.decide_device_certificate(payload, 1e-5, eps,
+                                               f64_solve=solve)
+    assert cert64.device_verdict == certify.CERT_REFUSE  # f32 band stands
+    assert cert64.certified and cert64.decidable         # f64 decided
+    assert cert64.lambda_min_f64 is not None
+
+
+def test_tiny_problem_probe_clamp(rng):
+    """lobpcg_standard requires 5 * num_probe < dim; the payload clamps
+    the probe count so micro problems (dim = n (d+1) = 16 here) trace
+    and decide instead of crashing."""
+    meas, _ = make_measurements(rng, n=4, d=3, num_lc=2,
+                                rot_noise=0.01, trans_noise=0.01)
+    res = local_pgo.solve_local(meas, rank=5, grad_norm_tol=1e-9,
+                                max_iters=300)
+    edges = edge_set_from_measurements(meas, dtype=jnp.float64)
+    payload = certify.device_certificate_payload(
+        res.X, edges, jax.random.PRNGKey(0), num_probe=4)
+    for k in ("lam_min", "sigma", "defl_resid", "rq"):
+        assert np.isfinite(float(payload[k])), k
+    cert = certify.decide_device_certificate(
+        payload, 1e-5, float(jnp.finfo(jnp.float64).eps))
+    assert cert.device_verdict != certify.CERT_NONE
+
+
+def test_certified_solve_single_terminal_fetch(rng, monkeypatch):
+    """The acceptance pin: certify_mode="device" adds ZERO host syncs —
+    the loop still performs rounds/K verdict-word fetches plus ONE fused
+    terminal-epilogue fetch (the certificate rides it), so
+    host_syncs_per_100_rounds stays 100/K with certification on."""
+    meas, _ = make_measurements(rng, n=50, d=3, num_lc=25,
+                                rot_noise=0.05, trans_noise=0.05)
+    params = AgentParams(d=3, r=5, num_robots=2, rel_change_tol=0.0,
+                         certify_mode="device")
+    count = [0]
+    orig = rbcd._host_fetch
+    monkeypatch.setattr(rbcd, "_host_fetch",
+                        lambda x: (count.__setitem__(0, count[0] + 1),
+                                   orig(x))[1])
+    res = rbcd.solve_rbcd(meas, 2, params=params, max_iters=32,
+                          eval_every=4, grad_norm_tol=0.0,
+                          dtype=jnp.float64, verdict_every=16)
+    assert res.iterations == 32
+    assert count[0] == 32 // 16 + 1  # words + one fused terminal epilogue
+    cert = res.certificate
+    assert cert is not None
+    assert cert.device_verdict != certify.CERT_NONE
+    # 32 f64 rounds land at the optimum on this instance rarely; the
+    # decision just has to be SOUND (decided or refused, never a vacuous
+    # accept at a non-stationary point).
+    if cert.certified:
+        assert cert.stationarity_gap < 1e-3
+
+
+def test_certify_off_keeps_certificate_none(rng):
+    """The default path is untouched: no certificate object, no change
+    to the terminal fetch contents."""
+    meas, _ = make_measurements(rng, n=24, d=3, num_lc=8,
+                                rot_noise=0.05, trans_noise=0.05)
+    params = AgentParams(d=3, r=5, num_robots=2)
+    res = rbcd.solve_rbcd(meas, 2, params=params, max_iters=8,
+                          eval_every=4, dtype=jnp.float64, verdict_every=4)
+    assert res.certificate is None
+
+
+def test_certified_solve_host_mode_certifies_at_optimum(rng):
+    """certify_mode="host" (the legacy post-hoc sparse/f64 path) rides
+    the same result field: a solve driven to tight gradient norm
+    produces a decided, certified result with CERT_NONE as the device
+    verdict (no device eigensolve ran)."""
+    meas, _ = make_measurements(rng, n=20, d=3, num_lc=8,
+                                rot_noise=0.05, trans_noise=0.05)
+    # eta=1e-4: lambda_min at an RBCD terminal iterate carries a
+    # -O(||rgrad||) term (~1e-3 at this instance's descent floor), so
+    # the default eta=1e-5 honestly reads "not yet stationary".
+    params = AgentParams(d=3, r=5, num_robots=2, certify_mode="host",
+                         certify_eta=1e-4)
+    res = rbcd.solve_rbcd(meas, 2, params=params, max_iters=300,
+                          eval_every=5, grad_norm_tol=1e-8,
+                          dtype=jnp.float64)
+    cert = res.certificate
+    assert cert is not None
+    assert cert.device_verdict == certify.CERT_NONE
+    assert cert.decidable and cert.certified
+    assert cert.lambda_min >= -cert.tol

@@ -518,7 +518,7 @@ def test_verdict_loop_termination_latches_mid_window(rng):
 
 def test_verdict_loop_fetch_cadence(rng, monkeypatch):
     """Telemetry off, the loop performs exactly rounds/K verdict-word
-    fetches plus the 2-call terminal epilogue — counted through the
+    fetches plus ONE fused terminal-epilogue fetch — counted through the
     ``_host_fetch`` seam (the bench's host_syncs shim technique)."""
     meas = _verdict_problem(rng)
     params = AgentParams(d=3, r=5, num_robots=2, rel_change_tol=0.0)
@@ -531,7 +531,7 @@ def test_verdict_loop_fetch_cadence(rng, monkeypatch):
                           eval_every=4, grad_norm_tol=0.0,
                           dtype=jnp.float64, verdict_every=16)
     assert res.iterations == 32
-    assert count[0] == 32 // 16 + 2  # words + terminal history/indices
+    assert count[0] == 32 // 16 + 1  # words + one fused terminal epilogue
 
 
 def test_verdict_every_must_divide_eval_every(rng):

@@ -159,8 +159,9 @@ def test_serving_observability_end_to_end(tmp_path):
 
     # --- compile & device profiling ------------------------------------
     compiles = [e for e in events if e.get("event") == "compile_profile"]
+    # "finalize" became the fused terminal epilogue (certify-aware key).
     assert {c["label"] for c in compiles} >= {"segment", "metrics",
-                                              "finalize"}
+                                              "epilogue:off"}
     for c in compiles:
         assert c["total_s"] > 0 and "key" in c
 

@@ -174,6 +174,50 @@ def test_all_snapshots_corrupt_yields_none(tmp_path):
     assert store.load_newest("sess") is None
 
 
+def test_save_async_read_after_save_and_flush(tmp_path):
+    """Off-thread writes (the checkpoint-overlap satellite): save_async
+    returns the promised path immediately, and load_newest drains the
+    writer first — a read-after-save always sees the snapshot the save
+    promised, with no explicit flush() at the call site."""
+    meas = _problem()
+    st = _solved_state(meas)
+    store = SessionStore(str(tmp_path / "s"), keep=4, async_write=True)
+    path = store.save_async("sess", st, iteration=10)
+    assert path.endswith("snap-00000010.npz")
+    snap = store.load_newest("sess")
+    assert snap is not None and snap.iteration == 10
+    assert store.flush(timeout=10)
+    assert store.last_write_error is None
+
+
+def test_save_async_last_writer_wins(tmp_path):
+    """The pending slot is ONE deep: with the writer pinned mid-write, a
+    third save replaces the unwritten second — a slow disk coalesces to
+    the freshest boundary instead of queueing a stale backlog."""
+    meas = _problem()
+    st = _solved_state(meas)
+    store = SessionStore(str(tmp_path / "s"), keep=8, async_write=True)
+    gate, started = threading.Event(), threading.Event()
+    orig_write = store._write
+
+    def slow_write(session_id, arrays, iteration):
+        started.set()
+        assert gate.wait(10)
+        return orig_write(session_id, arrays, iteration)
+
+    store._write = slow_write
+    store.save_async("sess", st, iteration=1)
+    assert started.wait(10)                    # writer busy on snap-1
+    store.save_async("sess", st, iteration=2)  # parked in the slot
+    store.save_async("sess", st, iteration=3)  # replaces 2
+    gate.set()
+    assert store.flush(timeout=10)
+    names = sorted(p.name for p in (tmp_path / "s" / "sess").iterdir())
+    assert names == ["snap-00000001.npz", "snap-00000003.npz"]
+    assert store.load_newest("sess").iteration == 3
+    assert store.last_write_error is None
+
+
 def test_session_id_sanitization(tmp_path):
     store = SessionStore(str(tmp_path / "s"))
     meas = _problem()

@@ -181,7 +181,7 @@ def test_sharded_verdict_host_sync_rate(rng):
         rbcd._host_fetch = orig
     assert res.iterations == rounds and res.terminated_by == "max_iters"
     words = rounds // K
-    assert counted[0] == words + 2, counted[0]  # words + terminal epilogue
+    assert counted[0] == words + 1, counted[0]  # words + fused epilogue
     assert 100.0 * words / rounds == pytest.approx(100.0 / K)
 
 

@@ -31,6 +31,13 @@ recovered at least once, matched the fault-free cost within
 RESILIENCE_MAX_COST_REL (default 1e-2), and kept the recovery overhead
 under RESILIENCE_MAX_RECOVERY_S (default 120s per recovery).
 
+For a ``bench_serving.py`` serving record (``metric ==
+"serving_batched_qps"``): positive QPS and a sane speedup field; when
+the ``--certified`` arm ran, every request came back with a
+certificate and the certified p99 latency is under
+SERVING_CERTIFIED_P99_S (default 120 s — the functional CPU-CI band;
+tighten via env on accelerator runners).
+
 For a ``bench_fleet.py`` FLEET record (``record == "FLEET"``; ISSUE 13):
 the QPS arms ascend in replica count with positive QPS, throughput
 scales >= FLEET_MIN_SCALING (default 1.7) from 1 to 2 replicas, the
@@ -57,6 +64,8 @@ RESILIENCE_MAX_RECOVERY_S = float(
     os.environ.get("RESILIENCE_MAX_RECOVERY_S", "120"))
 RESILIENCE_MAX_COST_REL = float(
     os.environ.get("RESILIENCE_MAX_COST_REL", "1e-2"))
+SERVING_CERTIFIED_P99_S = float(
+    os.environ.get("SERVING_CERTIFIED_P99_S", "120"))
 
 
 def fail(msg: str) -> None:
@@ -114,6 +123,20 @@ def check_multichip(rec: dict) -> None:
         for key in ("n_poses", "num_robots", "rounds"):
             if not _num(scale.get(key)) or scale[key] <= 0:
                 fail(f"scale_test field {key!r} bad: {scale}")
+        # The certified row (ISSUE 15): a real device-certificate verdict
+        # on the GN-polished terminal iterate.  The gate is schema-level
+        # (a refused/failed verdict on a few functional rounds is an
+        # honest reading, not a regression); a malformed payload is not.
+        if "cert_status" in scale:
+            if scale["cert_status"] not in ("accept", "refuse", "fail",
+                                            "none"):
+                fail(f"scale_test cert_status bad: {scale['cert_status']!r}")
+            import math
+
+            if not _num(scale.get("cert_lambda_min")) \
+                    or not math.isfinite(scale["cert_lambda_min"]):
+                fail(f"scale_test cert_lambda_min bad: "
+                     f"{scale.get('cert_lambda_min')!r}")
     rz = rec.get("resilience")
     if rz and not rz.get("skipped"):
         # The chaos arm injected a fault on purpose: zero recoveries
@@ -195,6 +218,38 @@ def check_fleet(rec: dict) -> None:
              f"disk_hits={cold['disk_hits']}") + ")")
 
 
+def check_serving(rec: dict) -> None:
+    """Serving-record gate (``bench_serving.py`` output), including the
+    ``--certified`` p99 arm when present."""
+    for key in ("value", "unit", "n_problems", "sequential_qps",
+                "speedup_vs_sequential", "latency_p99_s"):
+        if key not in rec:
+            fail(f"serving record missing {key!r}: {sorted(rec)}")
+    if not _num(rec["value"]) or rec["value"] <= 0:
+        fail(f"non-positive batched QPS {rec['value']!r}")
+    if not _num(rec["speedup_vs_sequential"]):
+        fail(f"bad speedup_vs_sequential {rec['speedup_vs_sequential']!r}")
+    cert_line = ""
+    if "certified_latency_p99_s" in rec:
+        p99 = rec["certified_latency_p99_s"]
+        total, acc = rec.get("certified_total"), rec.get("certified_accepted")
+        if not _num(p99) or p99 <= 0:
+            fail(f"certified arm p99 bad: {p99!r}")
+        if p99 > SERVING_CERTIFIED_P99_S:
+            fail(f"certified p99 {p99}s exceeds floor "
+                 f"{SERVING_CERTIFIED_P99_S}s")
+        if not _num(total) or total != rec["n_problems"]:
+            fail(f"certified arm covered {total!r} of "
+                 f"{rec['n_problems']} requests")
+        if not _num(acc) or acc < 0 or acc > total:
+            fail(f"certified_accepted bad: {acc!r}/{total!r}")
+        cert_line = (f", certified p99 {p99}s <= {SERVING_CERTIFIED_P99_S}s "
+                     f"({acc}/{total} accepted)")
+    print(f"bench floor gate: PASS — serving {rec['value']} problems/s "
+          f"(speedup {rec['speedup_vs_sequential']}x, "
+          f"p99 {rec['latency_p99_s']}s{cert_line})")
+
+
 def main() -> None:
     try:
         if len(sys.argv) > 1:
@@ -214,6 +269,10 @@ def main() -> None:
 
     if rec.get("record") == "FLEET":
         check_fleet(rec)
+        return
+
+    if rec.get("metric") == "serving_batched_qps":
+        check_serving(rec)
         return
 
     # 1. Schema (all platforms).

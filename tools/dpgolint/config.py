@@ -74,8 +74,10 @@ def project_config() -> Config:
             "DPG003": [
                 "dpgo_tpu/models/rbcd.py",
                 "dpgo_tpu/models/incremental.py",
+                "dpgo_tpu/models/certify.py",
                 "dpgo_tpu/serve/runner.py",
                 "dpgo_tpu/parallel/sharded.py",
+                "dpgo_tpu/parallel/certify.py",
                 "dpgo_tpu/parallel/resilience.py",
             ],
             # DPG004 is annotation-driven (# guarded-by) — run everywhere;
@@ -162,6 +164,25 @@ def project_config() -> Config:
                     "dpgo_tpu/parallel/resilience.py": {
                         "hot_functions": ["checkpoint_arrays",
                                           "boundary_cb"],
+                        "sync_calls": ["_host_fetch"],
+                    },
+                    # The certificate layer (ISSUE 15): the device
+                    # certificate rides the solve's fused terminal
+                    # epilogue, so the ONE sanctioned transfer is that
+                    # terminal ``_host_fetch`` — the staircase loops
+                    # (which re-certify per rank) must route every
+                    # readback through it rather than fetching scalars
+                    # ad hoc between escapes.
+                    "dpgo_tpu/models/certify.py": {
+                        "hot_functions": ["solve_staircase",
+                                          "device_certificate_payload",
+                                          "decide_device_certificate"],
+                        "sync_calls": ["_host_fetch"],
+                    },
+                    "dpgo_tpu/parallel/certify.py": {
+                        "hot_functions": ["solve_staircase_sharded",
+                                          "certify_sharded",
+                                          "make_sharded_certificate"],
                         "sync_calls": ["_host_fetch"],
                     },
                 },
