@@ -141,6 +141,33 @@ def time_rounds(device, dtype, rounds):
     return float(np.median(rates))
 
 
+def profile_fused_rounds(device, dtype, profile_dir, rounds=8):
+    """Device-time attribution of the fused single-device loop (ISSUE
+    16, opt-in via ``BENCH_DEVPROF=<dir>``): one traced segment run
+    AFTER the timed trials — tracing slows the loop, so it must never
+    touch a measured window.  Returns the attribution dict (or None when
+    the profiler produced no trace)."""
+    import jax
+    from dpgo_tpu.models import rbcd
+    from dpgo_tpu.obs import devprof
+
+    state, graph, meta, params, _part = build(dtype)
+    state = jax.device_put(state, device)
+    graph = jax.device_put(graph, device)
+    steps = lambda s, k: rbcd.rbcd_steps(s, graph, k, meta, params)
+    _ = np.asarray(steps(state, 1).X)  # compile outside the window
+    win = devprof.DeviceTraceWindow(profile_dir, plane="solve").start()
+    _ = np.asarray(steps(state, rounds).X)
+    att = win.stop(num_rounds=rounds, label="fused_loop")
+    if att is not None:
+        pr = att["per_round"]
+        log(f"  [devprof] fused loop: {pr['compute_s'] * 1e3:.2f} ms "
+            f"compute + {pr['collective_s'] * 1e3:.2f} ms collective + "
+            f"{pr['idle_s'] * 1e3:.2f} ms idle per round "
+            f"({att['lanes']} lanes; trace in {profile_dir})")
+    return att
+
+
 def time_verdict_loop(device, dtype, rounds, k):
     """Time the production device-resident solve loop: ``run_rbcd`` in
     verdict mode — schedule segments + fused eval/verdict program on
@@ -378,6 +405,14 @@ def main():
         log(f"  {ips:.2f} RBCD rounds/s ({bench_dtype}, verdict loop "
             f"K={VERDICT_K}; {syncs:.3g} host syncs/100 rounds)")
 
+    # Optional device-time attribution of the fused loop (ISSUE 16):
+    # a separate traced segment AFTER the timed arms above, so the
+    # profiler overhead never contaminates the measured rates.
+    attribution = None
+    if os.environ.get("BENCH_DEVPROF"):
+        attribution = profile_fused_rounds(
+            dev, getattr(jnp, bench_dtype), os.environ["BENCH_DEVPROF"])
+
     if dev.platform == "cpu":
         windows = [{"ips": ips, "contended": False}]
     else:
@@ -430,6 +465,11 @@ def main():
         out["host_fetches_per_trial"] = fetches
     if parity is not None:
         out["kernel_parity_max_abs_diff"] = parity
+    if attribution is not None:
+        out["device_attribution"] = {
+            k: attribution[k]
+            for k in ("lanes", "window_s", "compute_s", "collective_s",
+                      "idle_s", "overlap_efficiency_measured")}
     if any(w.get("contended") for w in windows):
         # At least one f64 window ran on a loaded host; if ALL were
         # contended the median itself is inflated — flag loudest then.

@@ -247,9 +247,10 @@ def overlap_arm(dtype, obs_run=None):
         n, robots, dtype, seed=99)
     mesh = make_mesh(n_dev)
     state, graph_s = shard_problem(mesh, state, graph)
-    rates = {}
+    rates, multis = {}, {}
     for name, overlap in (("lockstep", False), ("overlap", True)):
         multi = make_sharded_multi_step(mesh, meta, params, overlap=overlap)
+        multis[name] = multi
         _ = np.asarray(multi(state, graph_s, 2).X)  # compile + warm
         t0 = time.perf_counter()
         out = multi(state, graph_s, ARGS.rounds)
@@ -261,6 +262,27 @@ def overlap_arm(dtype, obs_run=None):
            "overlap_rounds_per_s": round(rates["overlap"], 3),
            "lockstep_rounds_per_s": round(rates["lockstep"], 3)}
     if obs_run is not None:
+        # Device-time attribution per arm (ISSUE 16): a separate traced
+        # segment AFTER the clean A/B walls above (the profiler slows
+        # execution, so it must never touch the timed arms).  The
+        # measured split says WHERE the A/B delta comes from.
+        from dpgo_tpu.obs import devprof
+
+        calib = max(4, min(ARGS.rounds, 16))
+        for name in ("lockstep", "overlap"):
+            win = devprof.DeviceTraceWindow(
+                os.path.join(obs_run.run_dir, f"devprof_ab_{name}"),
+                plane="sharded").start()
+            _ = np.asarray(multis[name](state, graph_s, calib).X)
+            att = win.stop(num_rounds=calib, label=f"ab_{name}")
+            if att is not None:
+                rec[f"{name}_measured_overlap"] = round(
+                    att["overlap_efficiency_measured"], 4)
+                rec[f"{name}_collective_s_per_round"] = round(
+                    att["per_round"]["collective_s"], 6)
+                log(f"  [overlap A/B] {name} attribution: "
+                    f"{att['overlap_efficiency_measured'] * 100:.1f}% of "
+                    f"collective time hidden")
         obs_run.metric("sharded_overlap_efficiency", rec["efficiency"],
                        phase="bench",
                        overlap_rounds_per_s=rec["overlap_rounds_per_s"],

@@ -190,6 +190,35 @@ def _min_eig_jit(X, edges: EdgeSet, key, num_probe: int = 4,
     return lam_min, vec, stat, sigma
 
 
+def _timed_f64(fn, sink: list):
+    """Wrap the host f64 REFUSE-band fallback so its wall seconds land
+    in ``sink`` — installed only when telemetry is live (the off path
+    keeps the bare closure)."""
+    def wrapped(t):
+        t_f = time.perf_counter()
+        try:
+            return fn(t)
+        finally:
+            sink.append(time.perf_counter() - t_f)
+    return wrapped
+
+
+def _tally_cert(run, certified: bool, decidable: bool, f64_secs: list,
+                source: str) -> None:
+    """ACCEPT/FAIL/REFUSE decision tallies plus the f64-fallback wall —
+    the per-status counters the f32 ACCEPT-band sweep (ROADMAP item 3)
+    reads to see how often the expensive host eigensolve fires."""
+    status = "accept" if certified else ("fail" if decidable else "refuse")
+    run.counter("cert_status_total",
+                "certificate decisions by final status").inc(
+        status=status, source=source)
+    if f64_secs:
+        run.counter("cert_f64_fallback_seconds_total",
+                    "wall-clock spent in the host f64 REFUSE-band "
+                    "eigensolve fallback",
+                    unit="s").inc(sum(f64_secs), source=source)
+
+
 def certify_solution(
     X: jax.Array,
     edges: EdgeSet,
@@ -237,9 +266,13 @@ def certify_solution(
                               warm=np.asarray(vec, np.float64), tol=t,
                               tol_cert=tol)
 
+    f64_secs: list = []
+    chosen_f64 = f64_solve if f64_verify == "auto" else None
+    if run is not None and chosen_f64 is not None:
+        chosen_f64 = _timed_f64(chosen_f64, f64_secs)
     certified, decidable, lam_used, lam_f64, vec64 = decide_certificate(
         lam_min_f, sigma_f, tol, float(jnp.finfo(X.dtype).eps),
-        f64_solve if f64_verify == "auto" else None)
+        chosen_f64)
     if vec64 is not None:
         vec = jnp.asarray(vec64, X.dtype)
     if run is not None:
@@ -256,11 +289,14 @@ def certify_solution(
             lam_used)
         run.counter("certificates_evaluated",
                     "certify_solution calls").inc()
+        _tally_cert(run, certified, decidable, f64_secs,
+                    source="certify_solution")
         run.event("certificate", phase="certify",
                   certified=certified, decidable=decidable,
                   lambda_min=lam_min_f, lambda_min_f64=lam_f64,
                   eigenvalue_gap=gap, tol=tol, sigma=sigma_f,
                   stationarity_gap=float(stat), dim=dim,
+                  f64_fallback_s=sum(f64_secs) if f64_secs else None,
                   duration_s=time.perf_counter() - t0)
         # Verdict timeline -> numerical health: a streak of undecidable
         # verdicts (REFUSE loop) is an anomaly the staircase driver would
@@ -491,6 +527,9 @@ def decide_device_certificate(payload: dict, eta: float, dtype_eps: float,
     """
     run = obs.get_run()
     t0 = time.perf_counter() if run is not None else 0.0
+    f64_secs: list = []
+    if run is not None and f64_solve is not None:
+        f64_solve = _timed_f64(f64_solve, f64_secs)
     lam = float(payload["lam_min"])
     sigma = float(payload["sigma"])
     rq = float(payload["rq"])
@@ -531,12 +570,14 @@ def decide_device_certificate(payload: dict, eta: float, dtype_eps: float,
             lam_used)
         run.counter("certificates_evaluated",
                     "certify_solution calls").inc()
+        _tally_cert(run, certified, decidable, f64_secs, source=source)
         run.event("certificate", phase="certify",
                   certified=certified, decidable=decidable,
                   lambda_min=lam, lambda_min_f64=lam_f64,
                   eigenvalue_gap=gap, tol=tol, sigma=sigma,
                   stationarity_gap=stat,
                   device_verdict=CERT_STATUS[verdict], source=source,
+                  f64_fallback_s=sum(f64_secs) if f64_secs else None,
                   duration_s=time.perf_counter() - t0)
         from ..obs.health import monitor_for as _monitor_for
 

@@ -2230,6 +2230,21 @@ def _run_verdict_loop(state, graph, meta, segment, *, max_iters,
         if params is not None else "off"
     epilogue = make_terminal_epilogue(graph, edges_g, n_total, num_meas,
                                       meta, certify_mode=certify_mode)
+    if obs_run is not None:
+        # Compile accounting (ISSUE 16): the verdict program and the
+        # terminal epilogue report their cost/memory analysis and the
+        # bytes-per-flop roofline ratio through the same AOT probe as
+        # the serve cache — one compile per program either way, and any
+        # probe failure falls back to the plain jit callables.
+        from ..obs import devprof as _devprof
+
+        _plane = "sharded" if metrics_body is not None else "solve"
+        verdict_step = _devprof.profiled_program(
+            obs_run, verdict_step, key=f"verdict/k{verdict_every}",
+            label="verdict_step", plane=_plane)
+        epilogue = _devprof.profiled_program(
+            obs_run, epilogue, key="epilogue/terminal",
+            label="terminal_epilogue", plane=_plane)
 
     eval_its: list[int] = []
     fetches = 0

@@ -1,0 +1,525 @@
+"""Device-time attribution from ``jax.profiler`` traces (solver planes).
+
+PR-7's ``ProfilerWindow`` captures a device profile for the serving plane
+but never *reads* it — the trace goes to TensorBoard and the obs stack
+stays blind to what happens inside a compiled program.  That blindness is
+exactly ROADMAP item 3's soft spot: the halo/compute overlap A/B showed a
+*negative* efficiency on the CPU mesh (MULTICHIP_r06) and nothing could
+say where the time went.  This module closes the loop:
+
+* ``DeviceTraceWindow`` — a fence-constructed ``jax.profiler`` trace
+  window over a short calibration segment (a few fused rounds of the
+  sharded verdict loop, or of the single-device fused loop).  Stopping
+  the window parses the emitted Chrome-format trace itself.
+* ``attribute_trace`` / ``attribute_profile_dir`` — pure parsers that
+  split per-device-lane XLA op time into **collective** (all-gather /
+  all-reduce / collective-permute / reduce-scatter / ... matched by the
+  op-name pattern table) vs **compute** vs **idle**, normalized per
+  round, plus a *measured* overlap efficiency: the fraction of
+  collective wall time during which some other lane was computing —
+  i.e. how much of the exchange actually hid behind compute.  (On the
+  CPU host-platform mesh the lanes share physical cores, so "hidden"
+  concurrency still contends for cycles — which is precisely why
+  overlap does not pay there; the A/B wall-clock in
+  ``decide_overlap`` stays the decision authority and the attribution
+  is the evidence.)
+* ``decide_overlap`` — the adaptive overlap gate's arbiter: given the
+  timed lockstep/overlapped arms (and their attributions when captured)
+  it picks the winner and shapes the ``overlap_decision`` evidence.
+* ``profiled_program`` — extends the serve cache's
+  ``ProfiledExecutable``-style compile accounting (cost/memory analysis
+  with the bytes-per-flop roofline ratio) to solver-plane programs,
+  defensively: a failed AOT probe falls back to the plain jit callable.
+
+Everything here is constructed and invoked strictly behind the PR-1
+zero-overhead telemetry fence; the trace-parsing helpers are pure
+functions usable offline (tests, ``report``).  XLA op events are
+recognized by the ``args.hlo_op`` marker the profiler attaches to device
+ops (host-side Python spans lack it), with one executor thread per
+device lane — verified against jax 0.4.x CPU traces.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import threading
+import time
+
+from .run import get_run
+
+__all__ = [
+    "COLLECTIVE_OP_PREFIXES",
+    "DeviceTraceWindow",
+    "attribute_profile_dir",
+    "attribute_trace",
+    "classify_op",
+    "decide_overlap",
+    "find_trace_files",
+    "load_trace_events",
+    "profiled_program",
+]
+
+#: Op-name prefixes that mark an XLA op as a cross-device collective.
+#: Matched against ``args.hlo_op`` (HLO instruction names: the HLO op
+#: kind plus a numeric suffix, e.g. ``all-gather.3``).  ``psum`` /
+#: ``ppermute`` are the jax-level spellings that surface on some
+#: backends' op metadata; ``send``/``recv`` are the point-to-point pair
+#: ppermute lowers to on real interconnects.
+COLLECTIVE_OP_PREFIXES = (
+    "all-gather",
+    "all-reduce",
+    "all-to-all",
+    "collective-broadcast",
+    "collective-permute",
+    "reduce-scatter",
+    "psum",
+    "ppermute",
+    "send",
+    "recv",
+)
+
+#: Keep at most this many slices in a ``device_attribution`` event (the
+#: longest ones) — enough for the timeline device track without letting
+#: a long window bloat events.jsonl.
+MAX_SLICES = 200
+
+#: And at most this many distinct ops in the ``top_ops`` table.
+MAX_TOP_OPS = 12
+
+
+def classify_op(op_name: str) -> str:
+    """``"collective"`` or ``"compute"`` for one HLO op name."""
+    name = op_name.lower()
+    for prefix in COLLECTIVE_OP_PREFIXES:
+        if name.startswith(prefix):
+            return "collective"
+    return "compute"
+
+
+def find_trace_files(profile_dir: str) -> list:
+    """Chrome-format trace files under a ``jax.profiler`` output dir.
+
+    jax writes ``<dir>/plugins/profile/<timestamp>/<host>.trace.json.gz``;
+    accept the uncompressed spelling too and, as a last resort, any
+    ``*.trace.json[.gz]`` anywhere below ``profile_dir``."""
+    pats = [
+        os.path.join(profile_dir, "plugins", "profile", "*",
+                     "*.trace.json.gz"),
+        os.path.join(profile_dir, "plugins", "profile", "*",
+                     "*.trace.json"),
+        os.path.join(profile_dir, "**", "*.trace.json.gz"),
+        os.path.join(profile_dir, "**", "*.trace.json"),
+    ]
+    for pat in pats:
+        found = sorted(glob.glob(pat, recursive=True))
+        if found:
+            return found
+    return []
+
+
+def load_trace_events(path: str) -> list:
+    """The ``traceEvents`` list of one Chrome-format trace file."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8", errors="replace") as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", []) if isinstance(doc, dict) else doc
+    return [e for e in events if isinstance(e, dict)]
+
+
+def _merge(intervals: list) -> list:
+    """Union of [t0, t1) intervals, sorted and coalesced."""
+    out = []
+    for t0, t1 in sorted(intervals):
+        if out and t0 <= out[-1][1]:
+            if t1 > out[-1][1]:
+                out[-1] = (out[-1][0], t1)
+        else:
+            out.append((t0, t1))
+    return out
+
+
+def _subtract(merged_a: list, merged_b: list) -> list:
+    """Parts of merged union ``a`` not covered by merged union ``b``."""
+    out = []
+    j = 0
+    for t0, t1 in merged_a:
+        cur = t0
+        while j < len(merged_b) and merged_b[j][1] <= cur:
+            j += 1
+        k = j
+        while k < len(merged_b) and merged_b[k][0] < t1:
+            if merged_b[k][0] > cur:
+                out.append((cur, merged_b[k][0]))
+            cur = max(cur, merged_b[k][1])
+            k += 1
+        if cur < t1:
+            out.append((cur, t1))
+    return out
+
+
+def _leaf_flags(ops: list) -> list:
+    """``True`` per op that contains no other op on the same lane.
+
+    XLA traces nest: the fused-rounds ``while`` slice encloses every op
+    of its body, so summing raw durations double-counts and the container
+    drowns the real op mix.  Ops here are ``(t0, t1, op)`` tuples;
+    ordering by (start, -duration) makes any enclosing op precede its
+    children, so one stack pass marks the parents."""
+    order = sorted(range(len(ops)),
+                   key=lambda i: (ops[i][0], ops[i][0] - ops[i][1]))
+    leaf = [True] * len(ops)
+    stack: list = []
+    for i in order:
+        t0, t1 = ops[i][0], ops[i][1]
+        while stack and ops[stack[-1]][1] <= t0:
+            stack.pop()
+        if stack:
+            leaf[stack[-1]] = False
+        stack.append(i)
+    return leaf
+
+def _overlap_len(intervals: list, merged: list) -> float:
+    """Total length of ``intervals`` covered by the merged union."""
+    total = 0.0
+    j = 0
+    for t0, t1 in sorted(intervals):
+        while j > 0 and merged[j - 1][1] > t0:
+            j -= 1
+        k = j
+        while k < len(merged) and merged[k][0] < t1:
+            total += max(0.0, min(t1, merged[k][1]) - max(t0, merged[k][0]))
+            k += 1
+        j = max(k - 1, 0)
+    return total
+
+
+def attribute_trace(events: list, num_rounds: int = 1,
+                    module_filter: str | None = None) -> dict:
+    """Per-round device-time attribution of one trace's XLA op events.
+
+    Device ops are the ``ph == "X"`` slices whose ``args`` carry the
+    ``hlo_op`` marker; one (pid, tid) pair per device lane.  Per lane,
+    collective time is the merged union of its collective-op intervals
+    and compute time is the lane's busy union minus that — interval
+    algebra, not duration sums, so nested slices (the fused-rounds
+    ``while`` container encloses its whole body) never double-count and
+    container self-time still lands in compute.  Idle is the rest of the
+    window.  Returns the split (totals and per-round), the measured
+    overlap efficiency (fraction of collective time concurrent with
+    compute on another lane — how much of the exchange was actually
+    hidden), a leaf-op ``top_ops`` table, and the longest leaf
+    ``slices`` (window-relative seconds) for the timeline device track.
+    """
+    num_rounds = max(1, int(num_rounds))
+    lanes: dict = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args")
+        if not isinstance(args, dict) or "hlo_op" not in args:
+            continue
+        if module_filter and module_filter not in str(
+                args.get("hlo_module", "")):
+            continue
+        try:
+            t0 = float(e["ts"])
+            dur = float(e.get("dur", 0.0))
+        except (KeyError, TypeError, ValueError):
+            continue
+        op = str(args.get("hlo_op") or e.get("name", ""))
+        lane = (e.get("pid", 0), e.get("tid", 0))
+        lanes.setdefault(lane, []).append((t0, t0 + max(dur, 0.0), op))
+
+    if not lanes:
+        return {"lanes": 0, "num_rounds": num_rounds, "window_s": 0.0,
+                "compute_s": 0.0, "collective_s": 0.0, "idle_s": 0.0,
+                "per_round": {"compute_s": 0.0, "collective_s": 0.0,
+                              "idle_s": 0.0},
+                "collective_hidden_s": 0.0,
+                "overlap_efficiency_measured": 0.0,
+                "top_ops": [], "slices": []}
+
+    t_min = min(t0 for ops in lanes.values() for t0, _t1, _op in ops)
+    t_max = max(t1 for ops in lanes.values() for _t0, t1, _op in ops)
+    window_us = max(t_max - t_min, 0.0)
+
+    lane_ids = {lane: i for i, lane in enumerate(sorted(lanes))}
+    compute_us = collective_us = busy_us = 0.0
+    per_lane_compute: dict = {}
+    per_lane_collective: dict = {}
+    op_totals: dict = {}
+    all_slices = []
+    for lane, ops in lanes.items():
+        leaf = _leaf_flags(ops)
+        coll_raw = []
+        for is_leaf, (t0, t1, op) in zip(leaf, ops):
+            kind = classify_op(op)
+            if kind == "collective":
+                coll_raw.append((t0, t1))
+            if is_leaf:
+                base = op.rsplit(".", 1)[0] or op
+                tot = op_totals.setdefault(base, [kind, 0.0, 0])
+                tot[1] += t1 - t0
+                tot[2] += 1
+                all_slices.append((t1 - t0, lane_ids[lane], op, kind, t0))
+        coll = _merge(coll_raw)
+        busy = _merge([(t0, t1) for t0, t1, _op in ops])
+        comp = _subtract(busy, coll)
+        compute_us += sum(t1 - t0 for t0, t1 in comp)
+        collective_us += sum(t1 - t0 for t0, t1 in coll)
+        busy_us += sum(t1 - t0 for t0, t1 in busy)
+        per_lane_compute[lane] = comp
+        per_lane_collective[lane] = coll
+
+    # Hidden collective time: per lane, its collective intervals that are
+    # concurrent with compute on ANY OTHER lane (same-lane overlap cannot
+    # happen on a serialized executor; on async-collective backends the
+    # same-device compute stream shows up as its own lane/tid anyway).
+    hidden_us = 0.0
+    for lane, coll in per_lane_collective.items():
+        if not coll:
+            continue
+        others = _merge([iv for other, comp in per_lane_compute.items()
+                         if other != lane for iv in comp])
+        if others:
+            hidden_us += _overlap_len(coll, others)
+
+    n_lanes = len(lanes)
+    idle_us = max(n_lanes * window_us - busy_us, 0.0)
+    to_s = 1e-6
+    top = sorted(op_totals.items(), key=lambda kv: -kv[1][1])[:MAX_TOP_OPS]
+    all_slices.sort(reverse=True)
+    slices = [{"lane": lane_i, "op": op, "kind": kind,
+               "t0_s": round((t0 - t_min) * to_s, 9),
+               "dur_s": round(dur * to_s, 9)}
+              for dur, lane_i, op, kind, t0 in all_slices[:MAX_SLICES]]
+    slices.sort(key=lambda s: (s["lane"], s["t0_s"]))
+    return {
+        "lanes": n_lanes,
+        "num_rounds": num_rounds,
+        "window_s": window_us * to_s,
+        "compute_s": compute_us * to_s,
+        "collective_s": collective_us * to_s,
+        "idle_s": idle_us * to_s,
+        "per_round": {
+            "compute_s": compute_us * to_s / num_rounds,
+            "collective_s": collective_us * to_s / num_rounds,
+            "idle_s": idle_us * to_s / num_rounds,
+        },
+        "collective_hidden_s": hidden_us * to_s,
+        "overlap_efficiency_measured":
+            (hidden_us / collective_us) if collective_us > 0 else 0.0,
+        "top_ops": [{"op": op, "kind": kind, "total_s": tot * to_s,
+                     "count": count}
+                    for op, (kind, tot, count) in top],
+        "slices": slices,
+    }
+
+
+def attribute_profile_dir(profile_dir: str, num_rounds: int = 1,
+                          module_filter: str | None = None) -> dict | None:
+    """Attribution over every trace file a profiler window emitted
+    (normally one per host); ``None`` when no trace was found."""
+    files = find_trace_files(profile_dir)
+    if not files:
+        return None
+    events = []
+    for path in files:
+        try:
+            events.extend(load_trace_events(path))
+        except (OSError, ValueError):
+            continue
+    out = attribute_trace(events, num_rounds=num_rounds,
+                          module_filter=module_filter)
+    out["trace_files"] = len(files)
+    return out
+
+
+class DeviceTraceWindow:
+    """One fence-constructed profiler capture + attribution window.
+
+    ``start()`` opens a ``jax.profiler`` trace into ``profile_dir``;
+    ``stop(num_rounds=K)`` closes it, attributes the emitted trace, and
+    (when a run is still live) emits one ``device_attribution`` event
+    carrying the split, the measured overlap efficiency, the top-ops
+    table, and the timeline slices.  Like the serving plane's
+    ``ProfilerWindow``, every failure path degrades to "no attribution"
+    (plus a ``profiler_error`` event) — profiling must never take a
+    solve down, and a window is only ever constructed behind
+    ``get_run() is not None`` (DPG002)."""
+
+    def __init__(self, profile_dir: str, plane: str = "sharded"):
+        self.profile_dir = str(profile_dir)
+        self.plane = str(plane)
+        self._active = False
+        self._dead = False
+        self._lock = threading.Lock()
+
+    def start(self) -> "DeviceTraceWindow":
+        with self._lock:
+            if self._dead or self._active:
+                return self
+            try:
+                import jax
+
+                jax.profiler.start_trace(self.profile_dir)
+                self._active = True
+            except Exception as e:
+                self._dead = True
+                run = get_run()
+                if run is not None:
+                    run.event("profiler_error", phase=self.plane,
+                              error=repr(e))
+        return self
+
+    def stop(self, num_rounds: int = 1, label: str = "calibration",
+             module_filter: str | None = None, **extra) -> dict | None:
+        with self._lock:
+            if not self._active:
+                return None
+            self._active = False
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception as e:
+                self._dead = True
+                run = get_run()
+                if run is not None:
+                    run.event("profiler_error", phase=self.plane,
+                              error=repr(e))
+                return None
+        try:
+            attribution = attribute_profile_dir(
+                self.profile_dir, num_rounds=num_rounds,
+                module_filter=module_filter)
+        except Exception as e:
+            attribution = None
+            run = get_run()
+            if run is not None:
+                run.event("profiler_error", phase=self.plane,
+                          error=repr(e))
+        run = get_run()
+        if run is not None and attribution is not None:
+            run.event("device_attribution", phase=self.plane, label=label,
+                      profile_dir=self.profile_dir, **attribution, **extra)
+            run.gauge(
+                "device_overlap_efficiency_measured",
+                "measured fraction of collective device time hidden "
+                "behind compute (profiler attribution)").set(
+                    attribution["overlap_efficiency_measured"], label=label)
+        return attribution
+
+    def close(self) -> None:
+        """Abandon a still-open window without attribution."""
+        with self._lock:
+            if self._active:
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                self._active = False
+
+
+def decide_overlap(arms: dict, threshold: float = 0.0) -> dict:
+    """The adaptive gate's arbiter: pick overlapped vs lockstep.
+
+    ``arms`` maps ``"lockstep"``/``"overlapped"`` to dicts with at least
+    ``seconds`` and ``rounds`` (plus optional ``attribution``).  The A/B
+    efficiency is ``1 - t_overlapped / t_lockstep`` (positive = overlap
+    pays); overlap wins when it clears ``threshold``.  Returns the
+    decision record that becomes the ``overlap_decision`` event body."""
+    lock = arms["lockstep"]
+    over = arms["overlapped"]
+    t_lock = max(float(lock["seconds"]), 1e-12)
+    t_over = max(float(over["seconds"]), 1e-12)
+    efficiency = 1.0 - t_over / t_lock
+    chosen = efficiency > float(threshold)
+    record = {
+        "overlap": chosen,
+        "efficiency": efficiency,
+        "threshold": float(threshold),
+        "lockstep_seconds": float(lock["seconds"]),
+        "overlapped_seconds": float(over["seconds"]),
+        "lockstep_rounds_per_s": float(lock["rounds"]) / t_lock,
+        "overlapped_rounds_per_s": float(over["rounds"]) / t_over,
+        "calib_rounds": int(lock["rounds"]),
+    }
+    for name, arm in (("lockstep", lock), ("overlapped", over)):
+        attribution = arm.get("attribution")
+        if attribution:
+            record[f"{name}_overlap_efficiency_measured"] = \
+                attribution["overlap_efficiency_measured"]
+            record[f"{name}_collective_s_per_round"] = \
+                attribution["per_round"]["collective_s"]
+            record[f"{name}_compute_s_per_round"] = \
+                attribution["per_round"]["compute_s"]
+    return record
+
+
+def profiled_program(run, jitfn, key: str, label: str, plane: str,
+                     static_names: tuple = (), **extra):
+    """Solver-plane compile accounting: a defensive, roofline-reporting
+    cousin of the serve cache's ``ProfiledExecutable``.
+
+    Returns a callable that AOT-compiles ``jitfn`` once per static-kwarg
+    combination through ``profile.aot_compile_profile`` (recording
+    lower/compile walls, cost/memory analysis, and the bytes-per-flop
+    roofline ratio under ``phase=plane``) and dispatches the compiled
+    executable from then on — the same compile count as the plain jit
+    path.  Any AOT failure (an exotic arg pytree, a backend without AOT
+    support) falls back permanently to the plain jit callable: compile
+    accounting must never change solver behavior.  ``run`` is the
+    caller's already-resolved fence, like ``aot_compile_profile``."""
+    from . import profile as profile_mod
+
+    compiled: dict = {}
+    dead: list = []
+    lock = threading.Lock()
+
+    def call(*args, **kwargs):
+        if dead or get_run() is None:
+            return jitfn(*args, **kwargs)
+        combo = tuple(sorted(
+            (k, kwargs[k]) for k in static_names if k in kwargs))
+        with lock:
+            exe = compiled.get(combo)
+        if exe is None:
+            try:
+                exe = profile_mod.aot_compile_profile(
+                    run, jitfn, args, kwargs, key, label, phase=plane,
+                    metric_prefix=plane, static=dict(combo) or None,
+                    **extra)
+            except Exception as e:
+                dead.append(True)
+                run.event("profiler_error", phase=plane, label=label,
+                          error=repr(e))
+                return jitfn(*args, **kwargs)
+            with lock:
+                compiled.setdefault(combo, exe)
+        dyn = {k: v for k, v in kwargs.items() if k not in static_names}
+        try:
+            return exe(*args, **dyn)
+        except Exception:
+            # AOT dispatch rejected the call (e.g. sharding/layout drift
+            # after a mesh rewind) — permanent fallback, correctness first.
+            dead.append(True)
+            return jitfn(*args, **kwargs)
+
+    return call
+
+
+def time_arm(fn, *args) -> float:
+    """Wall seconds for one fully-materialized call of ``fn`` — the plain
+    A/B timer the auto gate uses with telemetry OFF (no obs machinery:
+    ``jax.block_until_ready`` is the fence)."""
+    import jax
+
+    t0 = time.monotonic()
+    jax.block_until_ready(fn(*args))
+    return time.monotonic() - t0

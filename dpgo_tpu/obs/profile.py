@@ -96,6 +96,7 @@ def _memory_fields(compiled) -> dict:
 
 
 def aot_compile_profile(run, jitfn, args, kwargs, key: str, label: str,
+                        phase: str = "serve", metric_prefix: str = "serve",
                         **extra):
     """Lower + AOT-compile ``jitfn`` for these arguments, recording the
     compile profile under fingerprint ``key``; returns the compiled
@@ -103,7 +104,12 @@ def aot_compile_profile(run, jitfn, args, kwargs, key: str, label: str,
 
     One ``compile_profile`` event carries: the fingerprint key, the
     program label (segment/metrics/finalize), trace/lower vs. XLA compile
-    wall seconds, and whatever cost/memory analysis the backend exposes.
+    wall seconds, whatever cost/memory analysis the backend exposes, and
+    — when both flops and bytes-accessed are known — the bytes-per-flop
+    roofline ratio (arithmetic intensity's reciprocal: how memory-bound
+    the program is).  ``phase``/``metric_prefix`` scope the event and
+    metric names to the emitting plane (``serve`` for the executable
+    cache, ``solve``/``sharded`` via ``devprof.profiled_program``).
     ``run`` is the caller's already-resolved ambient run — the caller's
     fence, like ``emit_span``."""
     t0 = time.monotonic()
@@ -116,20 +122,27 @@ def aot_compile_profile(run, jitfn, args, kwargs, key: str, label: str,
               "total_s": t_done - t0}
     fields.update(_cost_fields(compiled))
     fields.update(_memory_fields(compiled))
+    if fields.get("flops", 0) > 0 and "bytes_accessed" in fields:
+        fields["bytes_per_flop"] = fields["bytes_accessed"] / fields["flops"]
     fields.update(extra)
-    run.event("compile_profile", phase="serve", **fields)
-    run.counter("serve_compile_seconds_total",
-                "wall-clock spent in XLA compiles of serving executables",
+    run.event("compile_profile", phase=phase, **fields)
+    run.counter(f"{metric_prefix}_compile_seconds_total",
+                "wall-clock spent in XLA compiles of profiled executables",
                 unit="s").inc(t_done - t0, label=label)
     if "flops" in fields:
-        run.gauge("serve_compile_flops",
-                  "XLA cost-analysis flops of the last compiled serving "
+        run.gauge(f"{metric_prefix}_compile_flops",
+                  "XLA cost-analysis flops of the last compiled "
                   "executable").set(fields["flops"], label=label)
     if "temp_bytes" in fields:
-        run.gauge("serve_compile_temp_bytes",
+        run.gauge(f"{metric_prefix}_compile_temp_bytes",
                   "XLA memory-analysis temp allocation of the last "
-                  "compiled serving executable",
+                  "compiled executable",
                   unit="bytes").set(fields["temp_bytes"], label=label)
+    if "bytes_per_flop" in fields:
+        run.gauge(f"{metric_prefix}_bytes_per_flop",
+                  "roofline ratio (bytes accessed / flop) of the last "
+                  "compiled executable").set(fields["bytes_per_flop"],
+                                             label=label)
     return compiled
 
 

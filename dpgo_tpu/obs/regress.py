@@ -57,11 +57,17 @@ from .run import EVENTS_FILE, META_FILE
 #: slower regresses ``mesh_recovery_overhead_s`` even when the solve
 #: itself is untouched.  Absent on fault-free runs, so only chaos-arm
 #: baselines ever compare it.
+#: Overlap efficiency (ISSUE 16) gates lower-bounded (higher is better):
+#: a change that drops the halo/compute overlap win below the baseline
+#: band — in particular a regression from positive to negative — fails
+#: the compare even when throughput metrics stay inside tolerance.
 GATED_METRICS = {"solver_cost": "lower", "solver_grad_norm": "lower",
                  "host_syncs_per_100_rounds": "lower",
                  "fleet_qps": "higher",
                  "serve_cold_start_seconds": "lower",
-                 "mesh_recovery_overhead_s": "lower"}
+                 "mesh_recovery_overhead_s": "lower",
+                 "sharded_overlap_efficiency": "higher",
+                 "device_overlap_efficiency_measured": "higher"}
 #: Fingerprint keys that never gate (recorded for the report only).
 NON_GATING_KEYS = {"version"}
 
@@ -254,6 +260,130 @@ def render_compare(cmp: dict) -> str:
     return "\n".join(lines)
 
 
+#: Cross-round ledger trends and their improvement direction (ISSUE 16).
+#: Keys are ``(family, series)`` into ``ledger.PerfLedger.series``:
+#: ``"value"`` is the family's headline metric, anything else an extras
+#: key.  The newest round gates against the noise band of all previous
+#: readings, the same sign-aware bound arithmetic as the pairwise gate —
+#: so the trend gate catches a slide the pairwise compare never sees
+#: (each round individually within tolerance of its predecessor).
+LEDGER_TRENDS = {
+    ("BENCH", "value"): "higher",
+    ("BENCH", "vs_baseline"): "higher",
+    ("BENCH", "kernel_parity_max_abs_diff"): "lower",
+    ("MULTICHIP", "value"): "higher",
+    ("MULTICHIP", "host_syncs_per_100_rounds"): "lower",
+    ("MULTICHIP", "overlap_efficiency"): "higher",
+    ("FLEET", "value"): "higher",
+    ("FLEET", "scaling_1_to_2"): "higher",
+}
+
+
+def _band_bound(band_edge: float, direction: str, rtol: float,
+                atol: float = 1e-9) -> float:
+    """Sign-aware tolerance widening of a band edge (shared with the
+    pairwise gate's inline arithmetic)."""
+    if direction == "lower":
+        return band_edge * (1.0 + rtol) + atol if band_edge >= 0 \
+            else band_edge * (1.0 - rtol) + atol
+    return band_edge * (1.0 - rtol) - atol if band_edge >= 0 \
+        else band_edge * (1.0 + rtol) - atol
+
+
+def trend_gate(ledger, rtol: float = 0.10, tail: int = 5) -> dict:
+    """Cross-round regression gate over a ``PerfLedger``.
+
+    For every declared trend series with >= 2 readings, the newest
+    round's value must stay inside the noise band (``tail_band`` over
+    the trailing ``tail`` previous readings) widened by ``rtol`` in the
+    series' improvement direction.  A latest-round record with
+    ``ok=false`` in any family regresses outright — a round that failed
+    to produce its record must not pass on the strength of old numbers.
+    Returns the comparison record (``rc`` 0/2), mirroring
+    ``compare_runs``."""
+    out: dict = {"root": ledger.root, "trends": {}, "regressions": [],
+                 "families": ledger.families()}
+    for family in ledger.families():
+        rows = ledger.family_rows(family)
+        if rows and not rows[-1]["ok"]:
+            name = f"{family}:ok"
+            out["trends"][name] = {
+                "latest_round": rows[-1]["round"], "regressed": True,
+                "reason": f"latest round r{rows[-1]['round']:02d} "
+                          f"({rows[-1]['file']}) reports ok=false"}
+            out["regressions"].append(name)
+    for (family, key), direction in sorted(LEDGER_TRENDS.items()):
+        pts = ledger.series(family, key)
+        if len(pts) < 2:
+            continue
+        rounds = [r for r, _ in pts]
+        values = [v for _, v in pts]
+        band = tail_band(values[:-1], tail)
+        latest_r, latest = rounds[-1], values[-1]
+        regressed, why = False, None
+        if direction == "lower":
+            bound = _band_bound(band["max"], "lower", rtol)
+            if math.isfinite(bound) and latest > bound:
+                regressed = True
+                why = (f"r{latest_r:02d} value {latest:.6g} above prior "
+                       f"band max {band['max']:.6g} (+{rtol * 100:.0f}%)")
+        else:
+            bound = _band_bound(band["min"], "higher", rtol)
+            if math.isfinite(bound) and latest < bound:
+                regressed = True
+                why = (f"r{latest_r:02d} value {latest:.6g} below prior "
+                       f"band min {band['min']:.6g} (-{rtol * 100:.0f}%)")
+        name = f"{family}:{key}"
+        out["trends"][name] = {
+            "direction": direction, "rounds": rounds, "values": values,
+            "band": band, "latest_round": latest_r, "latest": latest,
+            "regressed": regressed, "reason": why}
+        if regressed:
+            out["regressions"].append(name)
+    out["rc"] = 2 if out["regressions"] else 0
+    return out
+
+
+def render_trend(gate: dict) -> str:
+    lines = [f"== ledger trend gate: {gate['root']} "
+             f"({', '.join(gate['families']) or 'no records'}) =="]
+    for name, t in sorted(gate["trends"].items()):
+        if "values" not in t:
+            lines.append(f"  {name:<38} REGRESSED")
+            lines.append(f"    ^ {t['reason']}")
+            continue
+        span = (f"r{t['rounds'][0]:02d}..r{t['latest_round']:02d} "
+                f"({len(t['values'])} readings)")
+        verdict = "REGRESSED" if t["regressed"] else "ok"
+        lines.append(f"  {name:<38} {span:<26} "
+                     f"latest {_fmt(t['latest']):>12}  {verdict}")
+        if t.get("reason"):
+            lines.append(f"    ^ {t['reason']}")
+    if gate["regressions"]:
+        lines.append("RESULT: TREND REGRESSION in "
+                     + ", ".join(gate["regressions"]))
+    else:
+        lines.append("RESULT: no trend regression")
+    return "\n".join(lines)
+
+
+def run_trend(root: str, rtol: float = 0.10,
+              json_out: bool = False) -> int:
+    """CLI body for ``--ledger``: load, gate, print, return exit code."""
+    from .ledger import load_ledger
+
+    ledger = load_ledger(root)
+    if not ledger.rows:
+        print(f"no bench records found under {root}", file=sys.stderr)
+        return 2
+    gate = trend_gate(ledger, rtol=rtol)
+    if json_out:
+        print(json.dumps(gate))
+    else:
+        print(render_trend(gate))
+    return int(gate["rc"])
+
+
 def run_compare(dir_a: str, dir_b: str, rtol: float = 0.05,
                 json_out: bool = False, allow_mismatch: bool = False) -> int:
     """CLI body shared by ``report --compare`` and ``python -m
@@ -274,16 +404,29 @@ def run_compare(dir_a: str, dir_b: str, rtol: float = 0.05,
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m dpgo_tpu.obs.regress", description=__doc__)
-    ap.add_argument("run_a")
-    ap.add_argument("run_b")
-    ap.add_argument("--rtol", type=float, default=0.05,
-                    help="relative tolerance over run A's tail band "
-                         "(default 0.05)")
+    ap.add_argument("run_a", nargs="?")
+    ap.add_argument("run_b", nargs="?")
+    ap.add_argument("--rtol", type=float, default=None,
+                    help="relative tolerance over the baseline band "
+                         "(default 0.05 pairwise, 0.10 for --ledger)")
     ap.add_argument("--allow-mismatch", action="store_true",
                     help="compare despite fingerprint mismatches")
+    ap.add_argument("--ledger", metavar="ROOT",
+                    help="cross-round trend gate over the BENCH_r*/"
+                         "MULTICHIP_r*/FLEET_r* records under ROOT "
+                         "instead of a pairwise run compare")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
-    return run_compare(args.run_a, args.run_b, rtol=args.rtol,
+    if args.ledger is not None:
+        if args.run_a or args.run_b:
+            ap.error("--ledger takes no run directories")
+        return run_trend(args.ledger,
+                         rtol=0.10 if args.rtol is None else args.rtol,
+                         json_out=args.json)
+    if not (args.run_a and args.run_b):
+        ap.error("need two run directories (or --ledger ROOT)")
+    return run_compare(args.run_a, args.run_b,
+                       rtol=0.05 if args.rtol is None else args.rtol,
                        json_out=args.json,
                        allow_mismatch=args.allow_mismatch)
 

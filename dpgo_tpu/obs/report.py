@@ -368,6 +368,11 @@ def _sharded_lines(stats: dict | None) -> list[str]:
         lines.append(
             f"  halo overlap efficiency: {ov['efficiency'] * 100:.1f}%"
             + detail)
+        if ov["efficiency"] < 0:
+            lines.append(
+                "  WARNING: overlap not paying (negative efficiency — "
+                "gate it off with overlap=\"auto\" or profile with "
+                "devprof)")
     for t in stats["gn_tails"]:
         lines.append(
             f"  gn tail: {t['terminated_by']} after "
@@ -393,6 +398,158 @@ def _sharded_lines(stats: dict | None) -> list[str]:
             lines.append(
                 f"  rewind [{r['kind']}]: mesh {r['mesh_from']} -> "
                 f"{r['mesh_to']} devices, resumed from {dest}")
+    return lines
+
+
+def devprof_stats(events: list[dict]) -> dict | None:
+    """Device-time attribution facts (ISSUE 16): ``devprof``'s
+    ``device_attribution`` windows (compute/collective/idle split +
+    measured overlap efficiency), the adaptive gate's
+    ``overlap_decision`` records, and the solver planes'
+    ``compile_profile`` rooflines.  Serve-plane compiles keep rendering
+    in the fleet section (``fleet_serve_stats``); this section owns
+    ``phase in ("solve", "sharded")``."""
+    attrs = [ev for ev in events
+             if ev.get("event") == "device_attribution"]
+    decisions = [ev for ev in events
+                 if ev.get("event") == "overlap_decision"]
+    compiles = [ev for ev in events if ev.get("event") == "compile_profile"
+                and ev.get("phase") in ("solve", "sharded")]
+    errors = [ev for ev in events if ev.get("event") == "profiler_error"
+              and ev.get("phase") in ("solve", "sharded")]
+    if not (attrs or decisions or compiles):
+        return None
+    out: dict = {"windows": [], "decisions": [], "compiles": [],
+                 "profiler_errors": len(errors)}
+    for ev in attrs:
+        out["windows"].append({k: ev.get(k) for k in (
+            "label", "phase", "lanes", "num_rounds", "window_s",
+            "compute_s", "collective_s", "idle_s", "per_round",
+            "collective_hidden_s", "overlap_efficiency_measured",
+            "top_ops", "trace_files", "profile_dir")})
+    for ev in decisions:
+        out["decisions"].append({k: ev.get(k) for k in (
+            "overlap", "efficiency", "threshold", "reason", "mesh_size",
+            "exchange", "calib_rounds",
+            "lockstep_seconds", "overlapped_seconds",
+            "lockstep_rounds_per_s", "overlapped_rounds_per_s",
+            "lockstep_overlap_efficiency_measured",
+            "overlapped_overlap_efficiency_measured",
+            "lockstep_collective_s_per_round",
+            "overlapped_collective_s_per_round")})
+    for ev in compiles:
+        out["compiles"].append({k: ev.get(k) for k in (
+            "label", "phase", "key", "static", "lower_s", "compile_s",
+            "total_s", "flops", "bytes_accessed", "bytes_per_flop",
+            "temp_bytes")})
+    return out
+
+
+def _devprof_lines(stats: dict | None) -> list[str]:
+    """Render the device-profile section (devprof events present)."""
+    if not stats:
+        return []
+    lines = ["device profile:"]
+    for w in stats["windows"]:
+        busy = (w.get("compute_s") or 0.0) + (w.get("collective_s") or 0.0)
+        total = busy + (w.get("idle_s") or 0.0)
+        pct = (lambda v: f"{100.0 * v / total:.0f}%") if total > 0 \
+            else (lambda v: "-")
+        lines.append(
+            f"  window [{w.get('label')}] ({w.get('phase')}): "
+            f"{w.get('lanes')} lanes x {_fmt(w.get('window_s'))}s, "
+            f"{w.get('num_rounds')} rounds — compute "
+            f"{pct(w.get('compute_s') or 0.0)}, collective "
+            f"{pct(w.get('collective_s') or 0.0)}, idle "
+            f"{pct(w.get('idle_s') or 0.0)}")
+        eff = w.get("overlap_efficiency_measured")
+        if eff is not None:
+            lines.append(
+                f"    measured overlap: {eff * 100:.1f}% of collective "
+                f"time hidden behind compute "
+                f"({_fmt(w.get('collective_hidden_s'))}s of "
+                f"{_fmt(w.get('collective_s'))}s)")
+        for op in (w.get("top_ops") or [])[:3]:
+            lines.append(
+                f"    top op: {op.get('op')} [{op.get('kind')}] "
+                f"{_fmt(op.get('total_s'))}s x{op.get('count')}")
+    for d in stats["decisions"]:
+        verdict = "ON" if d.get("overlap") else "OFF"
+        if d.get("reason"):
+            lines.append(f"  overlap gate: {verdict} ({d['reason']})")
+            continue
+        lines.append(
+            f"  overlap gate: {verdict} — A/B efficiency "
+            f"{(d.get('efficiency') or 0.0) * 100:.1f}% vs threshold "
+            f"{(d.get('threshold') or 0.0) * 100:.0f}% "
+            f"({_fmt(d.get('overlapped_rounds_per_s'))} vs "
+            f"{_fmt(d.get('lockstep_rounds_per_s'))} rounds/s over "
+            f"{d.get('calib_rounds')} calib rounds)")
+        for arm in ("lockstep", "overlapped"):
+            m = d.get(f"{arm}_overlap_efficiency_measured")
+            if m is not None:
+                lines.append(
+                    f"    {arm} arm: measured overlap {m * 100:.1f}%, "
+                    f"collective "
+                    f"{_fmt(d.get(f'{arm}_collective_s_per_round'))}s"
+                    "/round")
+    for c in stats["compiles"]:
+        static = ""
+        if c.get("static"):
+            static = " {" + ", ".join(
+                f"{k}={v}" for k, v in sorted(c["static"].items())) + "}"
+        roof = ""
+        if c.get("bytes_per_flop") is not None:
+            roof = f", {c['bytes_per_flop']:.2f} bytes/flop"
+        flops = ""
+        if c.get("flops") is not None:
+            flops = f", {c['flops']:.3g} flops"
+        lines.append(
+            f"  compile [{c.get('label')}]{static} ({c.get('phase')}): "
+            f"{_fmt(c.get('total_s'))}s{flops}{roof}")
+    if stats.get("profiler_errors"):
+        lines.append(f"  profiler errors: {stats['profiler_errors']} "
+                     "(window(s) degraded, solve unaffected)")
+    return lines
+
+
+def cert_stats(events: list[dict]) -> dict | None:
+    """Certificate-decision tallies (ISSUE 16 satellite): ACCEPT / FAIL /
+    REFUSE counts over the run's ``certificate`` events, by source, plus
+    the host-f64 REFUSE-band fallback wall — the denominator data for
+    the f32 ACCEPT-band sweep."""
+    evs = [ev for ev in events if ev.get("event") == "certificate"]
+    if not evs:
+        return None
+    tally = {"accept": 0, "fail": 0, "refuse": 0}
+    sources: dict = {}
+    f64_s = 0.0
+    for ev in evs:
+        status = "accept" if ev.get("certified") else \
+            ("fail" if ev.get("decidable") else "refuse")
+        tally[status] += 1
+        src = ev.get("source") or \
+            ("certify_sharded" if ev.get("sharded") else "device_epilogue")
+        sources[src] = sources.get(src, 0) + 1
+        if isinstance(ev.get("f64_fallback_s"), (int, float)):
+            f64_s += ev["f64_fallback_s"]
+    return {"tally": tally, "sources": sources, "total": len(evs),
+            "f64_fallback_s": f64_s}
+
+
+def _cert_lines(stats: dict | None) -> list[str]:
+    if not stats:
+        return []
+    t = stats["tally"]
+    line = (f"  certificates: {t['accept']} accept / {t['fail']} fail / "
+            f"{t['refuse']} refuse ("
+            + ", ".join(f"{k} x{n}"
+                        for k, n in sorted(stats["sources"].items()))
+            + ")")
+    lines = [line]
+    if stats["f64_fallback_s"]:
+        lines.append(f"  f64 fallback: {stats['f64_fallback_s']:.3f}s "
+                     "wall in host eigensolves (REFUSE band)")
     return lines
 
 
@@ -751,8 +908,21 @@ def render_report(run_dir: str) -> str:
                     f"/ {row.get('count', 0)} "
                     f"({row.get('avg_ms', 0.0):.2f} ms avg)")
 
-        lines.extend(_sharded_lines(sharded_stats(events)))
-        lines.extend(_serving_lines(serving_stats(events)))
+        sharded_sec = _sharded_lines(sharded_stats(events))
+        serving_sec = _serving_lines(serving_stats(events))
+        certs = _cert_lines(cert_stats(events))
+        if certs:
+            # The tallies belong to whichever plane solved: sharded
+            # section first, serving next, standalone for a plain solve.
+            if sharded_sec:
+                sharded_sec.extend(certs)
+            elif serving_sec:
+                serving_sec.extend(certs)
+            else:
+                sharded_sec = ["certificates:"] + certs
+        lines.extend(sharded_sec)
+        lines.extend(_devprof_lines(devprof_stats(events)))
+        lines.extend(serving_sec)
         lines.extend(_health_lines(events))
         lines.extend(_fleet_lines(fleet_timeline_stats(events)))
         lines.extend(_fleet_serve_lines(fleet_serve_stats(events)))
@@ -813,6 +983,8 @@ def report_data(run_dir: str) -> dict:
                                                    "blackbox_dump")]
         out["sharded"] = sharded_stats(events)
         out["serving"] = serving_stats(events)
+        out["devprof"] = devprof_stats(events)
+        out["certificates"] = cert_stats(events)
         out["fleet_timeline"] = fleet_timeline_stats(events)
         out["fleet"] = fleet_serve_stats(events)
     m_path = os.path.join(run_dir, METRICS_FILE)
@@ -851,9 +1023,25 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--live", metavar="HOST:PORT",
                     help="scrape a running serve sidecar's /statusz "
                          "(--metrics-port) and render the live status")
+    ap.add_argument("--ledger", nargs="?", const=".", metavar="ROOT",
+                    help="render the cross-round perf ledger over the "
+                         "BENCH_r*/MULTICHIP_r*/FLEET_r* records under "
+                         "ROOT (default: cwd); --json emits the LEDGER "
+                         "record tools/check_bench_floor.py validates")
     args = ap.parse_args(argv)
     if args.live:
         return live_report(args.live, json_out=args.json)
+    if args.ledger is not None:
+        from .ledger import load_ledger
+
+        ledger = load_ledger(args.ledger)
+        if not ledger.rows:
+            print(f"no bench records found under {args.ledger}",
+                  file=sys.stderr)
+            return 2
+        print(json.dumps(ledger.to_json()) if args.json
+              else ledger.render())
+        return 0
     if args.compare:
         from .regress import run_compare
 
@@ -861,7 +1049,8 @@ def main(argv: list[str] | None = None) -> int:
                            rtol=args.rtol, json_out=args.json,
                            allow_mismatch=args.allow_mismatch)
     if not args.run_dir:
-        ap.error("at least one run_dir is required (or --compare A B)")
+        ap.error("at least one run_dir is required (or --compare A B, "
+                 "or --ledger [ROOT])")
     rc = 0
     try:
         for rd in args.run_dir:

@@ -371,7 +371,12 @@ _TID_NAMES = {0: "compute", 1: "comms", 2: "solver", 3: "events",
 
 #: Events rendered as instants on the timeline.
 _INSTANT_EVENTS = ("peer_lost", "solve_start", "solve_end", "run_start",
-                   "run_end", "agent_state")
+                   "run_end", "agent_state", "overlap_decision")
+
+#: The device-attribution track (ISSUE 16): ``device_attribution``
+#: events carry window-relative XLA op slices; they render as their own
+#: process with one thread per device lane, far above the robot pids.
+_PID_DEVICE = 1000
 
 
 def _pid(robot) -> int:
@@ -452,6 +457,28 @@ def to_chrome_trace(timeline: Timeline) -> dict:
                 out.append({"name": "frame", "cat": "frame", "ph": "f",
                             "bp": "e", "id": fid, "pid": pid, "tid": tid,
                             "ts": f_ts})  # not break s<=f ordering
+        elif kind == "device_attribution":
+            # Device track: the window's XLA op slices, anchored so the
+            # window ENDS at the event's (rebased) emission stamp — the
+            # slices' t0_s are window-relative.  Alignment to host spans
+            # is as good as the stop-to-emit latency (attribution parse
+            # time), which is fine for a visual correlation track.
+            window_s = float(e.get("window_s") or 0.0)
+            anchor = float(e.get("t_mono", t_base)) - window_s
+            pids_used[_PID_DEVICE] = "device"
+            for sl in e.get("slices") or []:
+                tid = int(sl.get("lane", 0))
+                tids_used.add((_PID_DEVICE, tid))
+                out.append({
+                    "name": str(sl.get("op", "op")),
+                    "cat": str(sl.get("kind", "compute")), "ph": "X",
+                    "ts": us(anchor + float(sl.get("t0_s", 0.0))),
+                    "dur": max(round(float(sl.get("dur_s", 0.0)) * 1e6, 3),
+                               0.001),
+                    "pid": _PID_DEVICE, "tid": tid,
+                    "args": {"kind": sl.get("kind"),
+                             "label": e.get("label"),
+                             "plane": e.get("phase")}})
         elif kind in _INSTANT_EVENTS:
             pid = track(e.get("robot"), e["_stream"])
             tids_used.add((pid, 3))
@@ -469,9 +496,10 @@ def to_chrome_trace(timeline: Timeline) -> dict:
         meta.append({"name": "process_sort_index", "ph": "M", "pid": pid,
                      "args": {"sort_index": pid}})
     for pid, tid in sorted(tids_used):
+        tname = f"device lane {tid}" if pid == _PID_DEVICE \
+            else _TID_NAMES.get(tid, "events")
         meta.append({"name": "thread_name", "ph": "M", "pid": pid,
-                     "tid": tid,
-                     "args": {"name": _TID_NAMES.get(tid, "events")}})
+                     "tid": tid, "args": {"name": tname}})
 
     return {"traceEvents": meta + out, "displayTimeUnit": "ms",
             "otherData": {"clock_alignment": timeline.offsets}}

@@ -486,21 +486,30 @@ def certify_sharded(X, graph: MultiAgentGraph, mesh=None,
             vec_pa[pmask] = np.asarray(v64, np.float64)[gi[pmask]]
         return lam64, vec_pa, resid
 
+    run = obs.get_run()
+    f64_secs: list = []
+    chosen_f64 = f64_solve if global_ctx is not None else None
+    if run is not None and chosen_f64 is not None:
+        from ..models.certify import _timed_f64
+        chosen_f64 = _timed_f64(chosen_f64, f64_secs)
     certified, decidable, _, lam_f64, vec64 = decide_certificate(
         lam_min_f, sigma_f, tol, float(jnp.finfo(jnp.asarray(X).dtype).eps),
-        f64_solve if global_ctx is not None else None)
+        chosen_f64)
     if vec64 is not None:
         direction = jnp.asarray(vec64, jnp.asarray(direction).dtype)
-    run = obs.get_run()
     if run is not None:
         # Verdict timeline on the distributed path too: the staircase's
         # REFUSE loops (docs/NEXT.md) are exactly the streaks the health
         # layer flags; every scalar here was already materialized above.
         lam_used = lam_f64 if lam_f64 is not None else lam_min_f
+        from ..models.certify import _tally_cert
+        _tally_cert(run, certified, decidable, f64_secs,
+                    source="certify_sharded")
         run.event("certificate", phase="certify", sharded=True,
                   certified=certified, decidable=decidable,
                   lambda_min=lam_min_f, lambda_min_f64=lam_f64,
                   eigenvalue_gap=lam_used + tol, tol=tol, sigma=sigma_f,
+                  f64_fallback_s=sum(f64_secs) if f64_secs else None,
                   stationarity_gap=float(stat))
         from ..obs.health import monitor_for as _monitor_for
 

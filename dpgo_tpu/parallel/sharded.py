@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
 import time
 from functools import partial
 
@@ -659,6 +660,84 @@ def gn_tail_sharded(X, graph: MultiAgentGraph, meta: GraphMeta,
     return X, result
 
 
+#: Fused rounds per arm of the ``overlap="auto"`` calibration, timed
+#: repetitions per arm (best-of, alternating), and the A/B efficiency
+#: the overlapped arm must clear to win.  The threshold is deliberate
+#: hysteresis sized ABOVE the scheduling-noise band of short best-of-N
+#: walls on a shared-core mesh: measured across ~100 calibrations on the
+#: 8-virtual-device CPU mesh the A/B efficiency of two equivalent
+#: schedules wanders in roughly [-0.10, +0.18], so anything below 0.25
+#: is indistinguishable from noise there.  Pipelining's genuine win is
+#: the hidden collective fraction of the round — tens of percent on a
+#: real interconnect when it pays at all (its loss on the CPU mesh is
+#: what MULTICHIP_r06 measured at -0.05) — so the gate only flips to
+#: overlapped on a decisive, better-than-noise win and resolves
+#: everything else to the simpler lockstep schedule.
+_AUTO_CALIB_ROUNDS = 8
+_AUTO_CALIB_REPS = 3
+_AUTO_THRESHOLD = 0.25
+
+
+def _resolve_overlap_auto(mesh, state, graph, meta, params, exchange,
+                          calib_rounds: int = _AUTO_CALIB_ROUNDS) -> bool:
+    """The adaptive overlap gate: a bounded lockstep-vs-overlapped
+    calibration on the real sharded problem, arbitrated by
+    ``obs.devprof.decide_overlap``.
+
+    Each arm compiles its fused multi-round program, warms it (paying the
+    compile outside the timed window), then times ``calib_rounds``-round
+    segments to a ``jax.block_until_ready`` fence — alternating arms,
+    best of ``_AUTO_CALIB_REPS``, with NO profiler active: trace capture
+    slows the traced
+    program, so the decision walls stay clean.  With telemetry on, one
+    additional segment per arm then runs under a ``DeviceTraceWindow``
+    so the ``overlap_decision`` event carries the measured device-time
+    evidence (collective/compute split, measured overlap efficiency)
+    next to the A/B walls.  Calibration segments are pure functions of
+    the sharded state and are discarded — the solve proper starts from
+    the untouched initial state, so forced ``overlap=True/False`` modes
+    remain bitwise references."""
+    from ..obs import devprof
+
+    run = obs.get_run()
+    size = int(mesh.devices.size)
+    if size == 1:
+        # No collectives to hide on one device — nothing to calibrate.
+        if run is not None:
+            run.event("overlap_decision", phase="setup", mesh_size=size,
+                      exchange=exchange, overlap=False,
+                      reason="single_device_mesh", calib_rounds=0)
+        return False
+    shifts, plan = _exchange_plan(mesh, meta, graph, exchange)
+    names = ("lockstep", "overlapped")
+    multis = {}
+    arms = {}
+    for name, ov in zip(names, (False, True)):
+        multis[name] = make_sharded_multi_step(mesh, meta, params, shifts,
+                                               plan, overlap=ov)
+        devprof.time_arm(multis[name], state, graph,
+                         calib_rounds)  # compile + warm
+        arms[name] = {"seconds": float("inf"), "rounds": calib_rounds,
+                      "attribution": None}
+    for _rep in range(_AUTO_CALIB_REPS):
+        for name in names:
+            dt = devprof.time_arm(multis[name], state, graph, calib_rounds)
+            arms[name]["seconds"] = min(arms[name]["seconds"], dt)
+    if run is not None:
+        for name in names:
+            window = devprof.DeviceTraceWindow(
+                os.path.join(run.run_dir, f"devprof_auto_{name}"),
+                plane="sharded").start()
+            devprof.time_arm(multis[name], state, graph, calib_rounds)
+            arms[name]["attribution"] = window.stop(
+                num_rounds=calib_rounds, label=f"auto_{name}")
+    decision = devprof.decide_overlap(arms, threshold=_AUTO_THRESHOLD)
+    if run is not None:
+        run.event("overlap_decision", phase="setup", mesh_size=size,
+                  exchange=exchange, **decision)
+    return bool(decision["overlap"])
+
+
 def solve_rbcd_sharded(
     meas: Measurements,
     num_robots: int,
@@ -672,7 +751,7 @@ def solve_rbcd_sharded(
     init: str = "chordal",
     exchange: str = "all_gather",
     verdict_every: int | None = None,
-    overlap: bool = True,
+    overlap: "bool | str" = True,
     gn_tail: "refine.GNTailConfig | None" = None,
     resilience: "resilience_mod.ResilienceConfig | None" = None,
 ) -> rbcd.RBCDResult:
@@ -692,7 +771,13 @@ def solve_rbcd_sharded(
     same ``rbcd._host_fetch`` seam as the single-device loop — killing the
     per-eval readback on the mesh path too.  ``overlap`` (default on)
     software-pipelines the halo exchange inside the fused round loops
-    (``make_sharded_multi_step``).  ``gn_tail`` (a ``refine.GNTailConfig``)
+    (``make_sharded_multi_step``); ``overlap="auto"`` runs a bounded
+    lockstep-vs-overlapped calibration on the sharded problem
+    (``_resolve_overlap_auto``) and picks the winner, recording an
+    ``overlap_decision`` event with the A/B walls and — with telemetry on
+    — the measured device-time attribution as evidence.  Forced
+    ``overlap=True/False`` stay bitwise-unchanged reference modes.
+    ``gn_tail`` (a ``refine.GNTailConfig``)
     appends the sharded device-resident Gauss-Newton-CG polish
     (``gn_tail_sharded``) after the BCD loop, extending the returned
     histories with the tail's trajectory and re-finalizing the rounded
@@ -755,6 +840,15 @@ def solve_rbcd_sharded(
         timer.stop("shard")
         run.event("phase_timings", phase="setup", timings=timer.as_dict())
 
+    if overlap == "auto":
+        # Adaptive overlap gate (ISSUE 16): decide pipelining from a
+        # measured A/B on this mesh/problem, not a hand-set flag.
+        overlap = _resolve_overlap_auto(mesh, state, graph, meta, params,
+                                        exchange)
+    elif not isinstance(overlap, bool):
+        raise ValueError(
+            f"overlap={overlap!r}: expected True, False, or 'auto'")
+
     n_total = part.meas_global.num_poses
     num_meas = len(part.meas_global)
     certify_mode = getattr(params, "certify_mode", "off")
@@ -774,6 +868,29 @@ def solve_rbcd_sharded(
                                                 plan, overlap=overlap)
         sharded_seg = make_sharded_segment(mesh_a, meta, params, shifts,
                                            plan, overlap=overlap)
+        if run is not None:
+            # Compile accounting with the bytes-per-flop roofline for
+            # every hot sharded program (devprof.profiled_program AOT-
+            # compiles once per static combo — same compile count as the
+            # plain jit path — and falls back to it on any probe
+            # failure).  Fence-guarded: telemetry off keeps the bare jit
+            # callables.
+            from ..obs import devprof
+
+            sharded_step = devprof.profiled_program(
+                run, sharded_step, key=f"sharded/{size_a}/step",
+                label="sharded_step", plane="sharded",
+                static_names=("update_weights", "restart"),
+                mesh_size=size_a)
+            sharded_multi = devprof.profiled_program(
+                run, sharded_multi, key=f"sharded/{size_a}/multi_step",
+                label="sharded_multi_step", plane="sharded",
+                mesh_size=size_a)
+            sharded_seg = devprof.profiled_program(
+                run, sharded_seg, key=f"sharded/{size_a}/segment",
+                label="sharded_segment", plane="sharded",
+                static_names=("update_weights", "restart"),
+                mesh_size=size_a)
         if injector is not None:
             # Chaos seam (parallel.resilience): the injector counts
             # dispatched rounds and may poison a seeded public pose —

@@ -159,6 +159,13 @@ SLOW_TESTS = {
     "test_double_device_loss_reshards_8_4_2",
     "test_resilience_sync_rate_unchanged",
     "test_hung_fetch_watchdog_rewind",
+    # ISSUE 16: the device-profiling acceptance tests compile both
+    # overlap arms (auto-gate calibration) and/or profiled shard_map
+    # programs on the virtual mesh — CI's `profiling` job runs them.
+    "test_sharded_overlap_auto_gates_off_with_evidence",
+    "test_overlap_auto_single_device_shortcut",
+    "test_profiled_sharded_run_merged_trace_device_track",
+    "test_telemetry_off_devprof_is_fenced",
 }
 
 

@@ -1,0 +1,301 @@
+"""Device-time attribution (ISSUE 16): XLA profile ingestion, the
+measured overlap split, the adaptive overlap gate, and the merged
+timeline's device track.
+
+The attribution unit tests run on hand-built Chrome trace events so the
+interval algebra is pinned exactly (container nesting, cross-lane
+hiding, leaf-only op tables); the integration tests drive the real
+``jax.profiler`` on the virtual CPU mesh — same plumbing the TPU path
+uses, with the attribution numbers treated as shapes, not truths.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dpgo_tpu import obs
+from dpgo_tpu.config import AgentParams
+from dpgo_tpu.models import rbcd
+from dpgo_tpu.obs import devprof, timeline
+from dpgo_tpu.obs.events import read_events
+from dpgo_tpu.parallel import make_mesh, solve_rbcd_sharded
+
+from synthetic import make_measurements
+
+
+def _dev_event(op, ts, dur, tid, pid=0):
+    return {"ph": "X", "pid": pid, "tid": tid, "ts": ts, "dur": dur,
+            "name": op, "args": {"hlo_op": op}}
+
+
+def test_classify_op_prefix_tables():
+    for op in ("all-gather.1", "all-reduce-start.2", "reduce-scatter.3",
+               "collective-permute.4", "ppermute", "All-Reduce.5"):
+        assert devprof.classify_op(op) == "collective", op
+    for op in ("fusion.1", "while.2", "dot.3", "custom-call.4", "copy.5"):
+        assert devprof.classify_op(op) == "compute", op
+
+
+def test_attribute_trace_containers_and_cross_lane_hiding():
+    """The pinned scenario: lane A's ``while`` container encloses a
+    40 us fusion and a 60 us all-reduce; lane B computes for 80 us.
+    Interval algebra must not double-count the container, compute is
+    busy-minus-collective, and the hidden fraction is the all-reduce
+    time concurrent with lane B's compute ([40, 80) of [40, 100))."""
+    events = [
+        _dev_event("while.9", 0.0, 100.0, tid=1),
+        _dev_event("fusion.1", 0.0, 40.0, tid=1),
+        _dev_event("all-reduce.3", 40.0, 60.0, tid=1),
+        _dev_event("fusion.2", 0.0, 80.0, tid=2),
+        {"ph": "X", "pid": 0, "tid": 3, "ts": 0, "dur": 500,
+         "name": "host_thing", "args": {}},   # no hlo_op: not a device op
+        {"ph": "i", "pid": 0, "tid": 1, "ts": 5, "name": "marker",
+         "args": {"hlo_op": "x"}},            # not an X slice
+    ]
+    att = devprof.attribute_trace(events, num_rounds=2)
+    assert att["lanes"] == 2
+    assert att["window_s"] == pytest.approx(100e-6)
+    assert att["compute_s"] == pytest.approx(120e-6)      # 40 + 80, no 100
+    assert att["collective_s"] == pytest.approx(60e-6)
+    assert att["idle_s"] == pytest.approx(20e-6)          # 2*100 - 180
+    assert att["collective_hidden_s"] == pytest.approx(40e-6)
+    assert att["overlap_efficiency_measured"] == pytest.approx(2.0 / 3.0)
+    assert att["per_round"]["compute_s"] == pytest.approx(60e-6)
+    assert att["per_round"]["collective_s"] == pytest.approx(30e-6)
+    # top_ops is leaf-only (no `while` container) and merges op families.
+    tops = {t["op"]: t for t in att["top_ops"]}
+    assert "while" not in tops
+    assert tops["fusion"]["total_s"] == pytest.approx(120e-6)
+    assert tops["fusion"]["count"] == 2
+    assert tops["all-reduce"]["kind"] == "collective"
+    # Slices are leaf-only, window-relative, lane-indexed.
+    assert {s["op"] for s in att["slices"]} == \
+        {"fusion.1", "all-reduce.3", "fusion.2"}
+    ar = next(s for s in att["slices"] if s["op"] == "all-reduce.3")
+    assert ar["lane"] == 0 and ar["t0_s"] == pytest.approx(40e-6)
+    assert ar["kind"] == "collective"
+
+
+def test_attribute_trace_no_device_ops_is_zeroed():
+    att = devprof.attribute_trace(
+        [{"ph": "X", "pid": 0, "tid": 0, "ts": 0, "dur": 5,
+          "name": "host", "args": {}}], num_rounds=4)
+    assert att["lanes"] == 0
+    assert att["compute_s"] == att["collective_s"] == att["idle_s"] == 0.0
+    assert att["overlap_efficiency_measured"] == 0.0
+    assert att["slices"] == [] and att["top_ops"] == []
+
+
+def test_decide_overlap_hysteresis_and_evidence():
+    """The arbiter: overlap wins only when its A/B efficiency clears the
+    threshold; the record carries both walls, both rates, and (when
+    present) each arm's measured attribution evidence."""
+    att = {"overlap_efficiency_measured": 0.4,
+           "per_round": {"collective_s": 2e-3, "compute_s": 5e-3}}
+    arms = {"lockstep": {"seconds": 1.0, "rounds": 8, "attribution": att},
+            "overlapped": {"seconds": 0.90, "rounds": 8}}
+    rec = devprof.decide_overlap(arms, threshold=0.05)
+    assert rec["overlap"] is True
+    assert rec["efficiency"] == pytest.approx(0.10)
+    assert rec["lockstep_seconds"] == 1.0
+    assert rec["overlapped_rounds_per_s"] == pytest.approx(8 / 0.90)
+    assert rec["lockstep_overlap_efficiency_measured"] == 0.4
+    assert rec["lockstep_collective_s_per_round"] == pytest.approx(2e-3)
+    assert "overlapped_overlap_efficiency_measured" not in rec
+    # Inside the hysteresis band the simpler lockstep schedule wins.
+    arms["overlapped"]["seconds"] = 0.97
+    rec = devprof.decide_overlap(arms, threshold=0.05)
+    assert rec["overlap"] is False
+    assert rec["efficiency"] == pytest.approx(0.03)
+    # Overlap slower than lockstep: clearly off.
+    arms["overlapped"]["seconds"] = 1.2
+    assert devprof.decide_overlap(arms, threshold=0.0)["overlap"] is False
+
+
+def test_device_trace_window_emits_attribution_event(tmp_path):
+    """A real profiler window around a jitted program yields a
+    schema-complete ``device_attribution`` event and the measured-
+    efficiency gauge — the CI profiling smoke's core assertion."""
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.eye(96, dtype=jnp.float32)
+    jax.block_until_ready(f(x))  # compile outside the window
+    run_dir = str(tmp_path / "run")
+    with obs.run_scope(run_dir):
+        win = devprof.DeviceTraceWindow(
+            str(tmp_path / "prof"), plane="solve").start()
+        for _ in range(3):
+            jax.block_until_ready(f(x))
+        att = win.stop(num_rounds=3, label="unit_matmul")
+        gauge = obs.get_run().gauge("device_overlap_efficiency_measured")
+        assert gauge.value(label="unit_matmul") == pytest.approx(
+            att["overlap_efficiency_measured"])
+    assert att is not None and att["lanes"] >= 1
+    assert att["compute_s"] > 0.0
+    evs = [e for e in read_events(f"{run_dir}/events.jsonl")
+           if e.get("event") == "device_attribution"]
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["phase"] == "solve" and ev["label"] == "unit_matmul"
+    for key in ("lanes", "num_rounds", "window_s", "compute_s",
+                "collective_s", "idle_s", "per_round",
+                "collective_hidden_s", "overlap_efficiency_measured",
+                "top_ops", "slices", "trace_files", "profile_dir"):
+        assert key in ev, key
+    assert ev["num_rounds"] == 3 and ev["trace_files"] >= 1
+    assert ev["slices"] and all(
+        {"lane", "op", "kind", "t0_s", "dur_s"} <= set(s) for s in
+        ev["slices"])
+
+
+def test_device_trace_window_without_run_emits_nothing(tmp_path):
+    """Outside a run the window still attributes (bench.py's opt-in
+    path) but emits no event and survives a double stop/close."""
+    f = jax.jit(lambda x: x * 2.0)
+    jax.block_until_ready(f(jnp.ones(8)))
+    win = devprof.DeviceTraceWindow(str(tmp_path / "p"), plane="solve")
+    win.start()
+    jax.block_until_ready(f(jnp.ones(8)))
+    att = win.stop(num_rounds=1)
+    assert att is None or att["lanes"] >= 0
+    assert win.stop() is None          # already stopped: no-op
+    win.close()                        # idempotent
+
+
+def _noisy(rng, n=48, num_lc=14):
+    meas, _ = make_measurements(rng, n=n, d=3, num_lc=num_lc,
+                                rot_noise=0.01, trans_noise=0.01)
+    return meas
+
+
+def test_sharded_overlap_auto_gates_off_with_evidence(rng, tmp_path):
+    """ISSUE 16 acceptance: on the shared-core CPU mesh the adaptive
+    gate turns overlap OFF (there is no interconnect to hide behind, and
+    MULTICHIP_r06 measured the pipelined schedule as a net loss), records
+    an ``overlap_decision`` event carrying the A/B walls plus per-arm
+    measured attribution, and the solve proper is BITWISE the forced
+    ``overlap=False`` solve — calibration segments are discarded."""
+    meas = _noisy(rng)
+    params = AgentParams(d=3, r=5, num_robots=4, rel_change_tol=0.0)
+    run_dir = str(tmp_path / "run")
+    with obs.run_scope(run_dir):
+        res_auto = solve_rbcd_sharded(meas, 4, mesh=make_mesh(4),
+                                      params=params, max_iters=8,
+                                      grad_norm_tol=0.0, eval_every=4,
+                                      overlap="auto")
+    events = read_events(f"{run_dir}/events.jsonl")
+    decisions = [e for e in events if e.get("event") == "overlap_decision"]
+    assert len(decisions) == 1
+    dec = decisions[0]
+    assert dec["phase"] == "setup" and dec["mesh_size"] == 4
+    assert dec["overlap"] is False
+    for key in ("efficiency", "threshold", "lockstep_seconds",
+                "overlapped_seconds", "lockstep_rounds_per_s",
+                "overlapped_rounds_per_s", "calib_rounds"):
+        assert key in dec, key
+    from dpgo_tpu.parallel.sharded import _AUTO_THRESHOLD
+    assert dec["threshold"] == pytest.approx(_AUTO_THRESHOLD)
+    # Telemetry was on, so the decision carries measured evidence and the
+    # evidence windows emitted their own attribution events.
+    assert "lockstep_overlap_efficiency_measured" in dec
+    assert "overlapped_collective_s_per_round" in dec
+    labels = {e.get("label") for e in events
+              if e.get("event") == "device_attribution"}
+    assert {"auto_lockstep", "auto_overlapped"} <= labels
+    # The setup event reflects the gated schedule.
+    setup = [e for e in events if e.get("event") == "sharded_solve"]
+    assert setup and setup[0]["overlap"] is False
+    # Bitwise parity with the forced reference mode the gate picked.
+    res_off = solve_rbcd_sharded(meas, 4, mesh=make_mesh(4), params=params,
+                                 max_iters=8, grad_norm_tol=0.0,
+                                 eval_every=4, overlap=False)
+    assert res_auto.cost_history == res_off.cost_history
+    np.testing.assert_array_equal(np.asarray(res_auto.T),
+                                  np.asarray(res_off.T))
+
+
+def test_overlap_auto_single_device_shortcut(rng, tmp_path):
+    """A 1-device mesh has no collectives to hide: the gate resolves to
+    lockstep without calibrating and says why."""
+    meas = _noisy(rng, n=24, num_lc=6)
+    params = AgentParams(d=3, r=5, num_robots=2, rel_change_tol=0.0)
+    run_dir = str(tmp_path / "run")
+    with obs.run_scope(run_dir):
+        solve_rbcd_sharded(meas, 2, mesh=make_mesh(1), params=params,
+                           max_iters=4, grad_norm_tol=0.0, eval_every=2,
+                           overlap="auto")
+    decisions = [e for e in read_events(f"{run_dir}/events.jsonl")
+                 if e.get("event") == "overlap_decision"]
+    assert len(decisions) == 1
+    assert decisions[0]["overlap"] is False
+    assert decisions[0]["reason"] == "single_device_mesh"
+    assert decisions[0]["calib_rounds"] == 0
+
+
+def test_profiled_sharded_run_merged_trace_device_track(rng, tmp_path):
+    """Satellite: a profiled 2-shard run merges into a schema-valid
+    Chrome trace carrying BOTH host spans and device attribution slices,
+    the latter on their own `device` process track (pid 1000) with
+    per-lane threads."""
+    meas = _noisy(rng, n=32, num_lc=8)
+    params = AgentParams(d=3, r=5, num_robots=2, rel_change_tol=0.0)
+    run_dir = str(tmp_path / "run")
+    with obs.run_scope(run_dir):
+        solve_rbcd_sharded(meas, 2, mesh=make_mesh(2), params=params,
+                           max_iters=8, grad_norm_tol=0.0, eval_every=4,
+                           overlap="auto")
+    tl = timeline.merge([run_dir])
+    trace_path = timeline.write_chrome_trace(
+        str(tmp_path / "trace.json"), tl)
+    counts = timeline.validate_chrome_trace(trace_path)
+    assert counts["spans"] > 0
+    with open(trace_path) as fh:
+        obj = json.load(fh)
+    evs = obj["traceEvents"]
+    device_slices = [e for e in evs
+                     if e.get("ph") == "X" and e.get("pid") == 1000]
+    host_spans = [e for e in evs
+                  if e.get("ph") == "X" and e.get("pid") != 1000]
+    assert device_slices, "no device attribution slices on the trace"
+    assert host_spans, "no host spans on the trace"
+    assert all(e["args"].get("kind") in ("compute", "collective")
+               for e in device_slices)
+    # The device track is named, with one thread per lane.
+    procs = {e["pid"]: e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert procs.get(1000) == "device"
+    lane_names = {e["args"]["name"] for e in evs
+                  if e.get("ph") == "M" and e.get("name") == "thread_name"
+                  and e.get("pid") == 1000}
+    assert lane_names and all(n.startswith("device lane ")
+                              for n in lane_names)
+    # The overlap decision renders as an instant on the host timeline.
+    assert any(e.get("ph") == "i" and e.get("name") == "overlap_decision"
+               for e in evs)
+
+
+def test_telemetry_off_devprof_is_fenced(monkeypatch, rng):
+    """Zero-overhead extension (ISSUE 16): with no ambient run, the
+    sharded solve — including ``overlap="auto"`` — constructs no
+    DeviceTraceWindow, no PerfLedger, and never calls the profiled-
+    program prober; the gate still calibrates (clean host timing is not
+    telemetry) and returns a working solve."""
+    from dpgo_tpu.obs import ledger as ledger_mod
+
+    def boom(*a, **kw):
+        raise AssertionError("devprof telemetry path taken while disabled")
+
+    monkeypatch.setattr(devprof.DeviceTraceWindow, "__init__", boom)
+    monkeypatch.setattr(devprof, "profiled_program", boom)
+    monkeypatch.setattr(devprof, "attribute_profile_dir", boom)
+    monkeypatch.setattr(ledger_mod.PerfLedger, "__init__", boom)
+
+    assert obs.get_run() is None
+    meas = _noisy(rng, n=24, num_lc=6)
+    params = AgentParams(d=3, r=5, num_robots=2, rel_change_tol=0.0)
+    res = solve_rbcd_sharded(meas, 2, mesh=make_mesh(2), params=params,
+                             max_iters=4, grad_norm_tol=0.0, eval_every=2,
+                             overlap="auto")
+    assert res.iterations > 0 and res.cost_history

@@ -1,0 +1,229 @@
+"""The cross-round perf ledger (ISSUE 16): record discovery and
+normalization across the three bench families, the rendered/JSON forms,
+the ``check_bench_floor`` schema gate, and the regress trend gate that
+catches cross-round slides the pairwise compare never sees."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from dpgo_tpu.obs import regress
+from dpgo_tpu.obs.ledger import PerfLedger, discover_records, load_ledger
+
+
+def _write(d, name, obj):
+    p = d / name
+    p.write_text(json.dumps(obj))
+    return str(p)
+
+
+def _bench(value, vs_baseline, rc=0, parity=None):
+    parsed = {"metric": "rbcd_rounds_per_sec", "value": value,
+              "unit": "rounds/s", "vs_baseline": vs_baseline,
+              "cpu_arm_band": {"min": 20.0, "max": 30.0}}
+    if parity is not None:
+        parsed["kernel_parity_max_abs_diff"] = parity
+    return {"n": 1, "cmd": "python bench.py", "rc": rc, "tail": "",
+            "parsed": parsed}
+
+
+def _multichip(value, overlap_eff=None, syncs=None):
+    rec = {"record": "MULTICHIP", "ok": True, "n_devices": 8,
+           "metric": "sharded_rounds_per_sec", "value": value,
+           "unit": "rounds/s", "verdict_every": 8}
+    if overlap_eff is not None:
+        rec["overlap"] = {"efficiency": overlap_eff}
+    if syncs is not None:
+        rec["host_syncs_per_100_rounds"] = syncs
+    return rec
+
+
+def _fixture_root(tmp_path):
+    d = tmp_path / "records"
+    d.mkdir()
+    _write(d, "BENCH_r01.json", _bench(100.0, 3.0))
+    _write(d, "BENCH_r02.json", _bench(110.0, 3.2, parity=3e-5))
+    _write(d, "BENCH_r03.json", _bench(120.0, 3.5, parity=2e-5))
+    # Placeholder round (pre-metric era) and a genuine failed run.
+    _write(d, "MULTICHIP_r01.json",
+           {"n_devices": 0, "ok": False, "rc": 1, "skipped": False,
+            "tail": "no devices"})
+    _write(d, "MULTICHIP_r02.json", _multichip(40.0, overlap_eff=-0.05,
+                                               syncs=25.0))
+    _write(d, "MULTICHIP_r03.json", _multichip(44.0, overlap_eff=-0.03,
+                                               syncs=25.0))
+    _write(d, "FLEET_r01.json",
+           {"ok": True, "qps": [{"replicas": 1, "qps": 5.0},
+                                {"replicas": 2, "qps": 9.0}],
+            "scaling_1_to_2": 1.8,
+            "cold_start": {"compile_seconds_total": 30.0}})
+    _write(d, "NOT_A_RECORD.json", {"x": 1})
+    (d / "BENCH_notes.txt").write_text("ignored")
+    return d
+
+
+def test_discover_records_families_and_order(tmp_path):
+    d = _fixture_root(tmp_path)
+    found = discover_records(str(d))
+    assert [(f, r) for f, r, _ in found] == [
+        ("BENCH", 1), ("BENCH", 2), ("BENCH", 3),
+        ("FLEET", 1),
+        ("MULTICHIP", 1), ("MULTICHIP", 2), ("MULTICHIP", 3)]
+
+
+def test_load_ledger_normalizes_all_families(tmp_path):
+    d = _fixture_root(tmp_path)
+    led = load_ledger(str(d))
+    assert led.families() == ["BENCH", "FLEET", "MULTICHIP"]
+    assert len(led.rows) == 7
+    b = led.family_rows("BENCH")
+    assert all(r["ok"] for r in b)
+    assert [r["value"] for r in b] == [100.0, 110.0, 120.0]
+    assert b[1]["extras"]["kernel_parity_max_abs_diff"] == 3e-5
+    assert b[0]["extras"]["band_min"] == 20.0
+    m = led.family_rows("MULTICHIP")
+    # r01 is an honest placeholder: present, failed, metric-less.
+    assert m[0]["ok"] is False and m[0]["value"] is None
+    assert m[1]["extras"]["overlap_efficiency"] == -0.05
+    f = led.family_rows("FLEET")
+    assert f[0]["value"] == 9.0          # widest replica arm's QPS
+    assert f[0]["extras"]["replicas"] == 2
+    assert f[0]["extras"]["scaling_1_to_2"] == 1.8
+    # Series skip placeholders.
+    assert led.series("MULTICHIP") == [(2, 40.0), (3, 44.0)]
+    assert led.series("BENCH", "vs_baseline") == \
+        [(1, 3.0), (2, 3.2), (3, 3.5)]
+
+
+def test_load_ledger_corrupt_file_becomes_failed_row(tmp_path):
+    d = tmp_path / "r"
+    d.mkdir()
+    (d / "BENCH_r01.json").write_text("{not json")
+    led = load_ledger(str(d))
+    assert len(led.rows) == 1
+    assert led.rows[0]["ok"] is False
+    assert "error" in led.rows[0]["extras"]
+
+
+def test_render_and_json_forms(tmp_path):
+    d = _fixture_root(tmp_path)
+    led = load_ledger(str(d))
+    txt = led.render()
+    assert "perf ledger: 7 rounds across 3 families" in txt
+    assert "[BENCH] (3 rounds)" in txt and "[MULTICHIP] (3 rounds)" in txt
+    assert "FAIL" in txt                      # MULTICHIP r01 shown honestly
+    assert "trend value:" in txt and "vs_baseline" in txt
+    obj = led.to_json()
+    assert obj["record"] == "LEDGER" and obj["rounds"] == 7
+    assert obj["families"] == ["BENCH", "FLEET", "MULTICHIP"]
+    json.dumps(obj)                           # fully serializable
+
+
+def test_check_bench_floor_validates_ledger_schema(tmp_path):
+    from tools import check_bench_floor
+
+    d = _fixture_root(tmp_path)
+    obj = load_ledger(str(d)).to_json()
+    check_bench_floor.check_ledger(obj)       # clean: no raise
+    # Schema violations the gate must catch.
+    bad = json.loads(json.dumps(obj))
+    bad["rows"][0].pop("extras")
+    with pytest.raises(SystemExit):
+        check_bench_floor.check_ledger(bad)
+    bad = json.loads(json.dumps(obj))
+    bad["rows"][0]["family"] = "WAT"
+    with pytest.raises(SystemExit):
+        check_bench_floor.check_ledger(bad)
+    bad = json.loads(json.dumps(obj))
+    bad["rounds"] = 99
+    with pytest.raises(SystemExit):
+        check_bench_floor.check_ledger(bad)
+
+
+def test_trend_gate_passes_monotone_history(tmp_path):
+    d = _fixture_root(tmp_path)
+    gate = regress.trend_gate(load_ledger(str(d)))
+    assert gate["rc"] == 0 and gate["regressions"] == []
+    # Every declared series with >= 2 readings got gated.
+    assert "BENCH:value" in gate["trends"]
+    assert "MULTICHIP:overlap_efficiency" in gate["trends"]
+    txt = regress.render_trend(gate)
+    assert "no trend regression" in txt
+
+
+def test_trend_gate_catches_slide_and_failed_latest_round(tmp_path):
+    d = _fixture_root(tmp_path)
+    # A slide: the new round is >10% below the prior band min.
+    _write(d, "BENCH_r04.json", _bench(80.0, 2.0))
+    gate = regress.trend_gate(load_ledger(str(d)))
+    assert gate["rc"] == 2
+    assert "BENCH:value" in gate["regressions"]
+    assert "BENCH:vs_baseline" in gate["regressions"]
+    assert "below prior band min" in \
+        gate["trends"]["BENCH:value"]["reason"]
+    # A latest round that failed outright regresses regardless of values.
+    _write(d, "MULTICHIP_r04.json",
+           {"n_devices": 8, "ok": False, "rc": 1, "skipped": False,
+            "tail": "crash"})
+    gate = regress.trend_gate(load_ledger(str(d)))
+    assert "MULTICHIP:ok" in gate["regressions"]
+    assert "ok=false" in gate["trends"]["MULTICHIP:ok"]["reason"]
+    txt = regress.render_trend(gate)
+    assert "TREND REGRESSION" in txt
+
+
+def test_trend_gate_direction_lower_is_better(tmp_path):
+    d = tmp_path / "r"
+    d.mkdir()
+    _write(d, "BENCH_r01.json", _bench(100.0, 3.0, parity=1e-5))
+    _write(d, "BENCH_r02.json", _bench(101.0, 3.0, parity=1e-5))
+    _write(d, "BENCH_r03.json", _bench(102.0, 3.0, parity=9e-5))
+    gate = regress.trend_gate(load_ledger(str(d)))
+    assert "BENCH:kernel_parity_max_abs_diff" in gate["regressions"]
+    assert "above prior band max" in \
+        gate["trends"]["BENCH:kernel_parity_max_abs_diff"]["reason"]
+
+
+def test_checked_in_records_cover_every_round_and_gate_clean():
+    """ISSUE 16 acceptance: the REAL repo records all load — every
+    BENCH_r*/MULTICHIP_r* file becomes a row — the machine form passes
+    the schema gate, and today's history carries no trend regression."""
+    from tools import check_bench_floor
+
+    led = load_ledger("/root/repo")
+    names = {(r["family"], r["round"]) for r in led.rows}
+    import glob as _glob
+    import re as _re
+    on_disk = set()
+    for p in _glob.glob("/root/repo/*.json"):
+        m = _re.match(r"^(BENCH|MULTICHIP|FLEET)_r(\d+)\.json$",
+                      p.rsplit("/", 1)[1])
+        if m:
+            on_disk.add((m.group(1), int(m.group(2))))
+    assert on_disk and names == on_disk
+    check_bench_floor.check_ledger(led.to_json())
+    assert regress.trend_gate(led)["rc"] == 0
+
+
+def test_report_ledger_cli_roundtrip(tmp_path):
+    """``report --ledger ROOT`` renders the table (and ``--json`` emits
+    the machine form check_bench_floor validates); ``regress --ledger``
+    returns the gate's exit code."""
+    d = _fixture_root(tmp_path)
+    env_cmd = [sys.executable, "-m", "dpgo_tpu.obs.report",
+               "--ledger", str(d)]
+    out = subprocess.run(env_cmd, capture_output=True, text=True,
+                         timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "perf ledger" in out.stdout
+    out = subprocess.run(env_cmd + ["--json"], capture_output=True,
+                         text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    obj = json.loads(out.stdout)
+    assert obj["record"] == "LEDGER"
+    # The regress CLI gates the same root.
+    assert regress.run_trend(str(d)) == 0
+    _write(d, "BENCH_r04.json", _bench(10.0, 0.5))
+    assert regress.run_trend(str(d)) == 2

@@ -451,6 +451,12 @@ def test_telemetry_off_is_zero_overhead(monkeypatch):
     monkeypatch.setattr(health_mod.HealthMonitor, "observe_solver", boom)
     monkeypatch.setattr(recorder_mod.FlightRecorder, "__init__", boom)
     monkeypatch.setattr(recorder_mod.FlightRecorder, "record_eval", boom)
+    # ISSUE 16: the device-profiling layer sits behind the same fence.
+    from dpgo_tpu.obs import devprof as devprof_mod
+    from dpgo_tpu.obs import ledger as ledger_mod
+    monkeypatch.setattr(devprof_mod.DeviceTraceWindow, "__init__", boom)
+    monkeypatch.setattr(devprof_mod, "profiled_program", boom)
+    monkeypatch.setattr(ledger_mod.PerfLedger, "__init__", boom)
 
     assert obs.get_run() is None
     meas = _tiny_problem()

@@ -46,6 +46,12 @@ autoscaling at least once, and the cold-start arm served its warm first
 solve with serve_compile_seconds_total exactly 0 (disk hits only — XLA
 never ran on the restarted replica).
 
+For a perf-ledger record (``record == "LEDGER"``; the ``report
+--ledger --json`` output, ISSUE 16): every row carries the normalized
+schema (family/round/file/ok/metric/value/unit/extras), rounds ascend
+without duplicates within each family, and at least one round produced
+a real reading (a ledger of nothing but placeholders is a wiring bug).
+
 Exit 0 on pass, 1 on any violation, 2 on an unreadable record.
 """
 from __future__ import annotations
@@ -165,6 +171,56 @@ def check_multichip(rec: dict) -> None:
              if rz and not rz.get("skipped") else "") + ")")
 
 
+def check_ledger(rec: dict) -> None:
+    """LEDGER-record schema gate (``report --ledger --json`` output,
+    ISSUE 16): every row is well-formed, rounds ascend without
+    duplicates within each family, and the table is not all
+    placeholders — at least one round produced a real reading."""
+    for key in ("root", "rounds", "families", "rows"):
+        if key not in rec:
+            fail(f"LEDGER record missing {key!r}: {sorted(rec)}")
+    rows = rec["rows"]
+    if not (isinstance(rows, list) and rows):
+        fail("empty ledger: no BENCH_r*/MULTICHIP_r*/FLEET_r* rows")
+    if rec["rounds"] != len(rows):
+        fail(f"rounds={rec['rounds']!r} != len(rows)={len(rows)}")
+    families = rec["families"]
+    prev: dict = {}
+    readings = 0
+    for row in rows:
+        for key in ("family", "round", "file", "ok", "metric", "value",
+                    "unit", "extras"):
+            if key not in row:
+                fail(f"ledger row missing {key!r}: {sorted(row)}")
+        fam = row["family"]
+        if fam not in ("BENCH", "MULTICHIP", "FLEET"):
+            fail(f"unknown ledger family {fam!r}")
+        if fam not in families:
+            fail(f"row family {fam!r} absent from families {families}")
+        if not (isinstance(row["round"], int) and row["round"] >= 1):
+            fail(f"bad round {row['round']!r} in {row['file']!r}")
+        if row["round"] <= prev.get(fam, 0):
+            fail(f"{fam} rounds must ascend without duplicates: "
+                 f"r{row['round']} after r{prev[fam]}")
+        prev[fam] = row["round"]
+        if not isinstance(row["ok"], bool):
+            fail(f"non-boolean ok {row['ok']!r} in {row['file']!r}")
+        if not isinstance(row["extras"], dict):
+            fail(f"non-dict extras in {row['file']!r}")
+        if row["value"] is not None:
+            if not _num(row["value"]):
+                fail(f"non-numeric value {row['value']!r} in "
+                     f"{row['file']!r}")
+            if not isinstance(row["metric"], str) or not row["metric"]:
+                fail(f"row with a value but no metric name: "
+                     f"{row['file']!r}")
+            readings += 1
+    if readings < 1:
+        fail("ledger has rows but zero real readings (all placeholders)")
+    print(f"bench floor gate: PASS — LEDGER ok ({len(rows)} rounds, "
+          f"{readings} readings across {', '.join(families)})")
+
+
 def check_fleet(rec: dict) -> None:
     """FLEET-record schema + scaling/chaos/cold-start gate
     (``bench_fleet.py`` output)."""
@@ -269,6 +325,10 @@ def main() -> None:
 
     if rec.get("record") == "FLEET":
         check_fleet(rec)
+        return
+
+    if rec.get("record") == "LEDGER":
+        check_ledger(rec)
         return
 
     if rec.get("metric") == "serving_batched_qps":

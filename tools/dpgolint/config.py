@@ -101,16 +101,22 @@ def project_config() -> Config:
                 "constructors": ["TelemetryRun", "HealthMonitor",
                                  "FlightRecorder", "MetricsSidecar",
                                  "ProfiledExecutable", "ProfilerWindow",
-                                 "Span"],
+                                 "Span", "DeviceTraceWindow",
+                                 "PerfLedger"],
                 # Obs-owned modules where construction IS the sanctioned
                 # implementation of the fence (each carries its own boom
                 # test): start_run/run_scope, span()/start_span(),
                 # monitor_for, FlightRecorder.attach + the replay CLI.
+                # devprof constructs its own trace windows behind
+                # ``get_run()`` checks; ledger.py is offline tooling
+                # whose PerfLedger only ever exists via load_ledger.
                 "allowed_files": [
                     "dpgo_tpu/obs/run.py",
                     "dpgo_tpu/obs/trace.py",
                     "dpgo_tpu/obs/health.py",
                     "dpgo_tpu/obs/recorder.py",
+                    "dpgo_tpu/obs/devprof.py",
+                    "dpgo_tpu/obs/ledger.py",
                 ],
             },
             "DPG003": {
