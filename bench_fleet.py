@@ -79,13 +79,25 @@ def req(meas, sid=None, iters=2, eval_every=2):
 
 
 def build_fleet(n, aot_root, sess_root=None, max_replicas=None,
-                batch_window_s=0.08, max_batch=2, **mgr_kw):
-    def make_server(rid):
-        return SolveServer(max_batch=max_batch,
-                           batch_window_s=batch_window_s,
-                           replica_id=rid, aot_cache_dir=aot_root,
-                           session_store=sess_root, session_every=1,
-                           resume_sessions=sess_root is not None)
+                batch_window_s=0.08, max_batch=2, procs=False, **mgr_kw):
+    if procs:
+        from dpgo_tpu.serve.fleet.procs import ProcServer
+
+        def make_server(rid):
+            # A real OS process per replica: the packed-v2 TCP front-end
+            # is the RPC surface, kill_replica is an actual SIGKILL.
+            return ProcServer(replica_id=rid, max_batch=max_batch,
+                              batch_window_s=batch_window_s,
+                              aot_cache_dir=aot_root,
+                              session_store=sess_root, session_every=1,
+                              resume_sessions=sess_root is not None)
+    else:
+        def make_server(rid):
+            return SolveServer(max_batch=max_batch,
+                               batch_window_s=batch_window_s,
+                               replica_id=rid, aot_cache_dir=aot_root,
+                               session_store=sess_root, session_every=1,
+                               resume_sessions=sess_root is not None)
 
     mgr = ReplicaManager(make_server, min_replicas=n,
                          max_replicas=max_replicas,
@@ -118,7 +130,8 @@ def balanced_sids(count, n_replicas):
     return out
 
 
-def arm_qps(meas, replica_counts, requests, aot_root) -> list[dict]:
+def arm_qps(meas, replica_counts, requests, aot_root,
+            procs=False) -> list[dict]:
     """The same heterogeneous request stream through fleets of ascending
     size.
 
@@ -150,7 +163,7 @@ def arm_qps(meas, replica_counts, requests, aot_root) -> list[dict]:
         # so the window applies to every dispatch (the lone-replica cost
         # being measured); max_batch is not the contended resource here.
         router = build_fleet(n, aot_root, batch_window_s=QPS_WINDOW_S,
-                             max_batch=2 * requests)
+                             max_batch=2 * requests, procs=procs)
         try:
             # One throwaway request per replica pays its executable disk
             # load before the clock starts.
@@ -173,22 +186,33 @@ def arm_qps(meas, replica_counts, requests, aot_root) -> list[dict]:
     return arms
 
 
-def arm_soak(meas, sessions, soak_iters, aot_root) -> dict:
+def arm_soak(meas, sessions, soak_iters, aot_root, procs=False) -> dict:
     """Concurrent live sessions with a mid-soak kill AND a mid-soak
-    autoscale-up; zero sessions may be lost."""
+    autoscale-up; zero sessions may be lost.  With ``procs=True`` the
+    kill is an actual ``SIGKILL`` of a replica OS process and sessions
+    migrate across process boundaries via the shared snapshot store."""
     sess_root = tempfile.mkdtemp(prefix="fleet-sess-")
     # queue_wait_slo_s=0 => every completed request reads as burning the
     # wait budget, so the autoscaler provably trips mid-soak.
     router = build_fleet(2, aot_root, sess_root=sess_root, max_replicas=3,
                          queue_wait_slo_s=0.0, scale_cooldown_s=0.5,
                          min_scale_observations=2, scale_window_s=60.0,
-                         batch_window_s=0.02, max_batch=2)
+                         batch_window_s=0.02, max_batch=2, procs=procs)
     mgr = router.manager
     try:
         tickets = {f"soak-{i}": router.submit(
             req(meas, sid=f"soak-{i}", iters=soak_iters, eval_every=1))
             for i in range(sessions)}
-        time.sleep(1.5)  # let solves get in flight and snapshot
+        # Let solves get in flight AND leave at least one boundary
+        # snapshot before the kill (out-of-process replicas pay a child
+        # boot first, so poll the store instead of a fixed sleep).
+        deadline = time.monotonic() + (120.0 if procs else 1.5)
+        while time.monotonic() < deadline:
+            import glob as _glob
+            if _glob.glob(os.path.join(sess_root, "*", "snap-*.npz")):
+                break
+            time.sleep(0.25)
+        time.sleep(1.5)
         victim = mgr.replicas()[0].replica_id
         mgr.kill_replica(victim)
         log(f"[soak] killed {victim} mid-soak")
@@ -260,14 +284,23 @@ def main(argv=None) -> int:
                     help="iteration budget of each soak session")
     ap.add_argument("--skip-soak", action="store_true")
     ap.add_argument("--skip-cold", action="store_true")
+    ap.add_argument("--procs", action="store_true",
+                    help="out-of-process replicas: each one a child OS "
+                         "process behind the packed-v2 TCP front-end; "
+                         "the soak kill is a real SIGKILL")
+    ap.add_argument("--out", default=None,
+                    help="also write the record JSON here (the checked-in "
+                         "FLEET_r*.json ledger rows)")
     args = ap.parse_args(argv)
 
     meas = make_meas(args.n_poses)
     aot_root = tempfile.mkdtemp(prefix="fleet-aot-")
 
-    qps = arm_qps(meas, args.replicas, args.requests, aot_root)
+    qps = arm_qps(meas, args.replicas, args.requests, aot_root,
+                  procs=args.procs)
     soak = {"skipped": True} if args.skip_soak else \
-        arm_soak(meas, args.sessions, args.soak_iters, aot_root)
+        arm_soak(meas, args.sessions, args.soak_iters, aot_root,
+                 procs=args.procs)
     cold = {"skipped": True} if args.skip_cold else arm_cold_start(meas)
 
     by_n = {a["replicas"]: a["qps"] for a in qps}
@@ -282,12 +315,17 @@ def main(argv=None) -> int:
         record="FLEET",
         ok=bool(ok),
         backend=jax.default_backend(),
+        out_of_process=bool(args.procs),
         qps=qps,
         scaling_1_to_2=scaling,
         soak=soak,
         cold_start=cold,
     )
     print(json.dumps(rec), flush=True)
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(rec, fh, indent=2)
+            fh.write("\n")
     return 0 if ok else 1
 
 

@@ -350,6 +350,55 @@ def unpack_pose_set(frame: dict, prefix: str) -> dict:
             for i, (r, p) in enumerate(zip(robots, poses))}
 
 
+# -- measurement batches (the serve-fleet RPC vocabulary) -------------------
+
+def pack_measurements(prefix: str, meas) -> dict:
+    """Columnar ``types.Measurements`` payload: the full struct-of-arrays
+    batch as 12 frame entries under ``prefix`` — edge indices int32,
+    value/precision columns float64, the inlier flags uint8.  Unlike the
+    g2o-bytes upload this round-trips EVERYTHING (multi-robot indexing,
+    GNC weights, known-inlier flags) bit-exactly, which is what lets an
+    out-of-process fleet replica solve the same problem its parent
+    constructed in memory."""
+    return {
+        f"{prefix}:d": np.int32(meas.d),
+        f"{prefix}:n": np.int32(meas.num_poses),
+        f"{prefix}:r1": np.asarray(meas.r1, np.int32),
+        f"{prefix}:p1": np.asarray(meas.p1, np.int32),
+        f"{prefix}:r2": np.asarray(meas.r2, np.int32),
+        f"{prefix}:p2": np.asarray(meas.p2, np.int32),
+        f"{prefix}:R": np.asarray(meas.R, np.float64),
+        f"{prefix}:t": np.asarray(meas.t, np.float64),
+        f"{prefix}:k": np.asarray(meas.kappa, np.float64),
+        f"{prefix}:tau": np.asarray(meas.tau, np.float64),
+        f"{prefix}:w": np.asarray(meas.weight, np.float64),
+        f"{prefix}:in": np.asarray(meas.is_known_inlier, np.uint8),
+    }
+
+
+def unpack_measurements(frame: dict, prefix: str):
+    """The ``Measurements`` under ``prefix``, or None when the frame does
+    not carry one (``{prefix}:d`` absent)."""
+    from ..types import Measurements  # local: protocol stays types-light
+
+    if f"{prefix}:d" not in frame:
+        return None
+    return Measurements(
+        d=int(np.asarray(frame[f"{prefix}:d"])),
+        num_poses=int(np.asarray(frame[f"{prefix}:n"])),
+        r1=np.asarray(frame[f"{prefix}:r1"], np.int64),
+        p1=np.asarray(frame[f"{prefix}:p1"], np.int64),
+        r2=np.asarray(frame[f"{prefix}:r2"], np.int64),
+        p2=np.asarray(frame[f"{prefix}:p2"], np.int64),
+        R=np.asarray(frame[f"{prefix}:R"], np.float64),
+        t=np.asarray(frame[f"{prefix}:t"], np.float64),
+        kappa=np.asarray(frame[f"{prefix}:k"], np.float64),
+        tau=np.asarray(frame[f"{prefix}:tau"], np.float64),
+        weight=np.asarray(frame[f"{prefix}:w"], np.float64),
+        is_known_inlier=np.asarray(frame[f"{prefix}:in"], bool),
+    )
+
+
 # ---------------------------------------------------------------------------
 # Trace context + clock stamps (the distributed-tracing wire vocabulary)
 # ---------------------------------------------------------------------------

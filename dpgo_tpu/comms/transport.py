@@ -308,17 +308,59 @@ def listen_tcp(host: str = "127.0.0.1", port: int = 0) -> socket.socket:
     return socket.create_server((host, port))
 
 
-def connect_tcp(host: str, port: int, attempts: int = 100,
-                retry_delay: float = 0.1) -> socket.socket:
-    """Dial with bounded connect retries (the listener may not be up yet)."""
+class ConnectError(ConnectionError):
+    """Structured connect failure: the retry budget ran out.
+
+    Carries the dial target and the budget actually spent so callers
+    (fleet respawn loops, CI harnesses) can log/decide without parsing
+    the message.  ``__cause__`` is the last socket-level error."""
+
+    def __init__(self, host: str, port: int, attempts: int,
+                 elapsed_s: float):
+        super().__init__(
+            f"could not reach {host}:{port} after {attempts} connect "
+            f"attempts over {elapsed_s:.2f}s")
+        self.host = host
+        self.port = int(port)
+        self.attempts = int(attempts)
+        self.elapsed_s = float(elapsed_s)
+
+
+#: Dial-retry budget: more attempts than ``RetryPolicy``'s send default
+#: (a listener that is still binding is the EXPECTED cold-start case,
+#: not a fault), same base/cap/jitter constants.  Total worst-case wait
+#: ~= 5-8s depending on jitter draws.
+CONNECT_ATTEMPTS = 9
+
+
+def connect_tcp(host: str, port: int, attempts: int | None = None,
+                policy=None, rng=None) -> socket.socket:
+    """Dial with bounded connect retries (the listener may not be up yet).
+
+    Backoff is ``reliable.RetryPolicy``'s exponential-plus-jitter
+    schedule — the same constants the send-retry path uses — instead of
+    a fixed poll interval, so a thundering herd of replicas dialing one
+    freshly spawned peer decorrelates.  Raises ``ConnectError`` (a
+    ``ConnectionError``) once the budget is spent."""
+    from .reliable import RetryPolicy  # lazy: reliable layers on transport
+
+    policy = RetryPolicy() if policy is None else policy
+    attempts = CONNECT_ATTEMPTS if attempts is None else int(attempts)
+    if rng is None:
+        import numpy as np
+
+        rng = np.random.default_rng()
+    t0 = time.monotonic()
     last: Exception | None = None
-    for _ in range(attempts):
+    for attempt in range(attempts):
         try:
             sock = socket.create_connection((host, port))
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             return sock
-        except ConnectionRefusedError as e:
+        except (ConnectionRefusedError, ConnectionResetError,
+                TimeoutError) as e:
             last = e
-            time.sleep(retry_delay)
-    raise ConnectionError(
-        f"could not reach {host}:{port} after {attempts} attempts") from last
+            if attempt + 1 < attempts:
+                time.sleep(policy.backoff_s(attempt, rng))
+    raise ConnectError(host, port, attempts,
+                       time.monotonic() - t0) from last

@@ -4,11 +4,16 @@
 boundary checkpoints, a deterministic collective fault injector, and the
 anomaly-triggered rewind supervisor behind
 ``solve_rbcd_sharded(resilience=...)``.
+
+``multihost.py`` makes the scale real: the same verdict-loop solve
+across multiple OS processes joined by ``jax.distributed``, with
+verdict-boundary lockstep over the coordination service and actual
+``kill -9`` recovery via generation respawn + checkpoint resume.
 """
 
-from .resilience import (CollectiveFaultInjector, DeviceLostError,
-                         MeshFaultError, MeshFaultSpec, ResilienceConfig,
-                         Watchdog, shrink_mesh_size)
+from .resilience import (WORLD_FAULT_KINDS, CollectiveFaultInjector,
+                         DeviceLostError, MeshFaultError, MeshFaultSpec,
+                         ResilienceConfig, Watchdog, shrink_mesh_size)
 from .sharded import (AXIS, comm_bytes_per_round, gn_tail_sharded,
                       make_mesh, make_multislice_mesh,
                       make_sharded_metrics_body,
@@ -16,9 +21,27 @@ from .sharded import (AXIS, comm_bytes_per_round, gn_tail_sharded,
                       make_sharded_step, shard_problem, solve_rbcd_sharded)
 
 __all__ = ["AXIS", "CollectiveFaultInjector", "DeviceLostError",
-           "MeshFaultError", "MeshFaultSpec", "ResilienceConfig",
-           "Watchdog", "comm_bytes_per_round", "gn_tail_sharded",
+           "EXIT_DESYNC", "EXIT_PROCESS_LOST", "MeshFaultError",
+           "MeshFaultSpec", "MultihostWorld", "ResilienceConfig",
+           "WORLD_FAULT_KINDS", "Watchdog", "WorldConfig",
+           "comm_bytes_per_round", "gn_tail_sharded", "launch_world",
            "make_mesh", "make_multislice_mesh",
            "make_sharded_metrics_body", "make_sharded_multi_step",
            "make_sharded_segment", "make_sharded_step", "shard_problem",
-           "shrink_mesh_size", "solve_rbcd_sharded"]
+           "shrink_mesh_size", "shrink_world", "solve_rbcd_sharded"]
+
+#: Lazily re-exported from ``.multihost``: importing it eagerly would
+#: re-execute the module when invoked as ``python -m dpgo_tpu.parallel
+#: .multihost`` (the worker/launcher CLI), tripping runpy's
+#: found-in-sys.modules warning in every worker log.
+_MULTIHOST_EXPORTS = frozenset({
+    "EXIT_DESYNC", "EXIT_PROCESS_LOST", "MultihostWorld", "WorldConfig",
+    "launch_world", "shrink_world"})
+
+
+def __getattr__(name):
+    if name in _MULTIHOST_EXPORTS:
+        from . import multihost
+
+        return getattr(multihost, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

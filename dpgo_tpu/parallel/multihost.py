@@ -1,0 +1,597 @@
+"""True multi-host execution: one solve, N OS processes, kill -9 recovery.
+
+This module makes the "distributed" in distributed PGO real: the
+verdict-loop solve (``solve_rbcd_sharded``) runs across multiple
+*processes* joined into one world by ``jax.distributed``, and a worker
+that dies — actually dies, ``kill -9``, not a raised exception — is
+detected, the world shrinks, and the survivors resume from the last v2
+checkpoint.  Three layers:
+
+* **World membership** (``MultihostWorld``) — ``jax.distributed
+  .initialize`` joins each rank to the coordination service (a gRPC
+  control plane owned by rank 0).  The service's key-value store and
+  named barriers are the cross-process primitives; they work on every
+  backend, including CPU.
+
+* **Lockstep compute** — XLA refuses cross-process computations on the
+  CPU backend (``INVALID_ARGUMENT: Multiprocess computations aren't
+  implemented on the CPU backend``, probed, both pmap and jit+shard_map),
+  so each rank executes the identical deterministic sharded solve on its
+  own local mesh and the world proves lockstep where the driver already
+  surfaces to the host: the ONE int32 verdict word per K rounds.  At
+  each verdict boundary every rank publishes ``iteration:word`` to the
+  KV store, crosses a named barrier, and checks its word against the
+  controller's (rank 0).  No new device syncs — the word is already on
+  the host at a boundary, so ``host_syncs_per_100_rounds == 100/K``
+  holds unchanged.  On a TPU pod the same entry points would place one
+  global mesh across the processes; the control plane is identical.
+
+* **Failure recovery** — a SIGKILLed peer never reaches its barrier, so
+  the survivors' ``wait_at_barrier`` raises ``DEADLINE_EXCEEDED``,
+  surfaced as ``MeshFaultError(phase="verdict_sync",
+  kind="process_lost")``.  ``CheckpointSupervisor.recover`` re-raises
+  world faults (a dead peer cannot be rewound away in-process), the
+  worker writes a structured fault record and exits
+  ``EXIT_PROCESS_LOST``, and the generation launcher (``launch_world``)
+  respawns the survivors as generation g+1 on a shrunken world
+  (``shrink_world``) with ``solve_rbcd_sharded(resume=True)`` — the
+  supervisor restores the newest mesh-shape-agnostic v2 checkpoint from
+  the shared ``SessionStore`` and the solve continues at the exact
+  absolute round index.  Only rank 0 persists checkpoints
+  (``ResilienceConfig.checkpoint_writer``); every rank reads them.
+
+Barrier timeouts are two-tier: the first boundary lands after each rank
+compiles its sharded programs (minutes of skew on a contended box), so
+it gets ``first_barrier_timeout_s``; steady-state boundaries are
+deterministic lockstep and get the tight ``barrier_timeout_s``, which is
+also the fault-detection latency.
+
+CLI (also the README quickstart)::
+
+    python -m dpgo_tpu.parallel.multihost --procs 2
+    python -m dpgo_tpu.parallel.multihost --procs 2 --kill-rank 1 \\
+        --kill-at-boundary 3   # kill -9 a worker mid-solve, watch recovery
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+from .. import obs
+from .resilience import MeshFaultError, shrink_mesh_size
+
+#: Worker exit codes the launcher classifies (anything else is a crash).
+EXIT_PROCESS_LOST = 17  # a peer died: barrier timed out at a boundary
+EXIT_DESYNC = 18        # lockstep broken: verdict words diverged
+
+
+def shrink_world(cur: int, num_robots: int, min_size: int = 1) -> int:
+    """The next smaller world size after losing a process: the largest
+    count strictly below ``cur`` that still divides the agent count —
+    the same divisibility planning as a mesh shrink, because each rank's
+    local mesh must go on dividing ``num_robots``."""
+    return shrink_mesh_size(cur, num_robots, min_size)
+
+
+# ---------------------------------------------------------------------------
+# World membership + verdict lockstep
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class WorldConfig:
+    """One rank's view of the world (`--worker` CLI args, test kwargs)."""
+
+    coordinator: str
+    world_size: int
+    rank: int
+    generation: int = 0
+    #: Steady-state barrier deadline == fault-detection latency.
+    barrier_timeout_s: float = 20.0
+    #: First-boundary deadline: absorbs cross-rank XLA compile skew.
+    first_barrier_timeout_s: float = 600.0
+    init_timeout_s: float = 300.0
+
+    def __post_init__(self):
+        if self.world_size < 1:
+            raise ValueError(f"world_size must be >= 1, got "
+                             f"{self.world_size}")
+        if not 0 <= self.rank < self.world_size:
+            raise ValueError(f"rank {self.rank} outside world of "
+                             f"{self.world_size}")
+        if self.barrier_timeout_s <= 0 or self.first_barrier_timeout_s <= 0:
+            raise ValueError("barrier timeouts must be > 0")
+
+
+def _coordination_client():
+    """The live process's coordination-service handle (requires a prior
+    ``jax.distributed.initialize``)."""
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:  # pragma: no cover - misuse guard
+        raise RuntimeError("jax.distributed is not initialized; "
+                           "call MultihostWorld.join() or initialize first")
+    return client
+
+
+class MultihostWorld:
+    """Verdict-boundary lockstep across the ranks of one generation.
+
+    ``boundary_cb`` plugs into ``solve_rbcd_sharded(boundary_cb=...)``:
+    at every verdict boundary it publishes this rank's ``iteration:word``
+    to the coordination-service KV store, crosses a generation-scoped
+    named barrier, and cross-checks against the controller's word.  A
+    barrier deadline means a peer never arrived —
+    ``MeshFaultError(kind="process_lost")``; a word mismatch means
+    replicated lockstep broke — ``MeshFaultError(kind="desync")``.
+
+    ``client`` is injectable (tests drive the protocol with a fake);
+    production ranks call :meth:`join` which initializes
+    ``jax.distributed`` and grabs the real client.
+    """
+
+    def __init__(self, cfg: WorldConfig, client=None):
+        self.cfg = cfg
+        self.rank = cfg.rank
+        self.world_size = cfg.world_size
+        self.generation = cfg.generation
+        self.client = client
+        self.boundaries = 0  # completed lockstep syncs
+        self.desync_checks = 0
+
+    @classmethod
+    def join(cls, cfg: WorldConfig) -> "MultihostWorld":
+        """Initialize ``jax.distributed`` for this rank and return the
+        joined world.  Must run before the first JAX computation."""
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator,
+            num_processes=cfg.world_size,
+            process_id=cfg.rank,
+            initialization_timeout=int(cfg.init_timeout_s))
+        return cls(cfg, client=_coordination_client())
+
+    # -- key naming ---------------------------------------------------------
+
+    def _word_key(self, seq: int, rank: int) -> str:
+        return f"dpgo/mh/g{self.generation}/s{seq}/r{rank}"
+
+    def _barrier_id(self, seq: int) -> str:
+        return f"dpgo/mh/g{self.generation}/b{seq}"
+
+    # -- the lockstep protocol ----------------------------------------------
+
+    def verdict_sync(self, it: int, word: int) -> None:
+        """One boundary's cross-process agreement: publish, barrier,
+        cross-check.  Raises the structured world faults above."""
+        if self.world_size == 1:
+            self.boundaries += 1
+            return
+        seq = self.boundaries
+        payload = f"{int(it)}:{int(word)}"
+        timeout_s = self.cfg.first_barrier_timeout_s if seq == 0 \
+            else self.cfg.barrier_timeout_s
+        self.client.key_value_set(self._word_key(seq, self.rank), payload)
+        try:
+            self.client.wait_at_barrier(self._barrier_id(seq),
+                                        int(timeout_s * 1000))
+        except Exception as e:
+            raise MeshFaultError(
+                f"rank {self.rank}: peer lost at verdict boundary {seq} "
+                f"(iteration {it}): barrier {self._barrier_id(seq)!r} "
+                f"timed out after {timeout_s:g}s",
+                phase="verdict_sync", kind="process_lost") from e
+        if self.rank != 0:
+            # The barrier just proved rank 0 published; the get is a
+            # KV read of an existing key, not a second wait.
+            ref = self.client.blocking_key_value_get(
+                self._word_key(seq, 0), int(timeout_s * 1000))
+            if isinstance(ref, bytes):
+                ref = ref.decode("utf-8", "replace")
+            self.desync_checks += 1
+            if ref != payload:
+                raise MeshFaultError(
+                    f"rank {self.rank}: verdict desync at boundary {seq}: "
+                    f"controller says {ref!r}, this rank computed "
+                    f"{payload!r} — replicated lockstep broken",
+                    phase="verdict_sync", kind="desync")
+        self.boundaries += 1
+        run = obs.get_run()
+        if run is not None:
+            run.counter("multihost_boundary_syncs_total",
+                        "verdict-boundary lockstep syncs").inc()
+
+    def boundary_cb(self, it, nwu, state, word, terminal) -> None:
+        """The ``solve_rbcd_sharded(boundary_cb=...)`` adapter; ``state``
+        stays on device — lockstep rides the already-fetched word."""
+        self.verdict_sync(int(it), int(word))
+
+
+# ---------------------------------------------------------------------------
+# Worker: one rank of one generation (its own OS process)
+# ---------------------------------------------------------------------------
+
+def _write_json(path, record: dict) -> None:
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_suffix(p.suffix + ".tmp")
+    tmp.write_text(json.dumps(record, indent=2, sort_keys=True))
+    os.replace(tmp, p)
+
+
+def _read_json(path) -> dict | None:
+    try:
+        return json.loads(Path(path).read_text())
+    except (OSError, ValueError):
+        return None
+
+
+def _solve_problem(args):
+    """The deterministic demo problem every rank rebuilds identically
+    (seeded synthetic odometry chain + loop closures)."""
+    from ..utils.synthetic import make_measurements
+
+    rng = np.random.default_rng(args.seed)
+    meas, _ = make_measurements(rng, n=args.n, d=3, num_lc=args.num_lc,
+                                rot_noise=args.noise,
+                                trans_noise=args.noise)
+    return meas
+
+
+def run_worker(args) -> int:
+    """``--worker`` entry: join the world, run the lockstep solve, write
+    a result (or structured fault) record, exit with a classifiable rc."""
+    import jax
+
+    # Mirror tests/conftest.py: the environment's sitecustomize may
+    # register a hardware tunnel; workers are pinned to the CPU backend
+    # the launcher sized via XLA_FLAGS.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    cfg = WorldConfig(coordinator=args.coordinator, world_size=args.world,
+                      rank=args.rank, generation=args.generation,
+                      barrier_timeout_s=args.barrier_timeout,
+                      first_barrier_timeout_s=args.first_barrier_timeout,
+                      init_timeout_s=args.init_timeout)
+    world = MultihostWorld.join(cfg)
+
+    from ..config import AgentParams
+    from ..models import rbcd
+    from ..serve.session import SessionStore
+    from .resilience import ResilienceConfig
+    from .sharded import make_mesh, solve_rbcd_sharded
+
+    meas = _solve_problem(args)
+    params = AgentParams(d=3, r=5, num_robots=args.robots,
+                        rel_change_tol=0.0)
+    rcfg = ResilienceConfig(
+        checkpoint_dir=args.checkpoint_dir, session_id=args.session,
+        checkpoint_every=1, keep=4,
+        checkpoint_writer=(world.rank == 0))
+
+    resume = args.generation > 0
+    resume_iteration = 0
+    if resume:
+        snap = SessionStore(args.checkpoint_dir).load_newest(args.session)
+        if snap is not None:
+            resume_iteration = int(snap.iteration)
+
+    chaos_cb = world.boundary_cb
+    if args.kill_at_boundary >= 0 and args.kill_rank == world.rank \
+            and args.generation == 0:
+        def chaos_cb(it, nwu, state, word, terminal):
+            if world.boundaries == args.kill_at_boundary:
+                sys.stdout.flush()
+                # A REAL kill -9 of this worker, mid-solve: uncatchable,
+                # no cleanup, no flush — exactly what the survivors must
+                # detect and recover from.
+                os.kill(os.getpid(), signal.SIGKILL)
+            world.boundary_cb(it, nwu, state, word, terminal)
+
+    # Count driver-loop host syncs through the sanctioned seam, the same
+    # shim as tests/test_mesh_resilience.py: the lockstep must not add
+    # any (it rides words already fetched).
+    fetches = [0]
+    orig_fetch = rbcd._host_fetch
+
+    def counting_fetch(x):
+        fetches[0] += 1
+        return orig_fetch(x)
+
+    # The rank's mesh spans its LOCAL devices only.  With jax.distributed
+    # active, ``jax.devices()`` is the GLOBAL list — a mesh slicing it
+    # would hand every rank but 0 remote devices, and a device_put onto a
+    # non-fully-addressable sharding routes through a cross-process
+    # psum (multihost_utils.assert_equal) the CPU backend refuses.  Each
+    # rank hosting the replicated solve on its own mesh is the lockstep
+    # design; on a TPU pod the same call site would place one global mesh.
+    mesh = make_mesh(args.mesh_size, devices=jax.local_devices())
+
+    t0 = time.monotonic()
+    rbcd._host_fetch = counting_fetch
+    try:
+        res = solve_rbcd_sharded(
+            meas, args.robots, mesh=mesh,
+            params=params, max_iters=args.rounds,
+            verdict_every=args.verdict_every,
+            eval_every=args.verdict_every, grad_norm_tol=0.0,
+            resilience=rcfg, resume=resume, boundary_cb=chaos_cb)
+    except MeshFaultError as e:
+        _write_json(args.out, {
+            "ok": False, "kind": e.kind, "phase": e.phase,
+            "rank": world.rank, "generation": world.generation,
+            "world_size": world.world_size,
+            "boundaries": world.boundaries, "error": str(e)})
+        sys.stdout.flush()
+        sys.stderr.flush()
+        # A peer is gone: the coordination service cannot complete a
+        # clean shutdown handshake, so the atexit hook would hang on the
+        # dead rank.  Exit hard with the classifiable code instead.
+        os._exit(EXIT_PROCESS_LOST if e.kind == "process_lost"
+                 else EXIT_DESYNC)
+    finally:
+        rbcd._host_fetch = orig_fetch
+
+    rounds = args.rounds - resume_iteration
+    # Driver-loop fetches exclude the one terminal-epilogue transfer
+    # (rbcd._emit_sync_rate's convention).
+    loop_fetches = max(fetches[0] - 1, 0)
+    _write_json(args.out, {
+        "ok": True, "rank": world.rank, "generation": world.generation,
+        "world_size": world.world_size, "mesh_size": args.mesh_size,
+        "boundaries": world.boundaries,
+        "desync_checks": world.desync_checks,
+        "resumed": resume, "resume_iteration": resume_iteration,
+        "iterations": int(res.iterations),
+        "terminated_by": res.terminated_by,
+        "final_cost": float(res.cost_history[-1]),
+        "cost_history": [float(c) for c in res.cost_history],
+        "grad_norm_history": [float(g) for g in res.grad_norm_history],
+        "recovered": bool(res.recovered),
+        "resilience": res.resilience,
+        "host_fetches": int(fetches[0]),
+        "rounds_executed": int(rounds),
+        "host_syncs_per_100_rounds":
+            100.0 * loop_fetches / max(rounds, 1),
+        "wall_s": round(time.monotonic() - t0, 3)})
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Launcher: generations of worker processes
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _classify(rc: int) -> str:
+    if rc == 0:
+        return "ok"
+    if rc == EXIT_PROCESS_LOST:
+        return "process_lost"
+    if rc == EXIT_DESYNC:
+        return "desync"
+    if rc < 0:
+        try:
+            return f"signal:{signal.Signals(-rc).name}"
+        except ValueError:
+            return f"signal:{-rc}"
+    return f"crash:{rc}"
+
+
+def launch_world(procs: int = 2, *, robots: int = 8, mesh_size: int = 2,
+                 n: int = 64, num_lc: int = 12, noise: float = 0.05,
+                 seed: int = 7, rounds: int = 24, verdict_every: int = 4,
+                 workdir: str | None = None,
+                 barrier_timeout_s: float = 20.0,
+                 first_barrier_timeout_s: float = 600.0,
+                 init_timeout_s: float = 300.0,
+                 kill_rank: int | None = None,
+                 kill_at_boundary: int | None = None,
+                 kill_after_s: float | None = None,
+                 max_generations: int = 3,
+                 worker_timeout_s: float = 1800.0,
+                 session: str = "multihost-solve") -> dict:
+    """Run one multihost solve to completion, across generations.
+
+    Spawns ``procs`` worker processes joined by ``jax.distributed``; if
+    a generation loses a process (the two chaos levers: a worker
+    SIGKILLs itself at a named verdict boundary, or the launcher
+    ``kill -9``\\ s a rank after a wall-clock delay), the surviving ranks
+    exit with structured fault records and the next generation respawns
+    them on the shrunken world with ``resume=True`` — the supervisor
+    restores the newest v2 checkpoint from the shared store and the
+    solve continues.  Returns the final generation's controller record
+    plus the per-generation fault ledger."""
+    if robots % mesh_size != 0:
+        raise ValueError(f"mesh_size {mesh_size} must divide robots "
+                         f"{robots}")
+    workdir = Path(workdir or tempfile.mkdtemp(prefix="dpgo-multihost-"))
+    workdir.mkdir(parents=True, exist_ok=True)
+    checkpoint_dir = workdir / "checkpoints"
+    repo_root = Path(__file__).resolve().parents[2]
+
+    world = int(procs)
+    generations = []
+    gen = 0
+    while True:
+        port = _free_port()
+        outs, log_files, procs_list = [], [], []
+        for rank in range(world):
+            out = workdir / f"g{gen}-r{rank}.json"
+            log = workdir / f"g{gen}-r{rank}.log"
+            outs.append(out)
+            cmd = [sys.executable, "-m", "dpgo_tpu.parallel.multihost",
+                   "--worker", "--rank", str(rank), "--world", str(world),
+                   "--coordinator", f"127.0.0.1:{port}",
+                   "--generation", str(gen),
+                   "--robots", str(robots), "--mesh-size", str(mesh_size),
+                   "--n", str(n), "--num-lc", str(num_lc),
+                   "--noise", str(noise), "--seed", str(seed),
+                   "--rounds", str(rounds),
+                   "--verdict-every", str(verdict_every),
+                   "--checkpoint-dir", str(checkpoint_dir),
+                   "--session", session, "--out", str(out),
+                   "--barrier-timeout", str(barrier_timeout_s),
+                   "--first-barrier-timeout", str(first_barrier_timeout_s),
+                   "--init-timeout", str(init_timeout_s)]
+            if gen == 0 and kill_rank is not None \
+                    and kill_at_boundary is not None:
+                cmd += ["--kill-rank", str(kill_rank),
+                        "--kill-at-boundary", str(kill_at_boundary)]
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={mesh_size}"
+            ).strip()
+            env["PYTHONPATH"] = str(repo_root) + (
+                os.pathsep + env["PYTHONPATH"]
+                if env.get("PYTHONPATH") else "")
+            lf = open(log, "w")
+            log_files.append(lf)
+            procs_list.append(subprocess.Popen(
+                cmd, env=env, stdout=lf, stderr=subprocess.STDOUT,
+                cwd=str(repo_root)))
+
+        if gen == 0 and kill_rank is not None and kill_after_s is not None \
+                and kill_at_boundary is None:
+            time.sleep(kill_after_s)
+            if procs_list[kill_rank].poll() is None:
+                procs_list[kill_rank].send_signal(signal.SIGKILL)
+
+        deadline = time.monotonic() + worker_timeout_s
+        rcs = []
+        for p in procs_list:
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 1.0))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+            rcs.append(p.returncode)
+        for lf in log_files:
+            lf.close()
+
+        records = [_read_json(o) for o in outs]
+        faults = [r for r in records
+                  if r is not None and not r.get("ok", False)]
+        gen_entry = {"generation": gen, "world_size": world,
+                     "rcs": list(rcs),
+                     "outcomes": [_classify(rc) for rc in rcs],
+                     "faults": faults}
+        generations.append(gen_entry)
+
+        if all(rc == 0 for rc in rcs):
+            result = records[0]
+            if result is None or not result.get("ok"):
+                raise RuntimeError(
+                    f"generation {gen}: all ranks exited 0 but the "
+                    f"controller record at {outs[0]} is missing/faulted")
+            return {"result": result, "generations": generations,
+                    "world_sizes": [g["world_size"] for g in generations],
+                    "recovered": gen > 0,
+                    "workdir": str(workdir)}
+
+        if gen + 1 >= max_generations:
+            raise RuntimeError(
+                f"multihost solve failed after {gen + 1} generations: "
+                f"{[g['outcomes'] for g in generations]}")
+        world = shrink_world(world, robots) if world > 1 else world
+        gen += 1
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m dpgo_tpu.parallel.multihost",
+        description="Multi-process mesh solve with kill -9 recovery")
+    p.add_argument("--procs", type=int, default=2,
+                   help="world size (worker processes) for generation 0")
+    p.add_argument("--robots", type=int, default=8)
+    p.add_argument("--mesh-size", type=int, default=2,
+                   help="local device-mesh size per rank (virtual CPU "
+                        "devices; must divide --robots)")
+    p.add_argument("--n", type=int, default=64,
+                   help="poses in the synthetic demo problem")
+    p.add_argument("--num-lc", type=int, default=12)
+    p.add_argument("--noise", type=float, default=0.05)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--rounds", type=int, default=24)
+    p.add_argument("--verdict-every", type=int, default=4)
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--session", default="multihost-solve")
+    p.add_argument("--barrier-timeout", type=float, default=20.0)
+    p.add_argument("--first-barrier-timeout", type=float, default=600.0)
+    p.add_argument("--init-timeout", type=float, default=300.0)
+    p.add_argument("--max-generations", type=int, default=3)
+    p.add_argument("--kill-rank", type=int, default=-1,
+                   help="chaos: the rank to kill -9 in generation 0")
+    p.add_argument("--kill-at-boundary", type=int, default=-1,
+                   help="chaos: the victim SIGKILLs itself at this "
+                        "verdict boundary (deterministic)")
+    p.add_argument("--kill-after", type=float, default=None,
+                   help="chaos: the launcher kill -9s --kill-rank after "
+                        "this many seconds (wall-clock)")
+    # Hidden worker-mode flags (the launcher spawns these).
+    p.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--rank", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--world", type=int, default=1, help=argparse.SUPPRESS)
+    p.add_argument("--coordinator", default="", help=argparse.SUPPRESS)
+    p.add_argument("--generation", type=int, default=0,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--checkpoint-dir", default="", help=argparse.SUPPRESS)
+    p.add_argument("--out", default="", help=argparse.SUPPRESS)
+    return p
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.worker:
+        return run_worker(args)
+    kill_rank = args.kill_rank if args.kill_rank >= 0 else None
+    kill_at = args.kill_at_boundary if args.kill_at_boundary >= 0 else None
+    summary = launch_world(
+        args.procs, robots=args.robots, mesh_size=args.mesh_size,
+        n=args.n, num_lc=args.num_lc, noise=args.noise, seed=args.seed,
+        rounds=args.rounds, verdict_every=args.verdict_every,
+        workdir=args.workdir, barrier_timeout_s=args.barrier_timeout,
+        first_barrier_timeout_s=args.first_barrier_timeout,
+        init_timeout_s=args.init_timeout,
+        kill_rank=kill_rank, kill_at_boundary=kill_at,
+        kill_after_s=args.kill_after,
+        max_generations=args.max_generations, session=args.session)
+    res = summary["result"]
+    print(json.dumps({
+        "world_sizes": summary["world_sizes"],
+        "recovered": summary["recovered"],
+        "resume_iteration": res["resume_iteration"],
+        "final_cost": res["final_cost"],
+        "iterations": res["iterations"],
+        "host_syncs_per_100_rounds": res["host_syncs_per_100_rounds"],
+        "boundaries": res["boundaries"],
+        "workdir": summary["workdir"]}, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

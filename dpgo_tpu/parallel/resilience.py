@@ -98,6 +98,16 @@ class MeshFaultError(RuntimeError):
         self.device = device
 
 
+#: Fault kinds scoped to the WORLD, not the local mesh: a peer process
+#: that died (``process_lost``, the multihost barrier timeout) or whose
+#: lockstep verdict word diverged (``desync``).  ``CheckpointSupervisor
+#: .recover`` re-raises these instead of rewinding — a dead or diverged
+#: peer cannot be repaired in-process; the multihost launcher shrinks
+#: the world and respawns the survivors, whose supervisor then resumes
+#: from the same checkpoint store (``parallel.multihost``).
+WORLD_FAULT_KINDS = frozenset({"process_lost", "desync"})
+
+
 class DeviceLostError(MeshFaultError):
     """A device (simulated or real) dropped out of the mesh."""
 
@@ -434,6 +444,13 @@ class ResilienceConfig:
     async_checkpoint: bool = True
     #: Deterministic chaos source (tests / chaos arms); None in prod.
     injector: CollectiveFaultInjector | None = None
+    #: Whether THIS process persists boundary checkpoints.  Multihost
+    #: runs replicate the solve across ranks over one shared store: only
+    #: the controller (rank 0) writes — concurrent ranks saving the same
+    #: iteration would race the atomic tmp+rename — while every rank
+    #: still reads the store on resume/recovery.  Anomaly detection and
+    #: rewind bookkeeping are unaffected by this flag.
+    checkpoint_writer: bool = True
 
     def __post_init__(self):
         if self.checkpoint_every < 1:
@@ -549,6 +566,8 @@ class CheckpointSupervisor:
             return
         if anomaly is not None or it == self._last_saved_it:
             return  # never checkpoint an anomalous state
+        if not self.cfg.checkpoint_writer:
+            return  # reader rank: the controller persists for the world
         self.save(state, it, nwu)
 
     def save(self, state, it: int, nwu: int) -> str:
@@ -580,6 +599,13 @@ class CheckpointSupervisor:
         """Map a caught fault to ``(new_mesh_size, host_state | None,
         start_iteration, start_num_weight_updates)``; ``None`` state
         means cold restart from the initial guess."""
+        if isinstance(exc, MeshFaultError) and exc.kind in WORLD_FAULT_KINDS:
+            # A dead or diverged PEER PROCESS is not fixable by an
+            # in-process rewind: the world itself must shrink.  Propagate
+            # to the multihost launcher, which respawns the surviving
+            # ranks as a new generation; that generation's supervisor
+            # resumes from this same store (solve_rbcd_sharded(resume=)).
+            raise exc
         self.recoveries += 1
         kind = exc.kind if isinstance(exc, MeshFaultError) \
             else f"anomaly:{exc.anomaly}"

@@ -738,6 +738,30 @@ def _resolve_overlap_auto(mesh, state, graph, meta, params, exchange,
     return bool(decision["overlap"])
 
 
+def _resume_from_store(sup, mesh, graph_host, meta, params, run):
+    """Warm entry for ``solve_rbcd_sharded(resume=True)``: the newest
+    usable snapshot of the supervisor's session, resharded onto the
+    caller's mesh (snapshots are mesh-shape-agnostic), or ``None`` for a
+    cold start.  Same refresh-then-shard order as fault recovery, so a
+    same-mesh resume is bitwise."""
+    flush = getattr(sup.store, "flush", None)
+    if flush is not None:
+        flush()
+    snap = sup.store.load_newest(sup.session_id)
+    if snap is None:
+        return None
+    if snap.global_index is not None and not np.array_equal(
+            np.asarray(snap.global_index), sup._gidx):
+        return None  # different problem layout — fail open to cold start
+    host_state = rbcd.refresh_problem(snap.state, graph_host, meta, params)
+    state, graph = shard_problem(mesh, host_state, graph_host)
+    if run is not None:
+        run.event("mesh_resume", phase="resilience",
+                  session=sup.session_id, iteration=int(snap.iteration),
+                  mesh_size=int(mesh.devices.size))
+    return state, graph, int(snap.iteration), int(snap.num_weight_updates)
+
+
 def solve_rbcd_sharded(
     meas: Measurements,
     num_robots: int,
@@ -754,6 +778,8 @@ def solve_rbcd_sharded(
     overlap: "bool | str" = True,
     gn_tail: "refine.GNTailConfig | None" = None,
     resilience: "resilience_mod.ResilienceConfig | None" = None,
+    boundary_cb=None,
+    resume: bool = False,
 ) -> rbcd.RBCDResult:
     """Distributed solve over a device mesh — the deployment path of the
     framework (``models.rbcd.solve_rbcd`` is the single-device debug path).
@@ -792,7 +818,18 @@ def solve_rbcd_sharded(
     the exact absolute round index.  The returned result then carries a
     ``resilience`` summary dict and ``recovered=True`` if any rewind
     happened; its histories cover the final (resumed) attempt — a
-    numerically-pinned suffix of the undisturbed run's."""
+    numerically-pinned suffix of the undisturbed run's.
+
+    ``boundary_cb(it, nwu, state, word, terminal)`` (requires the
+    verdict loop) is an external verdict-boundary hook that runs BEFORE
+    the resilience supervisor's own: the multihost lockstep
+    (``parallel.multihost``) rides it to cross-check the replicated
+    verdict word across processes and surface a dead peer as
+    ``MeshFaultError(kind="process_lost")``.  ``resume=True`` (requires
+    ``resilience``) enters the solve at the newest usable checkpoint of
+    ``resilience.session_id`` instead of the initial guess — the restart
+    path of a multihost generation whose predecessor lost a process —
+    falling back to a cold start when the store holds nothing usable."""
     mesh = mesh or make_mesh()
     mesh_size = int(mesh.devices.size)
     if num_robots % mesh_size != 0:
@@ -810,6 +847,14 @@ def solve_rbcd_sharded(
             "resilience=ResilienceConfig(...) rides the verdict-boundary "
             "contract (checkpoints at word-fetch boundaries); pass "
             "verdict_every=K to use it")
+    if boundary_cb is not None and verdict_every is None:
+        raise ValueError(
+            "boundary_cb is a verdict-boundary hook; pass verdict_every=K "
+            "to use it")
+    if resume and resilience is None:
+        raise ValueError(
+            "resume=True restores from the resilience checkpoint store; "
+            "pass resilience=ResilienceConfig(...) to use it")
     params = params or AgentParams(d=meas.d, r=5, num_robots=num_robots)
     max_iters = params.max_num_iters if max_iters is None else max_iters
 
@@ -991,7 +1036,7 @@ def solve_rbcd_sharded(
             certificate=certificate)
 
     if resilience is None:
-        res = _attempt(mesh, state, graph, 0, 0, None, None)
+        res = _attempt(mesh, state, graph, 0, 0, boundary_cb, None)
         return res if gn_tail is None else _append_gn_tail(res, graph, mesh)
 
     # -- the rewind supervisor (parallel.resilience) ------------------------
@@ -1006,13 +1051,27 @@ def solve_rbcd_sharded(
     phase = ["sharded_verdict"]
     mesh_cur, state_cur, graph_cur = mesh, state, graph
     start_it = start_nwu = 0
+    if boundary_cb is None:
+        chained_cb = sup.boundary_cb
+    else:
+        def chained_cb(it, nwu, st, word, terminal, _ext=boundary_cb):
+            # External hook first: the multihost lockstep must agree the
+            # boundary is clean ACROSS processes before this rank commits
+            # a checkpoint of it (a desync or dead peer aborts the save).
+            _ext(it, nwu, st, word, terminal)
+            sup.boundary_cb(it, nwu, st, word, terminal)
+    if resume:
+        restored = _resume_from_store(sup, mesh_cur, graph_host, meta,
+                                      params, run)
+        if restored is not None:
+            state_cur, graph_cur, start_it, start_nwu = restored
     sup.attach_mesh(mesh_size)
     try:
         with resilience_mod.fetch_guard(watchdog, injector, phase):
             while True:
                 try:
                     res = _attempt(mesh_cur, state_cur, graph_cur,
-                                   start_it, start_nwu, sup.boundary_cb,
+                                   start_it, start_nwu, chained_cb,
                                    injector)
                     break
                 except (resilience_mod.AnomalyRewind,

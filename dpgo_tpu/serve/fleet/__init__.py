@@ -10,10 +10,14 @@ Composes three pieces on top of the single-replica ``SolveServer``:
   across drains and deaths so a replica retirement loses zero sessions;
 * ``aotcache.AOTDiskCache`` / ``AOTExecutable`` — the persistent compile
   cache replicas share, making XLA compilation a fleet-wide one-time
-  cost instead of a per-restart tax.
+  cost instead of a per-restart tax;
+* ``procs.ProcServer`` — the out-of-process replica: the same server
+  surface backed by a CHILD PROCESS speaking the packed-v2 TCP
+  front-end, with heartbeat liveness and real ``kill -9`` semantics.
 """
 
 from .aotcache import AOT_CACHE_SCHEMA_VERSION  # noqa: F401
 from .aotcache import AOTDiskCache, AOTExecutable, entry_identity  # noqa: F401
 from .manager import Replica, ReplicaManager  # noqa: F401
+from .procs import ProcServer, ProcTicket  # noqa: F401
 from .router import FleetRouter, RouterTicket  # noqa: F401

@@ -17,13 +17,15 @@ module only adapts to the wire.
 
 from __future__ import annotations
 
+import json
 import threading
 
 import numpy as np
 
 from .. import obs
 from ..comms.protocol import (DEFAULT_MAX_FRAME_BYTES, ORIGIN_SERVE_CLIENT,
-                              ProtocolError, pack_trace_entries,
+                              ProtocolError, pack_measurements,
+                              pack_trace_entries, unpack_measurements,
                               unpack_trace_entries)
 from ..comms.transport import (TcpTransport, TransportClosed,
                                TransportTimeout, connect_tcp, listen_tcp)
@@ -65,10 +67,128 @@ def handle_request(server: SolveServer, frame: dict) -> dict:
         return reply
 
 
+def _result_reply(res, ticket=None) -> dict:
+    """The success-reply vocabulary shared by the solve ops."""
+    reply = {
+        "ok": np.int8(1),
+        "T": np.asarray(res.T),
+        "cost_history": np.asarray(res.cost_history, np.float64),
+        "grad_norm_history": np.asarray(res.grad_norm_history, np.float64),
+        "iterations": np.int32(res.iterations),
+        "terminated_by": _pack_str(res.terminated_by),
+        # Crash-recovery disclosure: the solve completed from a session
+        # snapshot after a worker death (serve.session).
+        "recovered": np.int8(bool(getattr(res, "recovered", False))),
+    }
+    if ticket is not None and ticket.queue_wait_s is not None:
+        # Out-of-process fleets feed the autoscaler from the REPLICA's
+        # admission queue, so the wait rides the reply.
+        reply["queue_wait_s"] = np.float64(ticket.queue_wait_s)
+    cert = getattr(res, "certificate", None)
+    if cert is not None:
+        from ..models.certify import CERT_STATUS
+
+        reply["certified"] = np.int8(bool(cert.certified))
+        reply["cert_status"] = _pack_str(
+            CERT_STATUS.get(cert.device_verdict, "none"))
+        reply["cert_lambda_min"] = np.float64(cert.lambda_min)
+        reply["cert_tol"] = np.float64(cert.tol)
+    return reply
+
+
+def _shed_reply(server, e: OverCapacityError) -> dict:
+    reply = {"ok": np.int8(0), "shed": np.int8(1),
+             "reason": _pack_str(e.reason), "error": _pack_str(str(e))}
+    if e.reason == "closed":
+        # Disclose a drain/shutdown shed distinctly: the client should
+        # reconnect (to the fleet's next replica), not back off.
+        try:
+            draining = bool(server.status().get("draining"))
+        except Exception:
+            draining = False
+        reply["draining"] = np.int8(draining)
+    return reply
+
+
+def _handle_solve_m(server: SolveServer, frame: dict, ctx) -> dict:
+    """``solve_m``: the in-memory-measurements solve op (the out-of-
+    process fleet's RPC surface).  Same reply vocabulary as ``solve``
+    plus the replica-side queue wait; the request round-trips the full
+    ``Measurements`` batch instead of g2o bytes."""
+    try:
+        meas = unpack_measurements(frame, "meas")
+        if meas is None:
+            raise ValueError("solve_m frame carries no 'meas' payload")
+        num_robots = int(np.asarray(frame["num_robots"]))
+        rank = int(np.asarray(frame["rank"])) if "rank" in frame else 5
+        params = AgentParams(
+            d=meas.d, r=rank, num_robots=num_robots,
+            rel_change_tol=float(np.asarray(frame["rel_change_tol"]))
+            if "rel_change_tol" in frame else 5e-3,
+            certify_mode=_unpack_str(frame["certify_mode"])
+            if "certify_mode" in frame else "off",
+            certify_eta=float(np.asarray(frame["certify_eta"]))
+            if "certify_eta" in frame else 1e-5)
+        req = SolveRequest(
+            meas=meas,
+            num_robots=num_robots,
+            params=params,
+            tenant=_unpack_str(frame["tenant"]) if "tenant" in frame
+            else "default",
+            deadline_s=float(np.asarray(frame["deadline_s"]))
+            if "deadline_s" in frame else None,
+            max_iters=int(np.asarray(frame["max_iters"]))
+            if "max_iters" in frame else None,
+            grad_norm_tol=float(np.asarray(frame["grad_norm_tol"]))
+            if "grad_norm_tol" in frame else 0.1,
+            eval_every=int(np.asarray(frame["eval_every"]))
+            if "eval_every" in frame else 1,
+            trace_ctx=ctx,
+            session_id=_unpack_str(frame["session"])
+            if "session" in frame else None,
+        )
+        ticket = server.submit(req)
+        res = ticket.result()
+    except OverCapacityError as e:
+        return _shed_reply(server, e)
+    except Exception as e:
+        return {"ok": np.int8(0), "error": _pack_str(f"{type(e).__name__}: {e}")}
+    return _result_reply(res, ticket)
+
+
 def _handle_request(server: SolveServer, frame: dict, ctx) -> dict:
     op = _unpack_str(frame["op"]) if "op" in frame else "solve"
     if op == "ping":
         return {"ok": np.int8(1)}
+    if op == "status":
+        # The fleet heartbeat: the replica's operational snapshot, JSON-
+        # encoded (mixed scalar types) inside one uint8 frame entry.
+        try:
+            return {"ok": np.int8(1),
+                    "status": _pack_str(json.dumps(server.status(),
+                                                   default=str))}
+        except Exception as e:
+            return {"ok": np.int8(0),
+                    "error": _pack_str(f"{type(e).__name__}: {e}")}
+    if op == "drain":
+        # Live-migration drain.  The evacuated tickets' WAITERS are this
+        # front-end's own handler threads (blocked in solve ops); finish
+        # them with the structured drain shed so every in-flight RPC
+        # replies "reroute me" instead of hanging — the parent-side
+        # ProcServer owns the real re-admission tickets.
+        try:
+            evacuated = server.drain()
+        except Exception as e:
+            return {"ok": np.int8(0),
+                    "error": _pack_str(f"{type(e).__name__}: {e}")}
+        for t in evacuated:
+            if not t.done():
+                t._finish(exception=OverCapacityError(
+                    "evacuated: replica draining for migration",
+                    reason="closed"))
+        return {"ok": np.int8(1), "evacuated": np.int32(len(evacuated))}
+    if op == "solve_m":
+        return _handle_solve_m(server, frame, ctx)
     if op != "solve":
         return {"ok": np.int8(0), "error": _pack_str(f"unknown op {op!r}")}
     try:
@@ -106,40 +226,10 @@ def _handle_request(server: SolveServer, frame: dict, ctx) -> dict:
             )
         res = server.submit(req).result()
     except OverCapacityError as e:
-        reply = {"ok": np.int8(0), "shed": np.int8(1),
-                 "reason": _pack_str(e.reason), "error": _pack_str(str(e))}
-        if e.reason == "closed":
-            # Disclose a drain/shutdown shed distinctly: the client should
-            # reconnect (to the fleet's next replica), not back off.
-            try:
-                draining = bool(server.status().get("draining"))
-            except Exception:
-                draining = False
-            reply["draining"] = np.int8(draining)
-        return reply
+        return _shed_reply(server, e)
     except Exception as e:  # bad payload, solver failure: structured reply
         return {"ok": np.int8(0), "error": _pack_str(f"{type(e).__name__}: {e}")}
-    reply = {
-        "ok": np.int8(1),
-        "T": np.asarray(res.T),
-        "cost_history": np.asarray(res.cost_history, np.float64),
-        "grad_norm_history": np.asarray(res.grad_norm_history, np.float64),
-        "iterations": np.int32(res.iterations),
-        "terminated_by": _pack_str(res.terminated_by),
-        # Crash-recovery disclosure: the solve completed from a session
-        # snapshot after a worker death (serve.session).
-        "recovered": np.int8(bool(getattr(res, "recovered", False))),
-    }
-    cert = getattr(res, "certificate", None)
-    if cert is not None:
-        from ..models.certify import CERT_STATUS
-
-        reply["certified"] = np.int8(bool(cert.certified))
-        reply["cert_status"] = _pack_str(
-            CERT_STATUS.get(cert.device_verdict, "none"))
-        reply["cert_lambda_min"] = np.float64(cert.lambda_min)
-        reply["cert_tol"] = np.float64(cert.tol)
-    return reply
+    return _result_reply(res)
 
 
 class ServeFrontend:
@@ -250,6 +340,34 @@ class ServeFrontend:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def solve_m_frame(request) -> dict:
+    """The ``solve_m`` request frame for one ``SolveRequest`` — the
+    client half of ``_handle_solve_m`` (the out-of-process fleet's RPC
+    encoder).  ``params`` fields beyond (d, r, rel_change_tol,
+    certify_mode, certify_eta) stay at replica defaults by design: the
+    fleet replicas are homogeneous and the bucket fingerprint only keys
+    on what rides the wire."""
+    frame = {"op": _pack_str("solve_m"),
+             "num_robots": np.int32(request.num_robots),
+             "tenant": _pack_str(request.tenant),
+             "grad_norm_tol": np.float64(request.grad_norm_tol),
+             "eval_every": np.int32(request.eval_every)}
+    frame.update(pack_measurements("meas", request.meas))
+    if request.params is not None:
+        frame["rank"] = np.int32(request.params.r)
+        frame["rel_change_tol"] = np.float64(request.params.rel_change_tol)
+        if request.params.certify_mode != "off":
+            frame["certify_mode"] = _pack_str(request.params.certify_mode)
+            frame["certify_eta"] = np.float64(request.params.certify_eta)
+    if request.max_iters is not None:
+        frame["max_iters"] = np.int32(request.max_iters)
+    if request.deadline_s is not None:
+        frame["deadline_s"] = np.float64(request.deadline_s)
+    if request.session_id is not None:
+        frame["session"] = _pack_str(request.session_id)
+    return frame
 
 
 def solve_g2o(host: str, port: int, g2o, num_robots: int,

@@ -590,3 +590,82 @@ def test_round_bus_readmission_revives_lost_robot():
     bus.close()
     clients[0].close()
     revived.close()
+
+
+# ---------------------------------------------------------------------------
+# connect_tcp: jittered-backoff dial budget (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def _unbound_port():
+    """A port that was just free — nothing listens on it."""
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        return probe.getsockname()[1]
+
+
+def test_connect_tcp_retries_until_listener_binds():
+    """The out-of-process spawn race: the child's listener binds AFTER
+    the parent starts dialing; the backoff budget must absorb it."""
+    import threading
+
+    from dpgo_tpu.comms.transport import connect_tcp
+
+    port = _unbound_port()
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    accepted = []
+
+    def late_bind():
+        time.sleep(0.25)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(1)
+        conn, _ = srv.accept()
+        accepted.append(conn)
+
+    t = threading.Thread(target=late_bind)
+    t.start()
+    try:
+        sock = connect_tcp("127.0.0.1", port,
+                           policy=RetryPolicy(base_delay_s=0.05,
+                                              max_delay_s=0.2))
+        sock.close()
+    finally:
+        t.join(timeout=10)
+        for c in accepted:
+            c.close()
+        srv.close()
+    assert accepted, "the late-bound listener never saw the dial"
+
+
+def test_connect_tcp_exhausted_budget_raises_structured_error():
+    from dpgo_tpu.comms.transport import ConnectError, connect_tcp
+
+    port = _unbound_port()
+    with pytest.raises(ConnectError) as ei:
+        connect_tcp("127.0.0.1", port, attempts=3,
+                    policy=RetryPolicy(base_delay_s=0.005,
+                                       max_delay_s=0.02))
+    e = ei.value
+    assert isinstance(e, ConnectionError)  # callers catching the base see it
+    assert e.host == "127.0.0.1" and e.port == port
+    assert e.attempts == 3 and e.elapsed_s >= 0.0
+    assert "3 connect attempts" in str(e)
+    assert isinstance(e.__cause__, ConnectionError)
+
+
+def test_connect_tcp_backoff_grows_exponentially_with_jitter(monkeypatch):
+    from dpgo_tpu.comms import transport as transport_mod
+    from dpgo_tpu.comms.transport import ConnectError, connect_tcp
+
+    delays = []
+    monkeypatch.setattr(transport_mod.time, "sleep",
+                        lambda s: delays.append(s))
+    with pytest.raises(ConnectError):
+        connect_tcp("127.0.0.1", _unbound_port(), attempts=4,
+                    policy=RetryPolicy(base_delay_s=0.1, max_delay_s=10.0,
+                                       jitter=0.5),
+                    rng=np.random.default_rng(0))
+    # No sleep after the final (failed) attempt.
+    assert len(delays) == 3
+    for d, base in zip(delays, (0.1, 0.2, 0.4)):
+        assert base <= d <= base * 1.5  # doubled base, bounded jitter

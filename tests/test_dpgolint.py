@@ -704,3 +704,84 @@ def test_project_policy_covers_fleet_subpackage():
     findings = run_lint([str(REPO / "dpgo_tpu" / "serve" / "fleet")],
                         project_config())
     assert findings == [], findings
+
+
+def test_seeded_multihost_lockstep_sync_violation(tmp_path):
+    """ISSUE-17 seam: the multi-host lockstep trades ONLY host bytes —
+    ``verdict_sync`` rides the word the driver already fetched, so a
+    ``_host_fetch`` call seeded into a loop inside it must be flagged by
+    DPG003 via the configured ``sync_calls`` list, with file:line."""
+    pdir = tmp_path / "dpgo_tpu" / "parallel"
+    pdir.mkdir(parents=True)
+    src = (REPO / "dpgo_tpu" / "parallel" / "multihost.py").read_text()
+    bad = src.replace(
+        "        self.boundaries += 1\n        run = obs.get_run()",
+        "        for _v in (it,):\n"
+        "            _dbg = _host_fetch(_v)\n"
+        "        self.boundaries += 1\n        run = obs.get_run()")
+    assert bad != src
+    (pdir / "multihost.py").write_text(bad)
+    findings = run_lint([str(tmp_path / "dpgo_tpu")], project_config())
+    hits = [f for f in findings if f.rule == "DPG003"
+            and "sync seam" in f.message]
+    assert hits, findings
+    assert all(f.path.endswith("parallel/multihost.py") and f.line > 0
+               for f in hits)
+
+
+def test_seeded_proc_fleet_heartbeat_sync_violation(tmp_path):
+    """ISSUE-17 seam: the parent-side pump/heartbeat threads are
+    host-only — an ad-hoc ``_rpc`` or a numpy materialization seeded
+    into the heartbeat's poll loop must be flagged by DPG003 under the
+    ``serve/fleet/procs.py`` scope (both classifiers: the configured
+    ``_rpc`` sync seam and the ``np.asarray`` fetcher)."""
+    fdir = tmp_path / "dpgo_tpu" / "serve" / "fleet"
+    fdir.mkdir(parents=True)
+    src = (REPO / "dpgo_tpu" / "serve" / "fleet" / "procs.py").read_text()
+    bad = src.replace(
+        "            st = self._beat_once()",
+        "            _dbg = self._rpc({\"op\": 0}, timeout=0.1)\n"
+        "            _mat = np.asarray(_dbg)\n"
+        "            st = self._beat_once()")
+    assert bad != src
+    (fdir / "procs.py").write_text(bad)
+    findings = run_lint([str(tmp_path / "dpgo_tpu")], project_config())
+    hits = [f for f in findings if f.rule == "DPG003"
+            and f.path.endswith("serve/fleet/procs.py")]
+    assert any("sync seam" in f.message for f in hits), findings
+    assert any("np.asarray" in f.message for f in hits), findings
+    assert all(f.line > 0 for f in hits)
+
+
+def test_measurements_codec_symmetry_under_dpg005(tmp_path):
+    """ISSUE-17 wire vocabulary: ``pack_measurements`` /
+    ``unpack_measurements`` (the columnar payload the out-of-process
+    replicas solve from) participate in DPG005's symmetry check — a
+    pack-only key seeded into the codec is flagged, and the real module
+    stays symmetric under the project policy."""
+    cfg = project_config()
+    for rel in ("dpgo_tpu/parallel/multihost.py",
+                "dpgo_tpu/serve/fleet/procs.py"):
+        # DPG002 via the package globs, DPG004 everywhere (procs.py's
+        # process-table locks carry # guarded-by: annotations), DPG003
+        # via the explicit hot-path scope.
+        assert cfg.applies("DPG002", rel), rel
+        assert cfg.applies("DPG003", rel), rel
+        assert cfg.applies("DPG004", rel), rel
+    opts = cfg.file_options("DPG005", "dpgo_tpu/comms/protocol.py")
+    assert "pack_measurements" in opts["pack_functions"]
+    assert "unpack_measurements" in opts["unpack_functions"]
+
+    cdir = tmp_path / "dpgo_tpu" / "comms"
+    cdir.mkdir(parents=True)
+    src = (REPO / "dpgo_tpu" / "comms" / "protocol.py").read_text()
+    bad = src.replace(
+        '        f"{prefix}:d": np.int32(meas.d),',
+        '        f"{prefix}:zz": np.int32(0),\n'
+        '        f"{prefix}:d": np.int32(meas.d),')
+    assert bad != src
+    (cdir / "protocol.py").write_text(bad)
+    findings = run_lint([str(tmp_path / "dpgo_tpu")], project_config())
+    hits = [f for f in findings if f.rule == "DPG005"]
+    assert any("'*:zz' is packed but never unpacked" in f.message
+               for f in hits), findings

@@ -1,0 +1,321 @@
+"""Out-of-process fleet replicas (``serve.fleet.procs``, ISSUE 17).
+
+Tier-1 pins the parent-side contract without spawning children: the
+``ProcTicket`` future semantics, the structured replica-death error the
+router reroutes on, the ``solve_m`` ``Measurements`` wire round-trip,
+and the front-end's ``status``/``drain``/``solve_m`` ops in-process.
+
+The slow-marked tests run REAL child processes: boot + solve over the
+packed-v2 TCP front-end, a mid-flight ``SIGKILL`` surfacing as a
+reroutable death, drain-for-migration, and a 2-process fleet that loses
+zero sessions across an actual process kill.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dpgo_tpu import obs
+from dpgo_tpu.comms.protocol import unpack_measurements
+from dpgo_tpu.config import AgentParams
+from dpgo_tpu.serve import ReplicaManager, SolveRequest, SolveServer
+from dpgo_tpu.serve.fleet import FleetRouter, ProcServer, ProcTicket
+from dpgo_tpu.serve.fleet.procs import _death_error, _result_from_reply
+from dpgo_tpu.serve.fleet.router import _is_replica_death
+from dpgo_tpu.serve.frontend import (ServeFrontend, _pack_str, _unpack_str,
+                                     handle_request, solve_m_frame)
+from dpgo_tpu.serve.server import OverCapacityError
+
+from synthetic import make_measurements
+
+#: Consensus unreachable + zero gradient tolerance: solves run their
+#: full iteration budget, so kills and drains land mid-flight.
+PARAMS = AgentParams(d=3, r=5, num_robots=2, rel_change_tol=-1.0)
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_run():
+    obs.end_run()
+    yield
+    obs.end_run()
+
+
+def _problem(seed=0, n=24):
+    rng = np.random.default_rng(seed)
+    meas, _ = make_measurements(rng, n=n, d=3, num_lc=8, rot_noise=0.01,
+                                trans_noise=0.01)
+    return meas
+
+
+def _req(meas, sid=None, iters=2, eval_every=2):
+    return SolveRequest(meas=meas, num_robots=2, params=PARAMS,
+                        max_iters=iters, grad_norm_tol=0.0,
+                        eval_every=eval_every, session_id=sid)
+
+
+@pytest.fixture(scope="module")
+def meas():
+    return _problem()
+
+
+@pytest.fixture(scope="module")
+def aot_root(tmp_path_factory, meas):
+    """Shared persistent AOT cache: the parent pays the compile once;
+    every spawned child disk-loads in milliseconds."""
+    root = str(tmp_path_factory.mktemp("aot"))
+    with SolveServer(max_batch=2, batch_window_s=0.0,
+                     aot_cache_dir=root) as srv:
+        srv.solve(_req(meas), timeout=600)
+    return root
+
+
+# ---------------------------------------------------------------------------
+# ProcTicket + death classification (no child processes)
+# ---------------------------------------------------------------------------
+
+def test_proc_ticket_first_finisher_wins():
+    t = ProcTicket(request=None)
+    assert not t.done()
+    t._finish(result="migrated-marker")
+    t._finish(exception=RuntimeError("late pump reply must lose"))
+    assert t.done() and t.result(timeout=1) == "migrated-marker"
+    t2 = ProcTicket(request=None)
+    t2._finish(exception=RuntimeError("boom"))
+    with pytest.raises(RuntimeError, match="boom"):
+        t2.result(timeout=1)
+
+
+def test_death_error_reads_as_replica_death_to_the_router():
+    # The router reroutes on deaths and fails the caller on request
+    # errors; a child's connection dropping mid-RPC must be the former.
+    assert _is_replica_death(_death_error("r0", "ConnectionReset"))
+    assert _is_replica_death(OverCapacityError("gone", reason="closed"))
+    assert not _is_replica_death(ValueError("bad request"))
+    assert not _is_replica_death(
+        OverCapacityError("busy", reason="queue"))
+
+
+def test_result_from_reply_builds_an_rbcd_result_view():
+    reply = {"ok": np.int8(1), "T": np.zeros((3, 4)),
+             "cost_history": np.asarray([2.0, 1.0]),
+             "grad_norm_history": np.asarray([0.5, 0.1]),
+             "iterations": np.int32(2),
+             "terminated_by": _pack_str("max_iters"),
+             "recovered": np.int8(1)}
+    res = _result_from_reply(reply)
+    assert res.iterations == 2 and res.terminated_by == "max_iters"
+    assert res.recovered is True and res.cost_history == [2.0, 1.0]
+
+
+# ---------------------------------------------------------------------------
+# solve_m wire round-trip (no sockets)
+# ---------------------------------------------------------------------------
+
+def test_solve_m_frame_round_trips_measurements(meas):
+    r = _req(meas, sid="sess-7")
+    frame = solve_m_frame(r)
+    m2 = unpack_measurements(frame, "meas")
+    assert m2.d == meas.d and m2.num_poses == meas.num_poses
+    for field in ("r1", "p1", "r2", "p2"):
+        np.testing.assert_array_equal(getattr(m2, field),
+                                      getattr(meas, field))
+    for field in ("R", "t", "kappa", "tau", "weight"):
+        np.testing.assert_allclose(getattr(m2, field),
+                                   getattr(meas, field))
+    np.testing.assert_array_equal(m2.is_known_inlier, meas.is_known_inlier)
+    assert int(np.asarray(frame["rank"])) == PARAMS.r
+    assert float(np.asarray(frame["rel_change_tol"])) == \
+        PARAMS.rel_change_tol
+    assert _unpack_str(frame["session"]) == "sess-7"
+    assert int(np.asarray(frame["max_iters"])) == 2
+
+
+def test_unpack_measurements_absent_prefix_is_none():
+    assert unpack_measurements({}, "meas") is None
+
+
+def test_handle_request_solve_m_solves_in_process(meas):
+    with SolveServer(max_batch=2, batch_window_s=0.0, quantum=64) as srv:
+        reply = handle_request(srv, solve_m_frame(_req(meas)))
+    assert int(np.asarray(reply["ok"])) == 1
+    assert np.asarray(reply["T"]).shape[-1] == 4
+    assert int(np.asarray(reply["iterations"])) == 2
+    assert len(np.asarray(reply["cost_history"])) >= 1
+    # The child's admission wait rides the reply — the out-of-process
+    # fleet's autoscaler signal.
+    assert float(np.asarray(reply["queue_wait_s"])) >= 0.0
+
+
+def test_solve_m_without_payload_is_a_structured_error():
+    with SolveServer(max_batch=2, batch_window_s=0.0) as srv:
+        reply = handle_request(srv, {"op": _pack_str("solve_m"),
+                                     "num_robots": np.int32(2)})
+    assert int(np.asarray(reply["ok"])) == 0
+    assert "meas" in _unpack_str(reply["error"])
+
+
+# ---------------------------------------------------------------------------
+# status / drain front-end ops
+# ---------------------------------------------------------------------------
+
+def test_status_op_returns_replica_snapshot_over_tcp():
+    import json
+
+    from dpgo_tpu.comms.transport import TcpTransport, connect_tcp
+
+    with SolveServer(max_batch=2, batch_window_s=0.0,
+                     replica_id="p7") as srv:
+        with ServeFrontend(srv) as fe:
+            tr = TcpTransport(connect_tcp("127.0.0.1", fe.port),
+                              src="test-client")
+            try:
+                tr.send({"op": _pack_str("status")})
+                reply = tr.recv(timeout=10)
+            finally:
+                tr.close()
+    assert int(np.asarray(reply["ok"])) == 1
+    st = json.loads(_unpack_str(reply["status"]))
+    assert st["accepting"] is True
+    assert st["replica"]["replica_id"] == "p7"
+
+
+def test_drain_op_evacuates_and_finishes_waiters(meas):
+    """The drain op must reply to every blocked in-flight RPC with the
+    structured closed shed (reroute me), not leave handler threads
+    hanging on tickets nobody will finish."""
+    # A wide batch window parks the ticket in admission un-dispatched.
+    with SolveServer(max_batch=2, batch_window_s=60.0) as srv:
+        parked = srv.submit(_req(meas))
+        reply = handle_request(srv, {"op": _pack_str("drain")})
+        assert int(np.asarray(reply["ok"])) == 1
+        assert int(np.asarray(reply["evacuated"])) == 1
+        with pytest.raises(OverCapacityError, match="evacuated") as ei:
+            parked.result(timeout=10)
+        assert ei.value.reason == "closed"
+
+
+# ---------------------------------------------------------------------------
+# Real child processes (slow)
+# ---------------------------------------------------------------------------
+
+def test_proc_server_lifecycle_and_sigkill_mid_flight(meas, aot_root):
+    """One spawn, the whole surface: boot-to-accepting, a solve over the
+    real TCP front-end, the local admission mirror, and a mid-flight
+    ``kill -9`` surfacing as the structured death the router reroutes."""
+    srv = ProcServer(replica_id="p0", max_batch=2, batch_window_s=0.0,
+                     aot_cache_dir=aot_root)
+    try:
+        st = srv.status()
+        assert st["accepting"] is True and st["out_of_process"] is True
+        assert st["child_alive"] is True and st["child_pid"] != os.getpid()
+
+        # The admission mirror sheds synchronously, preserving the
+        # router's rendezvous fall-through.
+        srv.max_queue, saved = 0, srv.max_queue
+        with pytest.raises(OverCapacityError) as ei:
+            srv.submit(_req(meas))
+        assert ei.value.reason == "queue"
+        srv.max_queue = saved
+
+        t = srv.submit(_req(meas))
+        res = t.result(timeout=600)
+        assert res.iterations == 2 and res.terminated_by == "max_iters"
+        assert t.queue_wait_s is not None and t.queue_wait_s >= 0.0
+
+        # SIGKILL with a solve in flight: the pump's connection dies and
+        # the ticket finishes with a reroutable death error.  (A big
+        # iteration budget — the AOT-warm per-round cost is tiny, and
+        # the kill must land mid-solve, not after.)
+        doomed = srv.submit(_req(meas, iters=20000, eval_every=1))
+        srv.kill()
+        with pytest.raises(RuntimeError) as ei:
+            doomed.result(timeout=60)
+        assert _is_replica_death(ei.value)
+
+        st = srv.status()
+        assert st["accepting"] is False and st["child_alive"] is False
+        with pytest.raises(OverCapacityError) as ei:
+            srv.submit(_req(meas))
+        assert ei.value.reason == "closed"
+    finally:
+        srv.close()
+
+
+def test_proc_server_drain_evacuates_for_migration(meas, aot_root,
+                                                  tmp_path):
+    """Live-migration drain against a real child: the in-flight solve
+    leaves a boundary snapshot in the SHARED session store, drain hands
+    the unanswered local ticket back, and the child-side RPC finishes
+    with the closed shed."""
+    sess_root = str(tmp_path / "sessions")
+    srv = ProcServer(replica_id="p1", max_batch=2, batch_window_s=0.0,
+                     aot_cache_dir=aot_root, session_store=sess_root,
+                     session_every=1, resume_sessions=True)
+    try:
+        t = srv.submit(_req(meas, sid="mig-1", iters=20000, eval_every=1))
+        deadline = time.monotonic() + 120
+        sdir = os.path.join(sess_root, "mig-1")
+        while time.monotonic() < deadline:
+            if os.path.isdir(sdir) and any(
+                    f.startswith("snap-") for f in os.listdir(sdir)):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("no boundary snapshot before drain")
+
+        evacuated = srv.drain()
+        assert evacuated == [t]
+        with pytest.raises(OverCapacityError) as ei:
+            t.result(timeout=60)
+        assert ei.value.reason == "closed"
+        st = srv.status()
+        assert st["draining"] is True and st["accepting"] is False
+        with pytest.raises(OverCapacityError):
+            srv.submit(_req(meas))
+    finally:
+        srv.close()
+
+
+def test_proc_fleet_kill9_loses_zero_sessions(meas, aot_root, tmp_path):
+    """The fleet acceptance across REAL process boundaries: a
+    2-process fleet takes long-running sessions, one replica is
+    SIGKILLed mid-solve, and every session completes — migrated via the
+    shared snapshot store — while the manager respawns a fresh process."""
+    sess_root = str(tmp_path / "sessions")
+
+    def make_server(rid):
+        return ProcServer(replica_id=rid, max_batch=2,
+                          batch_window_s=0.02, aot_cache_dir=aot_root,
+                          session_store=sess_root, session_every=1,
+                          resume_sessions=True)
+
+    mgr = ReplicaManager(make_server, min_replicas=2,
+                         monitor_interval_s=0.2)
+    router = FleetRouter(mgr)
+    try:
+        tickets = {f"soak-{i}": router.submit(
+            _req(meas, sid=f"soak-{i}", iters=600, eval_every=1))
+            for i in range(3)}
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            import glob
+            if glob.glob(os.path.join(sess_root, "*", "snap-*.npz")):
+                break
+            time.sleep(0.1)
+        time.sleep(1.0)
+        victim = mgr.replicas()[0].replica_id
+        mgr.kill_replica(victim)
+        # Zero lost: every session completes its budget — a migrated
+        # one reports only its post-resume rounds, so the gate is
+        # completion, not a raw iteration count.
+        for sid, t in tickets.items():
+            res = t.result(timeout=900)
+            assert res.terminated_by == "max_iters", sid
+        st = mgr.status()
+        assert st["respawns"] >= 1
+        assert router.migrations >= 1
+    finally:
+        router.close()

@@ -207,6 +207,30 @@ def test_checked_in_records_cover_every_round_and_gate_clean():
     assert regress.trend_gate(led)["rc"] == 0
 
 
+def test_checked_in_fleet_record_pins_out_of_process_scaling():
+    """ISSUE 17 acceptance pin: the checked-in ``FLEET_r01.json`` came
+    from the OUT-OF-PROCESS bench (``bench_fleet.py --procs``) — real OS
+    processes behind the packed-v2 TCP front-end, a real SIGKILL in the
+    soak — and it holds the same floors as the in-process fleet: >= 1.7x
+    1->2 scaling, zero lost sessions, a respawned process, and a 0s-XLA
+    warm restart."""
+    from tools import check_bench_floor
+
+    with open("/root/repo/FLEET_r01.json") as fh:
+        rec = json.load(fh)
+    assert rec["out_of_process"] is True
+    assert rec["scaling_1_to_2"] >= 1.7
+    assert rec["soak"]["lost"] == 0
+    assert rec["soak"]["migrations"] >= 1
+    assert rec["soak"]["respawns"] >= 1
+    assert rec["cold_start"]["compile_seconds_total"] == 0
+    check_bench_floor.check_fleet(rec)  # exits 1 on any floor violation
+    row = load_ledger("/root/repo").family_rows("FLEET")[0]
+    assert row["ok"] and row["round"] == 1
+    assert row["value"] == pytest.approx(rec["qps"][-1]["qps"])
+    assert row["extras"]["scaling_1_to_2"] == rec["scaling_1_to_2"]
+
+
 def test_report_ledger_cli_roundtrip(tmp_path):
     """``report --ledger ROOT`` renders the table (and ``--json`` emits
     the machine form check_bench_floor validates); ``regress --ledger``

@@ -105,6 +105,79 @@ def test_corrupt_newest_falls_back_to_previous(tmp_path, corrupt):
     assert store.load_newest("sess").iteration == 10
 
 
+@pytest.mark.parametrize("kill_at", ["mid_write", "pre_replace"])
+def test_sigkill_mid_save_leaves_store_loadable(tmp_path, kill_at):
+    """A writer SIGKILLed MID-SAVE — the out-of-process fleet's failure
+    mode: a replica child dies with a snapshot half-written.  The
+    tmp+rename discipline means the torn artifact is always a ``.tmp``
+    the snapshot regex never admits: the previous boundary keeps
+    loading, nothing needs quarantining, and the next writer simply
+    reuses the name.  This extends the 3-way corruption matrix with an
+    ACTUAL ``kill -9`` (rc -9), not a simulated truncation."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import textwrap
+
+    meas = _problem()
+    st = _solved_state(meas)
+    store = SessionStore(str(tmp_path / "s"), keep=3)
+    store.save("sess", st, iteration=10)
+
+    script = textwrap.dedent(f"""
+        import io, os, signal
+        import numpy as np
+        from dpgo_tpu.serve import session as session_mod
+        from dpgo_tpu.serve.session import SessionStore
+
+        store = SessionStore({str(tmp_path / "s")!r}, keep=3)
+        snap = store.load_newest("sess")
+
+        if {kill_at!r} == "mid_write":
+            real = np.savez_compressed
+
+            def torn(fh, **arrays):
+                buf = io.BytesIO()
+                real(buf, **arrays)
+                data = buf.getvalue()
+                fh.write(data[: len(data) // 2])
+                fh.flush()
+                os.fsync(fh.fileno())
+                os.kill(os.getpid(), signal.SIGKILL)
+
+            session_mod.np.savez_compressed = torn
+        else:  # pre_replace: full tmp written+fsynced, rename never ran
+
+            def boom(src, dst):
+                os.kill(os.getpid(), signal.SIGKILL)
+
+            session_mod.os.replace = boom
+
+        store.save("sess", snap.state, iteration=20)
+        raise SystemExit("unreachable: the save must have died")
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH="/root/repo" + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+
+    sdir = tmp_path / "s" / "sess"
+    names = sorted(p.name for p in sdir.iterdir())
+    assert "snap-00000010.npz" in names
+    assert "snap-00000020.npz" not in names
+    assert "snap-00000020.npz.tmp" in names  # the torn artifact
+    assert store.load_newest("sess").iteration == 10
+    # The next writer (the respawned replica) reuses the name; the
+    # stale tmp is overwritten, never read.
+    store.save("sess", st, iteration=20)
+    assert store.load_newest("sess").iteration == 20
+    assert "snap-00000020.npz.tmp" not in sorted(
+        p.name for p in sdir.iterdir())
+
+
 def test_v1_snapshot_loads_under_v2_reader(tmp_path):
     """Schema back-compat (ISSUE 14): a v1-era snapshot (no mesh tags)
     is a strict subset of v2 and must keep loading — mesh_shape /

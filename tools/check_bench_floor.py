@@ -248,6 +248,7 @@ def check_fleet(rec: dict) -> None:
     elif {a["replicas"] for a in qps} >= {1, 2}:
         fail("qps arms cover 1 and 2 replicas but scaling_1_to_2 missing")
     soak = rec["soak"]
+    oop = bool(rec.get("out_of_process"))
     if not soak.get("skipped"):
         if soak.get("lost") != 0:
             fail(f"soak lost sessions: {soak}")
@@ -255,6 +256,14 @@ def check_fleet(rec: dict) -> None:
             fail(f"soak recorded no migrations: {soak}")
         if not _num(soak.get("scale_ups")) or soak["scale_ups"] < 1:
             fail(f"soak recorded no autoscale-up: {soak}")
+        if oop:
+            # Out-of-process soak: the kill was a real SIGKILL of a
+            # replica OS process — the manager must have respawned one.
+            if not _num(soak.get("respawns")) or soak["respawns"] < 1:
+                fail(f"out-of-process soak recorded no respawn after the "
+                     f"SIGKILL: {soak}")
+            if not soak.get("killed"):
+                fail(f"out-of-process soak names no killed replica: {soak}")
     cold = rec["cold_start"]
     if not cold.get("skipped"):
         if cold.get("compile_seconds_total") != 0:
@@ -264,6 +273,7 @@ def check_fleet(rec: dict) -> None:
         if not _num(cold.get("disk_hits")) or cold["disk_hits"] < 1:
             fail(f"cold-start arm shows no disk hits: {cold}")
     print("bench floor gate: PASS — FLEET ok ("
+          + ("out-of-process, " if oop else "")
           + ", ".join(f"{a['replicas']}r={a['qps']}/s" for a in qps)
           + (f", scaling {scaling}" if scaling is not None else "")
           + ("" if soak.get("skipped") else
@@ -313,8 +323,13 @@ def main() -> None:
                 text = f.read()
         else:
             text = sys.stdin.read()
-        # bench.py prints exactly one JSON line last; tolerate log lines.
-        rec = json.loads(text.strip().splitlines()[-1])
+        # Checked-in records are whole-file (pretty-printed) JSON; bench
+        # stdout prints exactly one JSON line last — tolerate log lines
+        # by falling back to the final line.
+        try:
+            rec = json.loads(text)
+        except ValueError:
+            rec = json.loads(text.strip().splitlines()[-1])
     except (OSError, ValueError, IndexError) as e:
         print(f"bench floor gate: unreadable record ({e})")
         sys.exit(2)

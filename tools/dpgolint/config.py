@@ -79,6 +79,8 @@ def project_config() -> Config:
                 "dpgo_tpu/parallel/sharded.py",
                 "dpgo_tpu/parallel/certify.py",
                 "dpgo_tpu/parallel/resilience.py",
+                "dpgo_tpu/parallel/multihost.py",
+                "dpgo_tpu/serve/fleet/procs.py",
             ],
             # DPG004 is annotation-driven (# guarded-by) — run everywhere;
             # files without annotations produce nothing.
@@ -191,6 +193,29 @@ def project_config() -> Config:
                                           "make_sharded_certificate"],
                         "sync_calls": ["_host_fetch"],
                     },
+                    # The multi-host lockstep (ISSUE 17): verdict_sync
+                    # rides the ONE word the driver already fetched — it
+                    # trades host bytes over the coordination service and
+                    # must never touch the device; a fetch creeping into
+                    # its publish/cross-check loop (or into the per-round
+                    # boundary_cb it hangs off) would multiply the
+                    # cross-process sync rate past 100/K.
+                    "dpgo_tpu/parallel/multihost.py": {
+                        "hot_functions": ["verdict_sync", "boundary_cb",
+                                          "run_worker"],
+                        "sync_calls": ["_host_fetch"],
+                    },
+                    # The out-of-process fleet (ISSUE 17): the pump and
+                    # heartbeat threads sit on the parent's request path —
+                    # host-only by design (the device lives in the child),
+                    # so any numpy materialization or ad-hoc ``_rpc`` in
+                    # their loops is a new blocking stall behind a live
+                    # replica socket.
+                    "dpgo_tpu/serve/fleet/procs.py": {
+                        "hot_functions": ["_pump", "_heartbeat_loop",
+                                          "submit"],
+                        "sync_calls": ["_rpc"],
+                    },
                 },
             },
             "DPG005": {
@@ -198,10 +223,12 @@ def project_config() -> Config:
                     "dpgo_tpu/comms/protocol.py": {
                         "pack_functions": ["pack_pose_dict",
                                            "pack_pose_arrays",
-                                           "pack_trace_entries"],
+                                           "pack_trace_entries",
+                                           "pack_measurements"],
                         "unpack_functions": ["unpack_pose_dict",
                                              "unpack_pose_arrays",
-                                             "unpack_trace_entries"],
+                                             "unpack_trace_entries",
+                                             "unpack_measurements"],
                     },
                     "dpgo_tpu/comms/reliable.py": {
                         "pack_functions": ["send"],
