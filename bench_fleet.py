@@ -190,48 +190,71 @@ def arm_soak(meas, sessions, soak_iters, aot_root, procs=False) -> dict:
     """Concurrent live sessions with a mid-soak kill AND a mid-soak
     autoscale-up; zero sessions may be lost.  With ``procs=True`` the
     kill is an actual ``SIGKILL`` of a replica OS process and sessions
-    migrate across process boundaries via the shared snapshot store."""
+    migrate across process boundaries via the shared snapshot store.
+
+    The whole arm runs inside its own telemetry scope with a
+    fast-cadence ``ResourceSampler``, so the record carries the
+    flat-memory soak gate (``obs.regress.soak_memory_gate``) alongside
+    the lost/migration tallies — the "memory held flat over the soak"
+    claim as data, not prose."""
+    from dpgo_tpu.obs import fleetobs
+    from dpgo_tpu.obs.regress import soak_memory_gate
+
     sess_root = tempfile.mkdtemp(prefix="fleet-sess-")
+    soak_run = tempfile.mkdtemp(prefix="fleet-soak-run-")
     # queue_wait_slo_s=0 => every completed request reads as burning the
     # wait budget, so the autoscaler provably trips mid-soak.
-    router = build_fleet(2, aot_root, sess_root=sess_root, max_replicas=3,
-                         queue_wait_slo_s=0.0, scale_cooldown_s=0.5,
-                         min_scale_observations=2, scale_window_s=60.0,
-                         batch_window_s=0.02, max_batch=2, procs=procs)
-    mgr = router.manager
-    try:
-        tickets = {f"soak-{i}": router.submit(
-            req(meas, sid=f"soak-{i}", iters=soak_iters, eval_every=1))
-            for i in range(sessions)}
-        # Let solves get in flight AND leave at least one boundary
-        # snapshot before the kill (out-of-process replicas pay a child
-        # boot first, so poll the store instead of a fixed sleep).
-        deadline = time.monotonic() + (120.0 if procs else 1.5)
-        while time.monotonic() < deadline:
-            import glob as _glob
-            if _glob.glob(os.path.join(sess_root, "*", "snap-*.npz")):
-                break
-            time.sleep(0.25)
-        time.sleep(1.5)
-        victim = mgr.replicas()[0].replica_id
-        mgr.kill_replica(victim)
-        log(f"[soak] killed {victim} mid-soak")
-        lost, done = [], 0
-        for sid, t in tickets.items():
-            try:
-                t.result(timeout=900)
-                done += 1
-            except Exception as e:
-                log(f"[soak] LOST {sid}: {type(e).__name__}: {e}")
-                lost.append(sid)
-        st = mgr.status()
-        migrations = router.migrations
-    finally:
-        router.close()
+    with obs.run_scope(soak_run):
+        sampler = fleetobs.start_resource_sampler(interval_s=0.25,
+                                                  replica="bench")
+        router = build_fleet(2, aot_root, sess_root=sess_root,
+                             max_replicas=3,
+                             queue_wait_slo_s=0.0, scale_cooldown_s=0.5,
+                             min_scale_observations=2, scale_window_s=60.0,
+                             batch_window_s=0.02, max_batch=2, procs=procs)
+        mgr = router.manager
+        try:
+            tickets = {f"soak-{i}": router.submit(
+                req(meas, sid=f"soak-{i}", iters=soak_iters, eval_every=1))
+                for i in range(sessions)}
+            # Let solves get in flight AND leave at least one boundary
+            # snapshot before the kill (out-of-process replicas pay a
+            # child boot first, so poll the store instead of a fixed
+            # sleep).
+            deadline = time.monotonic() + (120.0 if procs else 1.5)
+            while time.monotonic() < deadline:
+                import glob as _glob
+                if _glob.glob(os.path.join(sess_root, "*", "snap-*.npz")):
+                    break
+                time.sleep(0.25)
+            time.sleep(1.5)
+            victim = mgr.replicas()[0].replica_id
+            mgr.kill_replica(victim)
+            log(f"[soak] killed {victim} mid-soak")
+            lost, done = [], 0
+            for sid, t in tickets.items():
+                try:
+                    t.result(timeout=900)
+                    done += 1
+                except Exception as e:
+                    log(f"[soak] LOST {sid}: {type(e).__name__}: {e}")
+                    lost.append(sid)
+            st = mgr.status()
+            migrations = router.migrations
+        finally:
+            router.close()
+            if sampler is not None:
+                sampler.close()
+    gate = soak_memory_gate(soak_run)
     out = {"sessions": sessions, "completed": done, "lost": len(lost),
            "lost_ids": lost, "killed": victim, "migrations": migrations,
            "scale_ups": st["scale_ups"], "respawns": st["respawns"],
-           "replicas_end": len(st["pool"])}
+           "replicas_end": len(st["pool"]),
+           "rss_flat": not gate["regressions"],
+           "rss_gate": {who: {k: s.get(k) for k in
+                              ("samples", "head_median", "tail_median",
+                               "bound", "skipped", "regressed")}
+                        for who, s in gate["series"].items()}}
     log(f"[soak] {out}")
     return out
 
@@ -306,7 +329,8 @@ def main(argv=None) -> int:
     by_n = {a["replicas"]: a["qps"] for a in qps}
     scaling = round(by_n[2] / by_n[1], 3) if 1 in by_n and 2 in by_n \
         else None
-    ok = (soak.get("skipped") or soak["lost"] == 0) \
+    ok = (soak.get("skipped")
+          or (soak["lost"] == 0 and soak.get("rss_flat", True))) \
         and (cold.get("skipped") or cold["compile_seconds_total"] == 0.0)
     rec = metric_record(
         "fleet_qps",

@@ -427,6 +427,34 @@ ORIGIN_BUS_HUB = -1
 ORIGIN_UNKNOWN = -2
 ORIGIN_SERVE_CLIENT = -3   # serve front-end client (solve_g2o)
 ORIGIN_SERVE_SERVER = -4   # serve server/worker side
+ORIGIN_FLEET_PARENT = -5   # fleet launcher/manager parent process
+
+#: Fleet-plane actor id bands (ISSUE 20): every process on a merged
+#: generation timeline identifies itself with one id.  Robots stay
+#: non-negative and the serving sentinels keep -1..-5; multihost ranks
+#: occupy -100-rank and out-of-process replicas -200-index, so
+#: ``obs.timeline`` can give each process its own track and the clock
+#: aligner can tell the launcher, every rank, and every replica apart.
+_MH_RANK_BASE = 100
+_PROC_REPLICA_BASE = 200
+
+
+def mh_rank_actor(rank: int) -> int:
+    """Timeline actor id of multihost rank ``rank`` (rank 0 -> -100)."""
+    return -(_MH_RANK_BASE + int(rank))
+
+
+def proc_replica_actor(replica_id) -> int:
+    """Timeline actor id of an out-of-process replica.  Accepts an index
+    or a replica-id string (``"r3"`` -> -203); non-numeric ids hash into
+    the band deterministically."""
+    if isinstance(replica_id, (int, np.integer)):
+        idx = int(replica_id)
+    else:
+        digits = "".join(ch for ch in str(replica_id) if ch.isdigit())
+        idx = int(digits) if digits else \
+            sum(str(replica_id).encode("utf-8")) % 97
+    return -(_PROC_REPLICA_BASE + abs(idx))
 
 
 def pack_trace_entries(trace_id: int, span_id: int, robot: int) -> dict:
@@ -455,6 +483,31 @@ def unpack_trace_entries(frame: dict, pop: bool = True):
         ts = np.asarray(ts, np.float64).ravel()
         return (int(ids[0]), int(ids[1]), int(ids[2]),
                 float(ts[0]), float(ts[1]))
+    except (ValueError, IndexError, TypeError):
+        return None
+
+
+def attach_clock(frame: dict, origin: int) -> dict:
+    """Stamp ``frame`` with the channel-level clock entry — the SAME
+    float64 triplet ``ReliableChannel`` attaches ([origin, t_send_mono,
+    t_send_wall] under ``CLOCK_KEY``) — and return it.  Callers guard on
+    ``obs.get_run()``: with telemetry off no stamp is attached and the
+    wire stays byte-identical."""
+    frame[CLOCK_KEY] = np.asarray(
+        [float(origin), time.monotonic(), time.time()], np.float64)
+    return frame
+
+
+def pop_clock(frame: dict):
+    """``(origin, t_send_mono, t_send_wall)`` popped off a stamped frame,
+    else None.  Always pops (mixed telemetry-on/off peers interoperate);
+    a mangled stamp is dropped, never fatal."""
+    ts = frame.pop(CLOCK_KEY, None)
+    if ts is None:
+        return None
+    try:
+        ts = np.asarray(ts, np.float64).ravel()
+        return (int(ts[0]), float(ts[1]), float(ts[2]))
     except (ValueError, IndexError, TypeError):
         return None
 

@@ -102,6 +102,132 @@ def to_prometheus_text(registry) -> str:
     return "\n".join(lines) + "\n"
 
 
+_NAME_RE = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+
+
+def _split_sample_line(line: str):
+    """``(name, labels_text_or_None, rest)`` of one exposition sample
+    line, or None when the line does not parse as a sample."""
+    import re
+
+    m = re.match(rf"^({_NAME_RE})(\{{.*\}})?\s+(\S+)(\s+-?\d+)?\s*$",
+                 line)
+    if m is None:
+        return None
+    end = m.end(2) if m.group(2) else m.end(1)
+    return m.group(1), m.group(2), line[end:]
+
+
+def validate_prometheus_text(text: str) -> dict:
+    """Line-validate a text exposition (format 0.0.4): every line must be
+    a ``# HELP``/``# TYPE``/comment line, blank, or a well-formed sample
+    with a finite/±Inf/NaN value.  Raises ``ValueError`` naming the first
+    offending line; returns ``{"families": n, "samples": n}`` — the check
+    the fleet-obs CI smoke runs on the aggregated scrape."""
+    families: set = set()
+    samples = 0
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                families.add(parts[2])
+            continue
+        parsed = _split_sample_line(line)
+        if parsed is None:
+            raise ValueError(f"malformed exposition line {ln}: {line!r}")
+        value = parsed[2].split()[0]
+        if value not in ("+Inf", "-Inf", "NaN"):
+            try:
+                float(value)
+            except ValueError:
+                raise ValueError(
+                    f"non-numeric sample value on line {ln}: {line!r}")
+        samples += 1
+    return {"families": len(families), "samples": samples}
+
+
+def relabel_prometheus_text(text: str, extra: dict) -> str:
+    """Inject ``extra`` labels into every sample line of an exposition
+    (comment/blank lines pass through) — how a fleet aggregator tags each
+    child replica's scrape with ``replica="rN"`` before merging."""
+    inject = _fmt_labels(extra)
+    out = []
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("#"):
+            out.append(line)
+            continue
+        parsed = _split_sample_line(line)
+        if parsed is None:
+            out.append(line)   # pass through; validation flags it
+            continue
+        name, labels, rest = parsed
+        if labels:
+            merged = _fmt_labels(
+                _parse_labels(labels), extra)
+            out.append(f"{name}{merged}{rest}")
+        else:
+            out.append(f"{name}{inject}{rest}")
+    return "\n".join(out)
+
+
+def _parse_labels(labels_text: str) -> dict:
+    """Parse ``{a="b",c="d"}`` back into a dict (escapes unwound) — only
+    used to merge aggregator labels into already-rendered lines."""
+    import re
+
+    out = {}
+    for m in re.finditer(rf'({_NAME_RE})="((?:\\.|[^"\\])*)"',
+                         labels_text):
+        v = (m.group(2).replace('\\"', '"').replace("\\n", "\n")
+             .replace("\\\\", "\\"))
+        out[m.group(1)] = v
+    return out
+
+
+def merge_prometheus_texts(parts: dict, label: str = "replica") -> str:
+    """One exposition from many: each value of ``parts`` (keyed by
+    replica id) is relabeled with ``label="<id>"`` and merged grouped by
+    family — one ``# HELP``/``# TYPE`` header per family (first writer
+    wins; the format forbids duplicates) followed by every contributor's
+    samples, so strict scrapers see no interleaved families.  A falsy
+    key ("" — the aggregator's own registry) passes through unlabeled:
+    its samples already carry whatever identity they need."""
+    order: list[str] = []
+    headers: dict = {}
+    samples: dict = {}
+    for rid in sorted(parts):
+        text = relabel_prometheus_text(parts[rid], {label: rid}) \
+            if rid else parts[rid]
+        fam = ""
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("#"):
+                toks = line.split(None, 3)
+                if len(toks) >= 3 and toks[1] in ("HELP", "TYPE"):
+                    fam = toks[2]
+                    if fam not in headers:
+                        headers[fam] = []
+                        samples[fam] = []
+                        order.append(fam)
+                    if toks[1] not in {h.split(None, 3)[1]
+                                       for h in headers[fam]}:
+                        headers[fam].append(line)
+                continue
+            if fam not in samples:
+                headers[fam] = []
+                samples[fam] = []
+                order.append(fam)
+            samples[fam].append(line)
+    out: list[str] = []
+    for fam in order:
+        out.extend(headers[fam])
+        out.extend(samples[fam])
+    return "\n".join(out) + ("\n" if out else "")
+
+
 def write_tensorboard_scalars(run_dir: str, events: list[dict],
                               logdir: str | None = None) -> str | None:
     """Export the stream's ``metric`` events as TensorBoard scalars.

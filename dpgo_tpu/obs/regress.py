@@ -384,6 +384,106 @@ def run_trend(root: str, rtol: float = 0.10,
     return int(gate["rc"])
 
 
+#: Flat-memory soak gate defaults (ISSUE 20): the head/tail medians of a
+#: soak window's ``process_rss_bytes`` series must agree within
+#: ``SOAK_RSS_RTOL`` plus an absolute slack — allocator warmup and JIT
+#: cache growth land in the slack; an unbounded leak does not.
+SOAK_RSS_RTOL = 0.15
+SOAK_RSS_SLACK_BYTES = 64 << 20
+SOAK_MIN_SAMPLES = 8
+
+
+def soak_memory_gate(run_dir: str, metric: str = "process_rss_bytes",
+                     rtol: float = SOAK_RSS_RTOL,
+                     slack: float = SOAK_RSS_SLACK_BYTES,
+                     window: int = 4,
+                     min_samples: int = SOAK_MIN_SAMPLES) -> dict:
+    """Flat-memory trend check over one soak run's ``ResourceSampler``
+    series (ROADMAP item 5's "memory held flat" acceptance, made
+    checkable).
+
+    The series' trailing-``window`` median must stay within
+    ``head_median * (1 + rtol) + slack`` of its leading-``window``
+    median.  Multiple labeled series (one per replica/rank) gate
+    independently — any replica leaking fails the run.  Too few samples
+    is a SKIP (ok, flagged), not a pass pretending to be evidence."""
+    run = load_run(run_dir)
+    series: dict = {}
+    for ev in run["events"]:
+        if ev.get("event") != "metric" or ev.get("metric") != metric:
+            continue
+        v = ev.get("value")
+        if not isinstance(v, (int, float)) or not math.isfinite(v):
+            continue
+        who = str(ev.get("replica", ev.get("rank", "self")))
+        series.setdefault(who, []).append(float(v))
+    out: dict = {"run_dir": run_dir, "metric": metric, "rtol": rtol,
+                 "slack_bytes": slack, "series": {}, "regressions": []}
+    for who, vals in sorted(series.items()):
+        if len(vals) < min_samples:
+            out["series"][who] = {"samples": len(vals), "skipped": True,
+                                  "reason": f"only {len(vals)} samples "
+                                            f"(< {min_samples})"}
+            continue
+        head = tail_band(vals[:window], window)["median"]
+        tail = tail_band(vals, window)["median"]
+        bound = head * (1.0 + rtol) + slack
+        regressed = tail > bound
+        out["series"][who] = {
+            "samples": len(vals), "skipped": False,
+            "head_median": head, "tail_median": tail, "bound": bound,
+            "growth_bytes": tail - head, "regressed": regressed}
+        if regressed:
+            out["regressions"].append(who)
+    if not series:
+        out["skipped"] = True
+        out["reason"] = f"no {metric!r} samples in {run_dir} " \
+                        "(sampler off or telemetry-off run)"
+    out["rc"] = 2 if out["regressions"] else 0
+    return out
+
+
+def render_soak(gate: dict) -> str:
+    lines = [f"== flat-memory soak gate: {gate['run_dir']} "
+             f"({gate['metric']}) =="]
+    if gate.get("skipped"):
+        lines.append(f"SKIPPED: {gate['reason']}")
+        return "\n".join(lines)
+    for who, s in sorted(gate["series"].items()):
+        if s.get("skipped"):
+            lines.append(f"  {who:<16} SKIPPED ({s['reason']})")
+            continue
+        mb = 1.0 / (1 << 20)
+        verdict = "LEAKING" if s["regressed"] else "flat"
+        lines.append(
+            f"  {who:<16} {s['samples']:>4} samples  "
+            f"head {s['head_median'] * mb:8.1f}MiB -> "
+            f"tail {s['tail_median'] * mb:8.1f}MiB "
+            f"({s['growth_bytes'] * mb:+8.1f}MiB)  {verdict}")
+    if gate["regressions"]:
+        lines.append("RESULT: MEMORY NOT FLAT in "
+                     + ", ".join(gate["regressions"]))
+    else:
+        lines.append("RESULT: memory held flat")
+    return "\n".join(lines)
+
+
+def run_soak(run_dir: str, rtol: float | None = None,
+             json_out: bool = False) -> int:
+    """CLI body for ``--soak``: gate, print, return exit code."""
+    try:
+        gate = soak_memory_gate(
+            run_dir, rtol=SOAK_RSS_RTOL if rtol is None else rtol)
+    except (ValueError, OSError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if json_out:
+        print(json.dumps(gate))
+    else:
+        print(render_soak(gate))
+    return int(gate["rc"])
+
+
 def run_compare(dir_a: str, dir_b: str, rtol: float = 0.05,
                 json_out: bool = False, allow_mismatch: bool = False) -> int:
     """CLI body shared by ``report --compare`` and ``python -m
@@ -415,8 +515,16 @@ def main(argv: list[str] | None = None) -> int:
                     help="cross-round trend gate over the BENCH_r*/"
                          "MULTICHIP_r*/FLEET_r* records under ROOT "
                          "instead of a pairwise run compare")
+    ap.add_argument("--soak", metavar="RUN_DIR",
+                    help="flat-memory gate over one soak run's "
+                         "ResourceSampler series (process_rss_bytes "
+                         "head vs tail median; exit 2 on growth)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
+    if args.soak is not None:
+        if args.run_a or args.run_b:
+            ap.error("--soak takes no extra run directories")
+        return run_soak(args.soak, rtol=args.rtol, json_out=args.json)
     if args.ledger is not None:
         if args.run_a or args.run_b:
             ap.error("--ledger takes no run directories")

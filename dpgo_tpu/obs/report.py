@@ -596,11 +596,50 @@ def render_statusz(status: dict) -> str:
     return "\n".join(lines)
 
 
+def render_fleet_statusz(payload: dict) -> str:
+    """Human rendering of a fleet-level ``/statusz`` payload (the JSON
+    ``obs.fleetobs.FleetSidecar`` serves): one line per replica with
+    unreachable/dead replicas MARKED — a partial fleet is still a
+    report, never an error."""
+    lines = ["== live fleet status =="]
+    replicas = payload.get("replicas") or {}
+    up = sum(1 for e in replicas.values() if e.get("reachable"))
+    lines.append(f"replicas: {up}/{len(replicas)} reachable")
+    for rid, entry in sorted(replicas.items()):
+        st = entry.get("status") or {}
+        if not entry.get("reachable"):
+            why = entry.get("error") or (
+                "closed" if st.get("closed") else "no status")
+            lines.append(f"  replica {rid}: ** UNREACHABLE ** ({why})")
+            continue
+        bits = [f"queue {st.get('queue_depth', 0)}"]
+        if st.get("draining"):
+            bits.append("DRAINING")
+        if not st.get("accepting", True):
+            bits.append("not accepting")
+        if "heartbeat_misses" in st and st["heartbeat_misses"]:
+            bits.append(f"{st['heartbeat_misses']} missed heartbeats")
+        bits.append(f"{st.get('requests_served', 0)} served")
+        lines.append(f"  replica {rid}: " + ", ".join(str(b)
+                                                      for b in bits))
+    fleet = payload.get("fleet") or {}
+    for k in ("error",):
+        if fleet.get(k):
+            lines.append(f"  fleet {k}: {fleet[k]}")
+    return "\n".join(lines)
+
+
 def live_report(target: str, json_out: bool = False, timeout: float = 5.0,
-                out=None) -> int:
+                out=None, fleet: bool = False) -> int:
     """``--live HOST:PORT``: scrape a running server's ``/statusz``
     sidecar and render it.  rc 0 on success, 2 on unreachable/garbage
-    (same contract as the run-dir error paths)."""
+    (same contract as the run-dir error paths).
+
+    With ``fleet=True`` (or a payload that is recognizably fleet-level)
+    the target is an aggregated ``FleetSidecar`` endpoint: replicas that
+    died or dropped mid-scrape render MARKED inside a partial fleet
+    view with rc 0 — only the aggregate endpoint itself being
+    unreachable is rc 2."""
     import urllib.error
     import urllib.request
 
@@ -619,8 +658,11 @@ def live_report(target: str, json_out: bool = False, timeout: float = 5.0,
             e.close()
         print(f"cannot scrape {url}: {e}", file=sys.stderr)
         return 2
+    is_fleet = fleet or ("replicas" in status and "fleet" in status)
     if json_out:
         print(json.dumps(status), file=out)
+    elif is_fleet:
+        print(render_fleet_statusz(status), file=out)
     else:
         print(render_statusz(status), file=out)
     return 0
@@ -1023,6 +1065,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--live", metavar="HOST:PORT",
                     help="scrape a running serve sidecar's /statusz "
                          "(--metrics-port) and render the live status")
+    ap.add_argument("--fleet", action="store_true",
+                    help="with --live: the target is a fleet-level "
+                         "aggregated /statusz (obs.fleetobs."
+                         "FleetSidecar); unreachable replicas render "
+                         "marked in a partial view, rc 0")
     ap.add_argument("--ledger", nargs="?", const=".", metavar="ROOT",
                     help="render the cross-round perf ledger over the "
                          "BENCH_r*/MULTICHIP_r*/FLEET_r* records under "
@@ -1030,7 +1077,8 @@ def main(argv: list[str] | None = None) -> int:
                          "record tools/check_bench_floor.py validates")
     args = ap.parse_args(argv)
     if args.live:
-        return live_report(args.live, json_out=args.json)
+        return live_report(args.live, json_out=args.json,
+                           fleet=args.fleet)
     if args.ledger is not None:
         from .ledger import load_ledger
 

@@ -95,7 +95,16 @@ def load_stream(path: str) -> Stream:
         if e.get("event") == "span" and "robot" in e:
             robots.add(int(e["robot"]))
             tally[int(e["robot"])] += 1
-    home = max(tally, key=tally.get) if tally else None
+    # Home preference: a fleet-plane actor (multihost rank <= -100 /
+    # procs replica <= -200 / launcher -5 — comms.protocol's bands)
+    # identifies the PROCESS that wrote this stream, so it wins over the
+    # solver's per-agent robot ids even when agent spans outnumber the
+    # plane's barrier/boot spans.
+    plane = {r: n for r, n in tally.items() if r <= -100 or r == -5}
+    if plane:
+        home = max(plane, key=plane.get)
+    else:
+        home = max(tally, key=tally.get) if tally else None
     return Stream(path=path, events=events, truncated=truncated,
                   robots=robots, home=home)
 
@@ -152,11 +161,13 @@ def estimate_offsets(streams: list[Stream]) -> dict:
 
     Reference choice: the stream owning the bus hub (robot -1) when
     present — every robot exchanges with the hub, so it is the natural
-    center of the sample graph — else the stream owning robot 0, else
-    stream 0.  Returns a report dict (per-stream offset, uncertainty,
-    sample counts, pair diagnostics)."""
+    center of the sample graph — else the fleet launcher/manager
+    (actor -5: it exchanges spawn/harvest/heartbeat samples with every
+    rank and replica), else the stream owning robot 0, else stream 0.
+    Returns a report dict (per-stream offset, uncertainty, sample
+    counts, pair diagnostics)."""
     robot_of = robot_stream_map(streams)
-    ref = robot_of.get(-1, robot_of.get(0, 0))
+    ref = robot_of.get(-1, robot_of.get(-5, robot_of.get(0, 0)))
     deltas = pairwise_deltas(streams, robot_of)
 
     # Symmetric pair estimates: offset o[j] - o[i] for each sampled pair.
@@ -369,24 +380,45 @@ _PHASE_TID = {"compute": 0, "comms": 1, "solve": 2, "eval": 2, "serve": 4}
 _TID_NAMES = {0: "compute", 1: "comms", 2: "solver", 3: "events",
               4: "serving"}
 
-#: Events rendered as instants on the timeline.
+#: Events rendered as instants on the timeline.  The fleet plane
+#: (ISSUE 20) adds process/generation lifecycle instants — a kill -9
+#: renders as ``process_lost`` on the victim's own track.
 _INSTANT_EVENTS = ("peer_lost", "solve_start", "solve_end", "run_start",
-                   "run_end", "agent_state", "overlap_decision")
+                   "run_end", "agent_state", "overlap_decision",
+                   "process_lost", "generation_start", "generation_end",
+                   "generation_postmortem", "replica_postmortem",
+                   "verdict_publish")
 
 #: The device-attribution track (ISSUE 16): ``device_attribution``
 #: events carry window-relative XLA op slices; they render as their own
 #: process with one thread per device lane, far above the robot pids.
 _PID_DEVICE = 1000
 
+#: Fleet-plane track bands (ISSUE 20), mirroring the actor-id bands in
+#: ``comms.protocol``: the launcher/manager (actor -5) gets its own
+#: track, multihost rank r (actor -100-r) the 300 band, out-of-process
+#: replica i (actor -200-i) the 500 band — all visually separated from
+#: robots (2+) and below/around the device track.
+_PID_LAUNCHER = 200
+_PID_RANK_BASE = 300
+_PID_REPLICA_BASE = 500
+
 
 def _pid(robot) -> int:
-    """Track id: 0 = host/driver, 1 = bus hub, 2+r = robot r.  The
-    serving-plane origin sentinels (<= -3, ``comms.protocol.ORIGIN_SERVE_*``)
-    map onto the host track — serve spans carry no robot, so their flow
-    arrows must start where the spans render."""
+    """Track id: 0 = host/driver, 1 = bus hub, 2+r = robot r, plus the
+    fleet bands above.  The serving-plane origin sentinels (-3/-4,
+    ``comms.protocol.ORIGIN_SERVE_*``) map onto the host track — serve
+    spans carry no robot, so their flow arrows must start where the
+    spans render."""
     if robot is None:
         return 0
     robot = int(robot)
+    if robot <= -200:
+        return _PID_REPLICA_BASE + (-robot - 200)
+    if robot <= -100:
+        return _PID_RANK_BASE + (-robot - 100)
+    if robot == -5:
+        return _PID_LAUNCHER
     if robot <= -3:
         return 0
     return 1 if robot < 0 else 2 + robot
@@ -397,6 +429,12 @@ def _pid_name(pid: int) -> str:
         return "host"
     if pid == 1:
         return "bus"
+    if pid == _PID_LAUNCHER:
+        return "launcher"
+    if _PID_RANK_BASE <= pid < _PID_REPLICA_BASE:
+        return f"rank {pid - _PID_RANK_BASE}"
+    if pid >= _PID_REPLICA_BASE:
+        return f"replica {pid - _PID_REPLICA_BASE}"
     return f"robot {pid - 2}"
 
 

@@ -56,6 +56,7 @@ CLI (also the README quickstart)::
 from __future__ import annotations
 
 import argparse
+import contextlib
 import dataclasses
 import json
 import os
@@ -70,6 +71,8 @@ from pathlib import Path
 import numpy as np
 
 from .. import obs
+from ..comms.protocol import ORIGIN_FLEET_PARENT, mh_rank_actor
+from ..obs.trace import emit_span
 from .resilience import MeshFaultError, shrink_mesh_size
 
 #: Worker exit codes the launcher classifies (anything else is a crash).
@@ -169,6 +172,12 @@ class MultihostWorld:
     def _word_key(self, seq: int, rank: int) -> str:
         return f"dpgo/mh/g{self.generation}/s{seq}/r{rank}"
 
+    def _stamp_key(self, seq: int, rank: int) -> str:
+        # Telemetry-only clock stamps ride their own key family: with
+        # telemetry off these keys are never written and the KV/barrier
+        # traffic is byte-identical to the uninstrumented protocol.
+        return f"dpgo/mh/g{self.generation}/c{seq}/r{rank}"
+
     def _barrier_id(self, seq: int) -> str:
         return f"dpgo/mh/g{self.generation}/b{seq}"
 
@@ -184,7 +193,23 @@ class MultihostWorld:
         payload = f"{int(it)}:{int(word)}"
         timeout_s = self.cfg.first_barrier_timeout_s if seq == 0 \
             else self.cfg.barrier_timeout_s
+        run = obs.get_run()
+        actor = mh_rank_actor(self.rank) if run is not None else None
+        if run is not None:
+            # The verdict_publish event is this rank's own durable copy
+            # of what it pushed to the KV store — the launcher's
+            # postmortem harvester decodes a SIGKILLed rank's last word
+            # from here.  The clock stamp key (c-family) pairs the
+            # barrier round-trip into clock_sample samples below.
+            run.event("verdict_publish", phase="comms", robot=actor,
+                      seq_boundary=seq, iteration=int(it),
+                      word=int(word),
+                      key=self._word_key(seq, self.rank))
+            self.client.key_value_set(
+                self._stamp_key(seq, self.rank),
+                f"{time.monotonic()}:{time.time()}")
         self.client.key_value_set(self._word_key(seq, self.rank), payload)
+        t0_mono, t0_wall = time.monotonic(), time.time()
         try:
             self.client.wait_at_barrier(self._barrier_id(seq),
                                         int(timeout_s * 1000))
@@ -194,6 +219,33 @@ class MultihostWorld:
                 f"(iteration {it}): barrier {self._barrier_id(seq)!r} "
                 f"timed out after {timeout_s:g}s",
                 phase="verdict_sync", kind="process_lost") from e
+        if run is not None:
+            emit_span(run, "barrier_wait", t0_mono, t0_wall,
+                      time.monotonic() - t0_mono, phase="comms",
+                      robot=actor, seq_boundary=seq,
+                      generation=self.generation)
+            # Post-barrier every telemetry-on peer's stamp exists: the
+            # controller samples every rank's clock and every rank
+            # samples the controller's — bidirectional pairs for the
+            # merged-timeline offset solve.  Fail-open (short timeout)
+            # so a telemetry-off peer can't stall a telemetry-on one.
+            peers = [r for r in range(self.world_size) if r != self.rank] \
+                if self.rank == 0 else [0]
+            for r in peers:
+                try:
+                    raw = self.client.blocking_key_value_get(
+                        self._stamp_key(seq, r), 2000)
+                    if isinstance(raw, bytes):
+                        raw = raw.decode("utf-8", "replace")
+                    mono_s, wall_s = raw.split(":")
+                    run.event("clock_sample", phase="comms",
+                              src=mh_rank_actor(r), dst=actor,
+                              channel="coord_kv", kind="barrier",
+                              seq_boundary=seq,
+                              t_send_mono=float(mono_s),
+                              t_send_wall=float(wall_s))
+                except Exception:
+                    pass
         if self.rank != 0:
             # The barrier just proved rank 0 published; the get is a
             # KV read of an existing key, not a second wait.
@@ -253,7 +305,13 @@ def _solve_problem(args):
 
 def run_worker(args) -> int:
     """``--worker`` entry: join the world, run the lockstep solve, write
-    a result (or structured fault) record, exit with a classifiable rc."""
+    a result (or structured fault) record, exit with a classifiable rc.
+
+    With ``--telemetry-dir`` (threaded by the launcher) the whole worker
+    runs inside its own generation-scoped ``TelemetryRun`` — the per-rank
+    stream the launcher harvests and merges after the generation ends,
+    SIGKILL or not (events.jsonl is flushed per line; the harvest is
+    tail-tolerant)."""
     import jax
 
     # Mirror tests/conftest.py: the environment's sitecustomize may
@@ -262,12 +320,47 @@ def run_worker(args) -> int:
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
 
+    boot = (time.monotonic(), time.time())
+    if getattr(args, "telemetry_dir", ""):
+        with obs.run_scope(args.telemetry_dir):
+            return _worker_main(args, boot)
+    return _worker_main(args, boot)
+
+
+def _worker_main(args, boot) -> int:
+    import jax
+
     cfg = WorldConfig(coordinator=args.coordinator, world_size=args.world,
                       rank=args.rank, generation=args.generation,
                       barrier_timeout_s=args.barrier_timeout,
                       first_barrier_timeout_s=args.first_barrier_timeout,
                       init_timeout_s=args.init_timeout)
     world = MultihostWorld.join(cfg)
+
+    run = obs.get_run()
+    if run is not None:
+        actor = mh_rank_actor(world.rank)
+        run.set_fingerprint(plane="multihost", rank=world.rank,
+                            generation=world.generation,
+                            world_size=world.world_size)
+        # Pair the launcher's spawn stamp with this (receive-side) event:
+        # the forward leg of the launcher<->rank clock sample; the
+        # harvester emits the reverse leg off the result record's stamp.
+        if getattr(args, "launch_stamp", ""):
+            try:
+                mono_s, wall_s = args.launch_stamp.split(",")
+                run.event("clock_sample", phase="comms",
+                          src=ORIGIN_FLEET_PARENT, dst=actor,
+                          channel="spawn", kind="launch",
+                          t_send_mono=float(mono_s),
+                          t_send_wall=float(wall_s))
+            except (ValueError, IndexError):
+                pass
+        # The boot span anchors this stream's home to the rank's actor
+        # id even for a 1-rank world that never crosses a barrier.
+        emit_span(run, "worker_boot", boot[0], boot[1],
+                  time.monotonic() - boot[0], phase="comms", robot=actor,
+                  rank=world.rank, generation=world.generation)
 
     from ..config import AgentParams
     from ..models import rbcd
@@ -304,12 +397,19 @@ def run_worker(args) -> int:
 
     # Count driver-loop host syncs through the sanctioned seam, the same
     # shim as tests/test_mesh_resilience.py: the lockstep must not add
-    # any (it rides words already fetched).
-    fetches = [0]
+    # any (it rides words already fetched).  The coordination-rate metric
+    # counts ONLY the packed verdict words (the scalar readbacks) — the
+    # telemetry plane's recurring lazy-history fetch is the single-host
+    # telemetry cost the solver's own gauge already accounts for, so
+    # ``host_syncs_per_100_rounds`` stays pinned at 100/K whether the
+    # rank runs instrumented (harvested) or dark.
+    fetches = [0, 0]  # [total, scalar verdict words]
     orig_fetch = rbcd._host_fetch
 
     def counting_fetch(x):
         fetches[0] += 1
+        if getattr(x, "ndim", None) == 0:
+            fetches[1] += 1
         return orig_fetch(x)
 
     # The rank's mesh spans its LOCAL devices only.  With jax.distributed
@@ -335,7 +435,16 @@ def run_worker(args) -> int:
             "ok": False, "kind": e.kind, "phase": e.phase,
             "rank": world.rank, "generation": world.generation,
             "world_size": world.world_size,
-            "boundaries": world.boundaries, "error": str(e)})
+            "boundaries": world.boundaries, "error": str(e),
+            "t_record_mono": time.monotonic(),
+            "t_record_wall": time.time()})
+        if run is not None and getattr(args, "telemetry_dir", ""):
+            # os._exit skips the run_scope teardown; finalize this
+            # rank's run artifacts so the harvest sees a closed stream.
+            try:
+                obs.end_run()
+            except Exception:
+                pass
         sys.stdout.flush()
         sys.stderr.flush()
         # A peer is gone: the coordination service cannot complete a
@@ -347,9 +456,12 @@ def run_worker(args) -> int:
         rbcd._host_fetch = orig_fetch
 
     rounds = args.rounds - resume_iteration
-    # Driver-loop fetches exclude the one terminal-epilogue transfer
-    # (rbcd._emit_sync_rate's convention).
-    loop_fetches = max(fetches[0] - 1, 0)
+    # The sync-rate metric counts the scalar verdict-word fetches only
+    # (one per K-round boundary; the terminal epilogue and the
+    # telemetry-on lazy-history legs are pytree transfers, so they never
+    # land in the word tally) — rbcd._emit_sync_rate's convention for
+    # the raw total still governs the solver's own gauge.
+    loop_fetches = fetches[1]
     _write_json(args.out, {
         "ok": True, "rank": world.rank, "generation": world.generation,
         "world_size": world.world_size, "mesh_size": args.mesh_size,
@@ -367,7 +479,9 @@ def run_worker(args) -> int:
         "rounds_executed": int(rounds),
         "host_syncs_per_100_rounds":
             100.0 * loop_fetches / max(rounds, 1),
-        "wall_s": round(time.monotonic() - t0, 3)})
+        "wall_s": round(time.monotonic() - t0, 3),
+        "t_record_mono": time.monotonic(),
+        "t_record_wall": time.time()})
     return 0
 
 
@@ -408,7 +522,8 @@ def launch_world(procs: int = 2, *, robots: int = 8, mesh_size: int = 2,
                  kill_after_s: float | None = None,
                  max_generations: int = 3,
                  worker_timeout_s: float = 1800.0,
-                 session: str = "multihost-solve") -> dict:
+                 session: str = "multihost-solve",
+                 telemetry_dir: str | None = None) -> dict:
     """Run one multihost solve to completion, across generations.
 
     Spawns ``procs`` worker processes joined by ``jax.distributed``; if
@@ -419,7 +534,14 @@ def launch_world(procs: int = 2, *, robots: int = 8, mesh_size: int = 2,
     them on the shrunken world with ``resume=True`` — the supervisor
     restores the newest v2 checkpoint from the shared store and the
     solve continues.  Returns the final generation's controller record
-    plus the per-generation fault ledger."""
+    plus the per-generation fault ledger.
+
+    With ``telemetry_dir`` the launcher opens its own run there (unless
+    one is already ambient), hands every rank a generation-scoped run
+    directory, harvests every rank's stream after each generation
+    (``generation_postmortem`` + ``process_lost`` forensics — the
+    SIGKILLed rank's tail survives it), and merges launcher + all ranks
+    into ONE validated Chrome trace (``summary["telemetry"]``)."""
     if robots % mesh_size != 0:
         raise ValueError(f"mesh_size {mesh_size} must divide robots "
                          f"{robots}")
@@ -428,93 +550,164 @@ def launch_world(procs: int = 2, *, robots: int = 8, mesh_size: int = 2,
     checkpoint_dir = workdir / "checkpoints"
     repo_root = Path(__file__).resolve().parents[2]
 
-    world = int(procs)
-    generations = []
-    gen = 0
-    while True:
-        port = _free_port()
-        outs, log_files, procs_list = [], [], []
-        for rank in range(world):
-            out = workdir / f"g{gen}-r{rank}.json"
-            log = workdir / f"g{gen}-r{rank}.log"
-            outs.append(out)
-            cmd = [sys.executable, "-m", "dpgo_tpu.parallel.multihost",
-                   "--worker", "--rank", str(rank), "--world", str(world),
-                   "--coordinator", f"127.0.0.1:{port}",
-                   "--generation", str(gen),
-                   "--robots", str(robots), "--mesh-size", str(mesh_size),
-                   "--n", str(n), "--num-lc", str(num_lc),
-                   "--noise", str(noise), "--seed", str(seed),
-                   "--rounds", str(rounds),
-                   "--verdict-every", str(verdict_every),
-                   "--checkpoint-dir", str(checkpoint_dir),
-                   "--session", session, "--out", str(out),
-                   "--barrier-timeout", str(barrier_timeout_s),
-                   "--first-barrier-timeout", str(first_barrier_timeout_s),
-                   "--init-timeout", str(init_timeout_s)]
+    from ..obs import fleetobs
+
+    tel_root = Path(telemetry_dir).resolve() if telemetry_dir else None
+    if tel_root is not None:
+        tel_root.mkdir(parents=True, exist_ok=True)
+    rank_dirs_all: list = []   # every generation's per-rank run dirs
+    summary: dict | None = None
+
+    with contextlib.ExitStack() as stack:
+        run = obs.get_run()
+        launcher_dir = None
+        if tel_root is not None and run is None:
+            launcher_dir = tel_root / "launcher"
+            run = stack.enter_context(obs.run_scope(str(launcher_dir)))
+        elif run is not None:
+            launcher_dir = Path(run.run_dir)
+        if run is not None:
+            run.set_fingerprint(plane="multihost", role="launcher",
+                                procs=int(procs))
+
+        world = int(procs)
+        generations = []
+        gen = 0
+        while True:
+            port = _free_port()
+            outs, log_files, procs_list = [], [], []
+            gen_rank_dirs: dict = {}
+            if run is not None:
+                run.event("generation_start", phase="fleet",
+                          generation=gen, world_size=world)
+            for rank in range(world):
+                out = workdir / f"g{gen}-r{rank}.json"
+                log = workdir / f"g{gen}-r{rank}.log"
+                outs.append(out)
+                cmd = [sys.executable, "-m",
+                       "dpgo_tpu.parallel.multihost",
+                       "--worker", "--rank", str(rank),
+                       "--world", str(world),
+                       "--coordinator", f"127.0.0.1:{port}",
+                       "--generation", str(gen),
+                       "--robots", str(robots),
+                       "--mesh-size", str(mesh_size),
+                       "--n", str(n), "--num-lc", str(num_lc),
+                       "--noise", str(noise), "--seed", str(seed),
+                       "--rounds", str(rounds),
+                       "--verdict-every", str(verdict_every),
+                       "--checkpoint-dir", str(checkpoint_dir),
+                       "--session", session, "--out", str(out),
+                       "--barrier-timeout", str(barrier_timeout_s),
+                       "--first-barrier-timeout",
+                       str(first_barrier_timeout_s),
+                       "--init-timeout", str(init_timeout_s)]
+                if gen == 0 and kill_rank is not None \
+                        and kill_at_boundary is not None:
+                    cmd += ["--kill-rank", str(kill_rank),
+                            "--kill-at-boundary", str(kill_at_boundary)]
+                if tel_root is not None:
+                    rank_dir = fleetobs.generation_run_dir(
+                        tel_root, gen, rank)
+                    gen_rank_dirs[rank] = rank_dir
+                    # Stamped immediately before the spawn: the forward
+                    # leg of the launcher<->rank clock pairing.
+                    cmd += ["--telemetry-dir", rank_dir,
+                            "--launch-stamp",
+                            f"{time.monotonic()},{time.time()}"]
+                env = dict(os.environ)
+                env["JAX_PLATFORMS"] = "cpu"
+                env["XLA_FLAGS"] = (
+                    env.get("XLA_FLAGS", "")
+                    + " --xla_force_host_platform_device_count="
+                    + str(mesh_size)
+                ).strip()
+                env["PYTHONPATH"] = str(repo_root) + (
+                    os.pathsep + env["PYTHONPATH"]
+                    if env.get("PYTHONPATH") else "")
+                lf = open(log, "w")
+                log_files.append(lf)
+                procs_list.append(subprocess.Popen(
+                    cmd, env=env, stdout=lf, stderr=subprocess.STDOUT,
+                    cwd=str(repo_root)))
+
             if gen == 0 and kill_rank is not None \
-                    and kill_at_boundary is not None:
-                cmd += ["--kill-rank", str(kill_rank),
-                        "--kill-at-boundary", str(kill_at_boundary)]
-            env = dict(os.environ)
-            env["JAX_PLATFORMS"] = "cpu"
-            env["XLA_FLAGS"] = (
-                env.get("XLA_FLAGS", "")
-                + f" --xla_force_host_platform_device_count={mesh_size}"
-            ).strip()
-            env["PYTHONPATH"] = str(repo_root) + (
-                os.pathsep + env["PYTHONPATH"]
-                if env.get("PYTHONPATH") else "")
-            lf = open(log, "w")
-            log_files.append(lf)
-            procs_list.append(subprocess.Popen(
-                cmd, env=env, stdout=lf, stderr=subprocess.STDOUT,
-                cwd=str(repo_root)))
+                    and kill_after_s is not None \
+                    and kill_at_boundary is None:
+                time.sleep(kill_after_s)
+                if procs_list[kill_rank].poll() is None:
+                    procs_list[kill_rank].send_signal(signal.SIGKILL)
 
-        if gen == 0 and kill_rank is not None and kill_after_s is not None \
-                and kill_at_boundary is None:
-            time.sleep(kill_after_s)
-            if procs_list[kill_rank].poll() is None:
-                procs_list[kill_rank].send_signal(signal.SIGKILL)
+            deadline = time.monotonic() + worker_timeout_s
+            rcs = []
+            for p in procs_list:
+                try:
+                    p.wait(timeout=max(deadline - time.monotonic(), 1.0))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+                rcs.append(p.returncode)
+            for lf in log_files:
+                lf.close()
 
-        deadline = time.monotonic() + worker_timeout_s
-        rcs = []
-        for p in procs_list:
-            try:
-                p.wait(timeout=max(deadline - time.monotonic(), 1.0))
-            except subprocess.TimeoutExpired:
-                p.kill()
-                p.wait()
-            rcs.append(p.returncode)
-        for lf in log_files:
-            lf.close()
+            records = [_read_json(o) for o in outs]
+            faults = [r for r in records
+                      if r is not None and not r.get("ok", False)]
+            outcomes = [_classify(rc) for rc in rcs]
+            gen_entry = {"generation": gen, "world_size": world,
+                         "rcs": list(rcs), "outcomes": outcomes,
+                         "faults": faults}
+            generations.append(gen_entry)
+            if run is not None:
+                run.event("generation_end", phase="fleet",
+                          generation=gen, world_size=world,
+                          outcomes=outcomes)
+                # Fail-open forensics: every rank's stream harvested,
+                # the victim's tail + last published verdict included.
+                fleetobs.harvest_generation(
+                    run, gen, gen_rank_dirs,
+                    outcomes={r: outcomes[r] for r in gen_rank_dirs},
+                    records={r: records[r] for r in gen_rank_dirs
+                             if r < len(records)},
+                    plane="multihost", lost_actor=mh_rank_actor)
+                rank_dirs_all.extend(gen_rank_dirs.values())
 
-        records = [_read_json(o) for o in outs]
-        faults = [r for r in records
-                  if r is not None and not r.get("ok", False)]
-        gen_entry = {"generation": gen, "world_size": world,
-                     "rcs": list(rcs),
-                     "outcomes": [_classify(rc) for rc in rcs],
-                     "faults": faults}
-        generations.append(gen_entry)
+            if all(rc == 0 for rc in rcs):
+                result = records[0]
+                if result is None or not result.get("ok"):
+                    raise RuntimeError(
+                        f"generation {gen}: all ranks exited 0 but the "
+                        f"controller record at {outs[0]} is "
+                        f"missing/faulted")
+                summary = {"result": result, "generations": generations,
+                           "world_sizes": [g["world_size"]
+                                           for g in generations],
+                           "recovered": gen > 0,
+                           "workdir": str(workdir)}
+                break
 
-        if all(rc == 0 for rc in rcs):
-            result = records[0]
-            if result is None or not result.get("ok"):
+            if gen + 1 >= max_generations:
                 raise RuntimeError(
-                    f"generation {gen}: all ranks exited 0 but the "
-                    f"controller record at {outs[0]} is missing/faulted")
-            return {"result": result, "generations": generations,
-                    "world_sizes": [g["world_size"] for g in generations],
-                    "recovered": gen > 0,
-                    "workdir": str(workdir)}
+                    f"multihost solve failed after {gen + 1} "
+                    f"generations: "
+                    f"{[g['outcomes'] for g in generations]}")
+            world = shrink_world(world, robots) if world > 1 else world
+            gen += 1
 
-        if gen + 1 >= max_generations:
-            raise RuntimeError(
-                f"multihost solve failed after {gen + 1} generations: "
-                f"{[g['outcomes'] for g in generations]}")
-        world = shrink_world(world, robots) if world > 1 else world
-        gen += 1
+    # The launcher run (if this call opened one) is finalized here; the
+    # merged generation timeline spans launcher + every rank of every
+    # generation — the kill shows up as a process_lost instant on the
+    # victim's own track.
+    if tel_root is not None and launcher_dir is not None:
+        try:
+            trace_info = fleetobs.write_fleet_trace(
+                [str(launcher_dir)] + [str(d) for d in rank_dirs_all],
+                str(tel_root / "fleet_trace.json"))
+            summary["telemetry"] = {"dir": str(tel_root), **trace_info}
+        except Exception as e:
+            summary["telemetry"] = {"dir": str(tel_root),
+                                    "error": f"{type(e).__name__}: {e}"}
+    return summary
 
 
 # ---------------------------------------------------------------------------
@@ -524,7 +717,21 @@ def launch_world(procs: int = 2, *, robots: int = 8, mesh_size: int = 2,
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m dpgo_tpu.parallel.multihost",
-        description="Multi-process mesh solve with kill -9 recovery")
+        description="Multi-process mesh solve with kill -9 recovery",
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+        epilog=(
+            "worker exit codes (the launcher classifies these per rank "
+            "in the final outcome line):\n"
+            f"  {EXIT_PROCESS_LOST}  process_lost: a peer died — the "
+            "verdict-boundary barrier timed out\n"
+            f"  {EXIT_DESYNC}  desync: replicated lockstep broke — "
+            "verdict words diverged from rank 0\n"
+            "  -N  signal:<name>: the worker was killed by signal N "
+            "(e.g. the kill -9 chaos levers)\n\n"
+            "on success the launcher prints ONE machine-readable JSON "
+            "line: world sizes, recovery,\nper-rank outcome "
+            "classifications per generation, solve result fields, and "
+            "(with\n--telemetry-dir) the merged-trace location."))
     p.add_argument("--procs", type=int, default=2,
                    help="world size (worker processes) for generation 0")
     p.add_argument("--robots", type=int, default=8)
@@ -539,6 +746,12 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rounds", type=int, default=24)
     p.add_argument("--verdict-every", type=int, default=4)
     p.add_argument("--workdir", default=None)
+    p.add_argument("--telemetry-dir", default="",
+                   help="enable fleet telemetry rooted here: launcher "
+                        "run + per-rank generation-scoped runs, "
+                        "post-generation harvest, and ONE merged Chrome "
+                        "trace at <dir>/fleet_trace.json (in worker "
+                        "mode: this rank's own run directory)")
     p.add_argument("--session", default="multihost-solve")
     p.add_argument("--barrier-timeout", type=float, default=20.0)
     p.add_argument("--first-barrier-timeout", type=float, default=600.0)
@@ -561,6 +774,7 @@ def _build_parser() -> argparse.ArgumentParser:
                    help=argparse.SUPPRESS)
     p.add_argument("--checkpoint-dir", default="", help=argparse.SUPPRESS)
     p.add_argument("--out", default="", help=argparse.SUPPRESS)
+    p.add_argument("--launch-stamp", default="", help=argparse.SUPPRESS)
     return p
 
 
@@ -579,17 +793,30 @@ def main(argv=None) -> int:
         init_timeout_s=args.init_timeout,
         kill_rank=kill_rank, kill_at_boundary=kill_at,
         kill_after_s=args.kill_after,
-        max_generations=args.max_generations, session=args.session)
+        max_generations=args.max_generations, session=args.session,
+        telemetry_dir=args.telemetry_dir or None)
     res = summary["result"]
-    print(json.dumps({
+    # ONE machine-readable line (json.loads-able whether callers read
+    # the whole file or the last line) — the scripting/CI contract.
+    outcome = {
         "world_sizes": summary["world_sizes"],
         "recovered": summary["recovered"],
+        "generations": [{"generation": g["generation"],
+                         "world_size": g["world_size"],
+                         "outcomes": g["outcomes"]}
+                        for g in summary["generations"]],
         "resume_iteration": res["resume_iteration"],
         "final_cost": res["final_cost"],
         "iterations": res["iterations"],
         "host_syncs_per_100_rounds": res["host_syncs_per_100_rounds"],
         "boundaries": res["boundaries"],
-        "workdir": summary["workdir"]}, indent=2))
+        "workdir": summary["workdir"]}
+    if "telemetry" in summary:
+        tel = summary["telemetry"]
+        outcome["telemetry"] = {
+            k: tel[k] for k in ("dir", "trace", "streams", "spans",
+                                "flows", "pids", "error") if k in tel}
+    print(json.dumps(outcome, sort_keys=True))
     return 0
 
 

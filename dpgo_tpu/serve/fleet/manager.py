@@ -71,7 +71,8 @@ class ReplicaManager:
                  scale_up_burn: float = 1.0,
                  scale_down_burn: float = 0.05,
                  scale_cooldown_s: float = 2.0,
-                 min_scale_observations: int = 8):
+                 min_scale_observations: int = 8,
+                 metrics_port: int | None = None):
         if min_replicas < 1:
             raise ValueError("min_replicas must be >= 1")
         self.make_server = make_server
@@ -87,6 +88,10 @@ class ReplicaManager:
         self.scale_down_burn = float(scale_down_burn)
         self.scale_cooldown_s = float(scale_cooldown_s)
         self.min_scale_observations = int(min_scale_observations)
+        self.metrics_port = metrics_port
+        #: Fleet-level ``/metrics`` + ``/statusz`` aggregator; constructed
+        #: in ``start()`` behind the telemetry fence (None when off).
+        self.sidecar = None
 
         self._lock = threading.Lock()
         self._replicas: list[Replica] = []  # guarded-by: _lock
@@ -137,6 +142,10 @@ class ReplicaManager:
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="dpgo-fleet-monitor", daemon=True)
         self._monitor.start()
+        if self.metrics_port is not None:
+            from ...obs import fleetobs
+            self.sidecar = fleetobs.attach_fleet_sidecar(
+                fleetobs.ReplicaFleetSource(self), port=self.metrics_port)
 
     def spawn(self, reason: str = "manual") -> Replica:
         with self._lock:
@@ -308,6 +317,12 @@ class ReplicaManager:
                 return
             self._closed = True
         self._stop.set()
+        if self.sidecar is not None:
+            try:
+                self.sidecar.close()
+            except Exception:
+                pass
+            self.sidecar = None
         if self._monitor is not None:
             self._monitor.join(timeout=10.0)
         for replica in self.replicas():

@@ -54,7 +54,10 @@ from pathlib import Path
 
 import numpy as np
 
-from ...comms.protocol import DEFAULT_MAX_FRAME_BYTES, ProtocolError
+from ... import obs
+from ...comms.protocol import (DEFAULT_MAX_FRAME_BYTES, ORIGIN_FLEET_PARENT,
+                               ProtocolError, attach_clock, pop_clock,
+                               proc_replica_actor)
 from ...comms.transport import (TcpTransport, TransportClosed,
                                 TransportTimeout, connect_tcp)
 from ..server import OverCapacityError
@@ -152,13 +155,17 @@ class ProcServer:
                  heartbeat_s: float = DEFAULT_HEARTBEAT_S,
                  heartbeat_misses: int = DEFAULT_HEARTBEAT_MISSES,
                  max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
-                 workdir: str | None = None):
+                 workdir: str | None = None,
+                 telemetry_dir: str | None = None):
         self.replica_id = replica_id
         self.max_queue = int(max_queue)
         self.host = host
         self.heartbeat_s = float(heartbeat_s)
         self.heartbeat_misses = int(heartbeat_misses)
         self.max_frame_bytes = int(max_frame_bytes)
+        self.telemetry_dir = telemetry_dir
+        self.child_metrics_port: int | None = None
+        self._lost_emitted = False
 
         self._lock = threading.Lock()
         self._tickets: dict[int, ProcTicket] = {}  # guarded-by: _lock
@@ -186,6 +193,11 @@ class ProcServer:
             cmd += ["--session-store", str(session_store)]
         if resume_sessions:
             cmd += ["--resume-sessions"]
+        if telemetry_dir is not None:
+            # The child runs inside its own TelemetryRun there (its
+            # sidecar port comes back through the port file); the parent
+            # harvests the directory post-mortem on a replica death.
+            cmd += ["--telemetry-dir", str(telemetry_dir)]
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         repo_root = str(Path(__file__).resolve().parents[3])
@@ -215,7 +227,10 @@ class ProcServer:
                     f"before binding (log: {self._log_path})")
             try:
                 with open(port_file) as fh:
-                    return int(json.load(fh)["port"])
+                    record = json.load(fh)
+                if record.get("metrics_port"):
+                    self.child_metrics_port = int(record["metrics_port"])
+                return int(record["port"])
             except (OSError, ValueError, KeyError):
                 time.sleep(0.05)
         self.proc.kill()
@@ -297,14 +312,39 @@ class ProcServer:
         with self._lock:
             self._tickets.pop(id(ticket), None)
 
+    @property
+    def metrics_url(self) -> str | None:
+        """The CHILD's ``/metrics`` scrape URL (its sidecar only exists
+        when the child got a telemetry dir), or None."""
+        if self.child_metrics_port is None:
+            return None
+        return f"http://{self.host}:{self.child_metrics_port}/metrics"
+
     # -- heartbeat ----------------------------------------------------------
 
     def _beat_once(self) -> dict | None:
-        """One status poll; None on any failure."""
+        """One status poll; None on any failure.
+
+        With telemetry on the poll doubles as the procs-plane clock
+        channel: the request carries the parent's ``attach_clock`` stamp
+        (the child's front end emits the forward ``clock_sample``), and
+        the child stamps its status reply (the reverse sample emitted
+        here) — bidirectional parent<->replica pairs at the heartbeat
+        cadence.  Telemetry off: no stamp, byte-identical wire."""
         from ..frontend import _pack_str
 
+        run = obs.get_run()
+        frame = {"op": _pack_str("status")}
+        if run is not None:
+            attach_clock(frame, ORIGIN_FLEET_PARENT)
         try:
-            reply = self._rpc({"op": _pack_str("status")}, timeout=2.0)
+            reply = self._rpc(frame, timeout=2.0)
+            ts = pop_clock(reply)
+            if run is not None and ts is not None:
+                run.event("clock_sample", phase="comms", src=ts[0],
+                          dst=ORIGIN_FLEET_PARENT, channel="heartbeat",
+                          kind="status_reply", t_send_mono=ts[1],
+                          t_send_wall=ts[2])
             if not int(np.asarray(reply["ok"])):
                 return None
             return json.loads(_unpack_str(reply["status"]))
@@ -312,10 +352,35 @@ class ProcServer:
             return None
 
     def _heartbeat_loop(self) -> None:
+        run = obs.get_run()
+        rid = str(self.replica_id)
+        if run is not None:
+            # Satellite: the status-poll fields the parent already
+            # fetches become per-replica labeled gauges instead of
+            # liveness-only bookkeeping.
+            g_queue = run.gauge("fleet_replica_queue_depth",
+                                "child admission queue depth per replica")
+            g_inflight = run.gauge("fleet_replica_in_flight",
+                                   "in-flight requests per replica")
+            g_draining = run.gauge("fleet_replica_draining",
+                                   "1 while the replica is draining")
+            g_accepting = run.gauge("fleet_replica_accepting",
+                                    "1 while the replica accepts work")
+            g_misses = run.gauge("fleet_replica_heartbeat_misses",
+                                 "consecutive missed heartbeats")
         while not self._stop.wait(self.heartbeat_s):
             if self.proc.poll() is not None:
                 with self._lock:
                     self._beat_misses = self.heartbeat_misses
+                    closed = self._closed
+                if run is not None and not closed \
+                        and not self._lost_emitted:
+                    # An unrequested child death (kill -9, OOM, crash):
+                    # the instant lands on the REPLICA's own timeline
+                    # track, and whatever the child's run directory
+                    # still holds is harvested post-mortem.
+                    self._lost_emitted = True
+                    self._emit_process_lost(run, rid)
                 continue  # dead child: keep reporting it until close()
             st = self._beat_once()
             with self._lock:
@@ -324,6 +389,38 @@ class ProcServer:
                 else:
                     self._beat_misses = 0
                     self._child_status = st
+                misses = self._beat_misses
+                inflight = len(self._tickets)
+            if run is not None and st is not None:
+                tenant_inflight = sum(
+                    t.get("in_flight", 0)
+                    for t in st.get("tenants", {}).values())
+                g_queue.set(st.get("queue_depth", 0) or 0, replica=rid)
+                g_inflight.set(tenant_inflight + inflight, replica=rid)
+                g_draining.set(1.0 if st.get("draining") else 0.0,
+                               replica=rid)
+                g_accepting.set(1.0 if st.get("accepting", True) else 0.0,
+                                replica=rid)
+                g_misses.set(misses, replica=rid)
+            elif run is not None:
+                g_misses.set(misses, replica=rid)
+
+    def _emit_process_lost(self, run, rid: str) -> None:
+        try:
+            post = None
+            if self.telemetry_dir:
+                from ...obs import fleetobs
+
+                post = fleetobs.harvest_run_dir(self.telemetry_dir)
+            run.event("process_lost", phase="comms",
+                      robot=proc_replica_actor(rid), replica=rid,
+                      plane="procs", pid=self.proc.pid,
+                      rc=self.proc.returncode)
+            if post is not None:
+                run.event("replica_postmortem", phase="fleet",
+                          replica=rid, **post)
+        except Exception:
+            pass  # forensics are fail-open by contract
 
     # -- server surface (Replica/FleetRouter contract) ----------------------
 
@@ -380,6 +477,10 @@ class ProcServer:
         if self.proc.poll() is None:
             self.proc.kill()
         self.proc.wait()
+        run = obs.get_run()
+        if run is not None and not self._lost_emitted:
+            self._lost_emitted = True
+            self._emit_process_lost(run, str(self.replica_id))
         self._shutdown_threads()
 
     def close(self, drain: bool = False) -> None:
@@ -425,37 +526,70 @@ class ProcServer:
 
 def _run_child(args) -> int:
     """The replica process: an ordinary ``SolveServer`` behind an
-    ordinary ``ServeFrontend``, plus the port-file handshake."""
+    ordinary ``ServeFrontend``, plus the port-file handshake.
+
+    With ``--telemetry-dir`` the whole child runs inside its own
+    ``TelemetryRun``: its statusz sidecar binds an OS-assigned port
+    (reported back through the port file for the fleet aggregator to
+    scrape), a ``ResourceSampler`` feeds the soak-gate series, and a
+    boot span homes this stream to the replica's timeline actor."""
+    import contextlib
+
     import jax
 
     jax.config.update("jax_enable_x64", True)
 
-    from ..frontend import ServeFrontend
-    from ..server import SolveServer
+    boot = (time.monotonic(), time.time())
+    scope = obs.run_scope(args.telemetry_dir) if args.telemetry_dir \
+        else contextlib.nullcontext()
+    with scope:
+        from ..frontend import ServeFrontend
+        from ..server import SolveServer
 
-    server = SolveServer(
-        max_batch=args.max_batch, max_queue=args.max_queue,
-        batch_window_s=args.batch_window,
-        replica_id=args.replica_id or None,
-        aot_cache_dir=args.aot_cache,
-        session_store=args.session_store,
-        session_every=args.session_every,
-        resume_sessions=args.resume_sessions)
-    frontend = ServeFrontend(server, host=args.host, port=0)
-    record = {"port": int(frontend.port), "pid": os.getpid()}
-    tmp = args.port_file + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(record, fh)
-    os.replace(tmp, args.port_file)
+        run = obs.get_run()
+        server = SolveServer(
+            max_batch=args.max_batch, max_queue=args.max_queue,
+            batch_window_s=args.batch_window,
+            replica_id=args.replica_id or None,
+            aot_cache_dir=args.aot_cache,
+            session_store=args.session_store,
+            session_every=args.session_every,
+            resume_sessions=args.resume_sessions,
+            metrics_port=0 if run is not None else None)
+        sampler = None
+        if run is not None:
+            from ...obs.fleetobs import start_resource_sampler
+            from ...obs.trace import emit_span
 
-    stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *_: stop.set())
-    stop.wait()
-    frontend.close()
-    try:
-        server.kill()  # immediate: queued work reroutes on the parent side
-    except Exception:
-        pass
+            rid = args.replica_id or "r"
+            run.set_fingerprint(plane="procs", replica=rid,
+                                pid=os.getpid())
+            emit_span(run, "replica_boot", boot[0], boot[1],
+                      time.monotonic() - boot[0], phase="serve",
+                      robot=proc_replica_actor(rid), replica=rid)
+            sampler = start_resource_sampler(
+                run=run,
+                queue_depth=lambda: server.status().get("queue_depth", 0),
+                replica=rid)
+        frontend = ServeFrontend(server, host=args.host, port=0)
+        record = {"port": int(frontend.port), "pid": os.getpid()}
+        if server.sidecar is not None:
+            record["metrics_port"] = int(server.sidecar.port)
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(record, fh)
+        os.replace(tmp, args.port_file)
+
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        stop.wait()
+        frontend.close()
+        if sampler is not None:
+            sampler.close()
+        try:
+            server.kill()  # immediate: queued work reroutes parent-side
+        except Exception:
+            pass
     return 0
 
 
@@ -476,6 +610,10 @@ def _build_parser():
     ap.add_argument("--session-store", default=None)
     ap.add_argument("--session-every", type=int, default=1)
     ap.add_argument("--resume-sessions", action="store_true")
+    ap.add_argument("--telemetry-dir", default="",
+                    help="run the child inside its own TelemetryRun "
+                         "rooted here (statusz sidecar port reported "
+                         "via the port file)")
     return ap
 
 

@@ -24,8 +24,9 @@ import numpy as np
 
 from .. import obs
 from ..comms.protocol import (DEFAULT_MAX_FRAME_BYTES, ORIGIN_SERVE_CLIENT,
-                              ProtocolError, pack_measurements,
-                              pack_trace_entries, unpack_measurements,
+                              ProtocolError, attach_clock, pack_measurements,
+                              pack_trace_entries, pop_clock,
+                              proc_replica_actor, unpack_measurements,
                               unpack_trace_entries)
 from ..comms.transport import (TcpTransport, TransportClosed,
                                TransportTimeout, connect_tcp, listen_tcp)
@@ -54,9 +55,18 @@ def handle_request(server: SolveServer, frame: dict) -> dict:
     admission span then nests under it, so the Perfetto timeline runs
     from TCP receive to reply on one trace id."""
     ctx = unpack_trace_entries(frame)
+    # Channel-level clock stamp (the procs heartbeat wire): popped
+    # unconditionally so mixed telemetry-on/off peers interoperate;
+    # recorded as the forward clock_sample only with a run on.
+    ts = pop_clock(frame)
     run = obs.get_run()
     if run is None:
         return _handle_request(server, frame, None)
+    if ts is not None:
+        run.event("clock_sample", phase="comms", src=ts[0],
+                  dst=proc_replica_actor(server.replica_id or "r"),
+                  channel="heartbeat", kind="status_poll",
+                  t_send_mono=ts[1], t_send_wall=ts[2])
     sp = obs_trace.Span(run, "frontend", phase="serve",
                         trace_id=ctx[0] if ctx is not None else None,
                         link=ctx)
@@ -163,10 +173,16 @@ def _handle_request(server: SolveServer, frame: dict, ctx) -> dict:
     if op == "status":
         # The fleet heartbeat: the replica's operational snapshot, JSON-
         # encoded (mixed scalar types) inside one uint8 frame entry.
+        # With telemetry on the reply carries this replica's clock stamp
+        # — the reverse leg of the heartbeat's clock_sample pair.
         try:
-            return {"ok": np.int8(1),
-                    "status": _pack_str(json.dumps(server.status(),
-                                                   default=str))}
+            reply = {"ok": np.int8(1),
+                     "status": _pack_str(json.dumps(server.status(),
+                                                    default=str))}
+            if obs.get_run() is not None:
+                attach_clock(reply,
+                             proc_replica_actor(server.replica_id or "r"))
+            return reply
         except Exception as e:
             return {"ok": np.int8(0),
                     "error": _pack_str(f"{type(e).__name__}: {e}")}

@@ -387,6 +387,15 @@ class SolveServer:
                 self._profiler.close()
             raise
 
+    @property
+    def metrics_url(self) -> str | None:
+        """This replica's ``/metrics`` scrape URL, or None when the
+        sidecar is off (no run / no ``metrics_port``) — the per-replica
+        target a fleet-level aggregator merges."""
+        if self.sidecar is None:
+            return None
+        return f"http://{self.sidecar.host}:{self.sidecar.port}/metrics"
+
     # -- client API ---------------------------------------------------------
 
     def submit(self, request: SolveRequest) -> SolveTicket:

@@ -182,6 +182,85 @@ def test_status_op_returns_replica_snapshot_over_tcp():
     assert st["replica"]["replica_id"] == "p7"
 
 
+def test_status_reply_wire_is_byte_identical_with_telemetry_off():
+    """DPG005 symmetry (ISSUE 20): telemetry off = no clock stamp on the
+    heartbeat wire in either direction."""
+    assert obs.get_run() is None
+    with SolveServer(max_batch=2, batch_window_s=0.0,
+                     replica_id="r0") as srv:
+        reply = handle_request(srv, {"op": _pack_str("status")})
+    assert int(np.asarray(reply["ok"])) == 1
+    assert "_ts" not in reply
+
+
+def test_status_poll_pairs_clocks_with_telemetry_on(tmp_path):
+    """Satellite (a)/(d) groundwork: a stamped status poll is popped and
+    recorded as the forward clock_sample, and the reply carries the
+    replica's own stamp — the reverse leg the parent pairs on."""
+    import json as _json
+
+    from dpgo_tpu.comms.protocol import (ORIGIN_FLEET_PARENT, attach_clock,
+                                         pop_clock, proc_replica_actor)
+
+    with obs.run_scope(str(tmp_path / "child")):
+        with SolveServer(max_batch=2, batch_window_s=0.0,
+                         replica_id="r3") as srv:
+            frame = {"op": _pack_str("status")}
+            attach_clock(frame, ORIGIN_FLEET_PARENT)
+            reply = handle_request(srv, frame)
+    assert int(np.asarray(reply["ok"])) == 1
+    ts = pop_clock(reply)
+    assert ts is not None and ts[0] == proc_replica_actor("r3")
+    with open(tmp_path / "child" / "events.jsonl") as fh:
+        evs = [_json.loads(ln) for ln in fh if ln.strip()]
+    (cs,) = [e for e in evs if e["event"] == "clock_sample"]
+    assert cs["src"] == ORIGIN_FLEET_PARENT
+    assert cs["dst"] == proc_replica_actor("r3")
+    assert cs["channel"] == "heartbeat" and cs["kind"] == "status_poll"
+
+
+def test_manager_fleet_sidecar_serves_aggregated_statusz(tmp_path):
+    """The manager's fleet-level sidecar (ISSUE 20): constructed only
+    behind the run fence, it serves the per-replica reachability map
+    over the live pool and closes leak-clean with the manager."""
+    import urllib.request
+
+    from dpgo_tpu.obs import fleetobs
+
+    def make_server(rid):
+        return SolveServer(max_batch=2, batch_window_s=0.0,
+                           replica_id=rid)
+
+    # Telemetry off: no sidecar object, no HTTP thread.
+    mgr = ReplicaManager(make_server, min_replicas=1, metrics_port=0)
+    try:
+        mgr.start()
+        assert mgr.sidecar is None
+    finally:
+        mgr.close()
+
+    with obs.run_scope(str(tmp_path / "mgr")):
+        mgr = ReplicaManager(make_server, min_replicas=2, metrics_port=0)
+        try:
+            mgr.start()
+            assert isinstance(mgr.sidecar, fleetobs.FleetSidecar)
+            url = f"http://{mgr.sidecar.host}:{mgr.sidecar.port}/statusz"
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                st = _read_json_body(resp)
+            assert set(st["replicas"]) == {"r0", "r1"}
+            assert all(e["reachable"] for e in st["replicas"].values())
+            assert st["fleet"]["pool"] == ["r0", "r1"]
+        finally:
+            mgr.close()
+        assert mgr.sidecar is None
+
+
+def _read_json_body(resp):
+    import json as _json
+
+    return _json.loads(resp.read().decode())
+
+
 def test_drain_op_evacuates_and_finishes_waiters(meas):
     """The drain op must reply to every blocked in-flight RPC with the
     structured closed shed (reroute me), not leave handler threads

@@ -1,0 +1,525 @@
+"""Fleet-wide observability (``obs.fleetobs``, ISSUE 20): resource
+sampling behind the telemetry fence, fail-open cross-process harvest +
+crash forensics, clock-offset recovery over the fleet planes' stamp
+channels, the aggregated ``/metrics``/``/statusz`` sidecar with dead
+replicas MARKED (never fatal), ``report --live --fleet``'s partial
+view, and ``regress --soak``'s flat-memory gate."""
+
+import io
+import json
+import os
+import threading
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+from dpgo_tpu import obs
+from dpgo_tpu.comms.protocol import (ORIGIN_FLEET_PARENT, attach_clock,
+                                     mh_rank_actor, pop_clock,
+                                     proc_replica_actor)
+from dpgo_tpu.obs import fleetobs, timeline
+from dpgo_tpu.obs.exporters import (merge_prometheus_texts,
+                                    relabel_prometheus_text,
+                                    validate_prometheus_text)
+from dpgo_tpu.obs.regress import soak_memory_gate
+from dpgo_tpu.obs.report import live_report
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_run():
+    obs.end_run()
+    yield
+    obs.end_run()
+
+
+def _read_events(run_dir):
+    path = os.path.join(str(run_dir), "events.jsonl")
+    with open(path) as fh:
+        return [json.loads(ln) for ln in fh if ln.strip()]
+
+
+# ---------------------------------------------------------------------------
+# Actor bands + the channel clock codec
+# ---------------------------------------------------------------------------
+
+def test_actor_bands_are_disjoint():
+    assert mh_rank_actor(0) == -100 and mh_rank_actor(3) == -103
+    assert proc_replica_actor("r0") == -200
+    assert proc_replica_actor("r7") == -207
+    assert proc_replica_actor(2) == -202
+    # Non-numeric ids still land inside the replica band, deterministic.
+    a = proc_replica_actor("weird-id")
+    assert a == proc_replica_actor("weird-id") and -297 <= a <= -200
+    assert ORIGIN_FLEET_PARENT == -5
+
+
+def test_attach_pop_clock_round_trip_and_fail_open():
+    frame = {"x": np.zeros(3)}
+    attach_clock(frame, ORIGIN_FLEET_PARENT)
+    ts = pop_clock(frame)
+    assert ts is not None and ts[0] == ORIGIN_FLEET_PARENT
+    assert ts[1] > 0.0 and ts[2] > 0.0
+    assert "_ts" not in frame and set(frame) == {"x"}
+    # Unstamped: pop is a no-op None; mangled: dropped, never fatal.
+    assert pop_clock({"x": 1}) is None
+    assert pop_clock({"_ts": np.zeros(0)}) is None
+
+
+# ---------------------------------------------------------------------------
+# ResourceSampler (stdlib-only, fenced, leakcheck-clean start/stop)
+# ---------------------------------------------------------------------------
+
+def test_sample_resources_reads_this_process():
+    s = fleetobs.sample_resources()
+    assert s["threads"] >= 1
+    if os.path.isdir("/proc/self/fd"):
+        assert s["open_fds"] >= 3
+    assert s["rss_bytes"] is None or s["rss_bytes"] > 1 << 20
+
+
+def test_resource_sampler_fence_returns_none_without_run():
+    assert obs.get_run() is None
+    before = threading.active_count()
+    assert fleetobs.start_resource_sampler() is None
+    assert threading.active_count() == before
+
+
+def test_resource_sampler_emits_gauges_and_soak_series(tmp_path):
+    """Satellite (d): the sampler thread starts and stops leakcheck-clean
+    (the plugin asserts no leaked thread after the test) and its samples
+    land both as labeled gauges and as ``metric`` events."""
+    with obs.run_scope(str(tmp_path / "run")) as run:
+        sampler = fleetobs.start_resource_sampler(
+            interval_s=60.0, queue_depth=lambda: 5, replica="r0")
+        assert isinstance(sampler, fleetobs.ResourceSampler)
+        sampler.sample_once()
+        assert sampler.samples >= 1
+        g = run.registry.gauge("process_threads")
+        assert g.value(replica="r0") >= 1
+        assert run.registry.gauge("serve_queue_depth_sampled").value(
+            replica="r0") == 5.0
+        sampler.close()
+        assert not sampler._thread.is_alive()
+    evs = _read_events(tmp_path / "run")
+    rss = [e for e in evs if e.get("metric") == "process_rss_bytes"]
+    assert rss and all(e["replica"] == "r0" and e["phase"] == "fleet"
+                       for e in rss)
+
+
+# ---------------------------------------------------------------------------
+# Harvest + crash forensics
+# ---------------------------------------------------------------------------
+
+def _fake_rank_dir(tmp_path, name, actor, word=None, torn=False):
+    """A hand-built worker run dir: a homing span, optionally the last
+    published verdict, optionally a torn final line (SIGKILL mid-write)."""
+    d = tmp_path / name
+    d.mkdir(parents=True)
+    lines = [
+        {"event": "span", "name": "worker_boot", "phase": "comms",
+         "robot": actor, "t0_mono": 1.0, "t0_wall": 100.0, "dur_s": 0.01,
+         "t_mono": 1.01, "t_wall": 100.01, "seq": 0},
+    ]
+    if word is not None:
+        lines.append({"event": "verdict_publish", "phase": "comms",
+                      "robot": actor, "seq_boundary": 2, "iteration": 8,
+                      "word": word, "key": "dpgo/mh/g0/s2/r1",
+                      "t_mono": 2.0, "t_wall": 101.0, "seq": 1})
+    with open(d / "events.jsonl", "w") as fh:
+        for ln in lines:
+            fh.write(json.dumps(ln) + "\n")
+        if torn:
+            fh.write('{"event": "span", "name": "iter')  # killed mid-write
+    return str(d)
+
+
+def test_harvest_run_dir_is_fail_open():
+    out = fleetobs.harvest_run_dir("/nonexistent/run-dir")
+    assert out["events"] == 0 and out["tail"] == []
+    assert "error" in out
+
+
+def test_harvest_run_dir_torn_tail_and_last_verdict(tmp_path):
+    from dpgo_tpu.models.rbcd import VERDICT_RUNNING, pack_verdict
+
+    word = int(pack_verdict(VERDICT_RUNNING))
+    d = _fake_rank_dir(tmp_path, "g0-r1", mh_rank_actor(1), word=word,
+                       torn=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        out = fleetobs.harvest_run_dir(d)
+    assert out["truncated"] is True and out["events"] == 2
+    assert out["tail"][-1]["event"] == "verdict_publish"
+    lv = out["last_verdict"]
+    assert lv["word"] == word and lv["seq"] == 2 and lv["iteration"] == 8
+    assert lv["decoded"]["status"] == "running"
+
+
+def test_harvest_generation_emits_postmortem_and_process_lost(tmp_path):
+    from dpgo_tpu.models.rbcd import VERDICT_RUNNING, pack_verdict
+
+    word = int(pack_verdict(VERDICT_RUNNING))
+    d0 = _fake_rank_dir(tmp_path, "g0-r0", mh_rank_actor(0))
+    d1 = _fake_rank_dir(tmp_path, "g0-r1", mh_rank_actor(1), word=word,
+                        torn=True)
+    with obs.run_scope(str(tmp_path / "launcher")) as run:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            post = fleetobs.harvest_generation(
+                run, 0, {0: d0, 1: d1},
+                outcomes={0: "process_lost", 1: "signal:SIGKILL"},
+                records={0: {"ok": False, "kind": "process_lost",
+                             "t_record_mono": 3.0, "t_record_wall": 103.0}},
+                plane="multihost", lost_actor=mh_rank_actor)
+    assert set(post["ranks"]) == {"0", "1"}
+    assert post["ranks"]["1"]["last_verdict"]["word"] == word
+    assert post["ranks"]["0"]["record"]["kind"] == "process_lost"
+    evs = _read_events(tmp_path / "launcher")
+    (pm,) = [e for e in evs if e["event"] == "generation_postmortem"]
+    assert pm["plane"] == "multihost" and "1" in pm["ranks"]
+    # The SIGKILLed rank gets the instant on ITS OWN track; the survivor
+    # (process_lost = orderly structured exit) does not.
+    (lost,) = [e for e in evs if e["event"] == "process_lost"]
+    assert lost["robot"] == mh_rank_actor(1) and lost["rank"] == 1
+    assert lost["last_event"] == "verdict_publish"
+    # Reverse launcher<->rank clock leg off the record stamp.
+    (cs,) = [e for e in evs if e["event"] == "clock_sample"]
+    assert cs["src"] == mh_rank_actor(0) and cs["channel"] == "harvest"
+    # The harvest span anchors the launcher stream's identity.
+    assert any(e.get("event") == "span"
+               and e.get("name") == "harvest_generation"
+               and e.get("robot") == ORIGIN_FLEET_PARENT for e in evs)
+
+
+def test_harvest_generation_fence_returns_none_without_run(tmp_path):
+    assert fleetobs.harvest_generation(None, 0, {0: str(tmp_path)}) is None
+
+
+# ---------------------------------------------------------------------------
+# Clock-offset recovery across the fleet stamp channels (satellite d)
+# ---------------------------------------------------------------------------
+
+def _write_events(d, lines):
+    d.mkdir(parents=True)
+    with open(d / "events.jsonl", "w") as fh:
+        for ln in lines:
+            fh.write(json.dumps(ln) + "\n")
+    return str(d)
+
+
+def test_clock_offset_recovered_across_heartbeat_wire(tmp_path):
+    """A child replica whose monotonic clock reads 5 s AHEAD of the
+    parent's: bidirectional heartbeat stamp pairs recover the skew
+    (latency cancels) within tolerance; a second, send-only replica is
+    used latency-biased and flagged ``bidirectional: false``."""
+    SKEW, LAT = 5.0, 0.010
+    launcher = ORIGIN_FLEET_PARENT
+    child, oneway = proc_replica_actor("r0"), proc_replica_actor("r1")
+    parent_lines = [{"event": "span", "name": "harvest", "phase": "fleet",
+                     "robot": launcher, "t0_mono": 0.0, "t0_wall": 1e5,
+                     "dur_s": 0.01, "t_mono": 0.01, "t_wall": 1e5,
+                     "seq": 0}]
+    child_lines = [{"event": "span", "name": "replica_boot",
+                    "phase": "comms", "robot": child,
+                    "t0_mono": SKEW, "t0_wall": 1e5, "dur_s": 0.01,
+                    "t_mono": SKEW + 0.01, "t_wall": 1e5, "seq": 0}]
+    oneway_lines = [{"event": "span", "name": "replica_boot",
+                     "phase": "comms", "robot": oneway,
+                     "t0_mono": 2.0, "t0_wall": 1e5, "dur_s": 0.01,
+                     "t_mono": 2.01, "t_wall": 1e5, "seq": 0}]
+    for k in range(6):
+        t = 1.0 + 0.1 * k  # true (parent-clock) send instant
+        # Parent -> child: received on the child's skewed clock.
+        child_lines.append({
+            "event": "clock_sample", "phase": "comms", "src": launcher,
+            "dst": child, "channel": "heartbeat", "kind": "status_poll",
+            "t_send_mono": t, "t_mono": t + LAT + SKEW,
+            "t_wall": 1e5, "seq": k + 1})
+        # Child -> parent: the status-reply stamp, popped by the parent.
+        parent_lines.append({
+            "event": "clock_sample", "phase": "comms", "src": child,
+            "dst": launcher, "channel": "heartbeat",
+            "kind": "status_reply", "t_send_mono": t + LAT / 2 + SKEW,
+            "t_mono": t + LAT * 1.5, "t_wall": 1e5, "seq": k + 1})
+        # One-way replica: the parent hears it, it never hears back.
+        parent_lines.append({
+            "event": "clock_sample", "phase": "comms", "src": oneway,
+            "dst": launcher, "channel": "heartbeat",
+            "kind": "status_reply", "t_send_mono": t, "t_mono": t + LAT,
+            "t_wall": 1e5, "seq": 100 + k})
+    p = _write_events(tmp_path / "parent", parent_lines)
+    c = _write_events(tmp_path / "child", child_lines)
+    o = _write_events(tmp_path / "oneway", oneway_lines)
+    tl = timeline.merge([p, c, o])
+    # The parent stream is the reference (actor -5 beats robot homing).
+    assert tl.offsets["reference"] == p
+    by_path = {s["path"]: s for s in tl.offsets["streams"]}
+    assert by_path[c]["offset_s"] == pytest.approx(SKEW, abs=0.01)
+    assert by_path[c]["aligned"] and by_path[o]["aligned"]
+    flags = {tuple(sorted(pr["streams"])): pr["bidirectional"]
+             for pr in tl.offsets["pairs"]}
+    assert flags[tuple(sorted((p, c)))] is True
+    assert flags[tuple(sorted((p, o)))] is False
+    # Rebased: the child's span now sits near parent t=5->0.
+    boot = [e for e in tl.events if e.get("name") == "replica_boot"
+            and e.get("robot") == child]
+    assert boot[0]["t0_mono"] == pytest.approx(0.0, abs=0.02)
+
+
+def test_fleet_trace_merges_onto_plane_tracks(tmp_path):
+    """Launcher + victim + survivor streams merge into ONE validated
+    Chrome trace with the launcher/rank tracks separated and the kill
+    visible as a ``process_lost`` instant on the victim's track."""
+    launcher = ORIGIN_FLEET_PARENT
+    r0, r1 = mh_rank_actor(0), mh_rank_actor(1)
+    lead = _write_events(tmp_path / "launcher", [
+        {"event": "span", "name": "harvest_generation", "phase": "fleet",
+         "robot": launcher, "t0_mono": 3.0, "t0_wall": 1e5, "dur_s": 0.05,
+         "t_mono": 3.05, "t_wall": 1e5, "seq": 0},
+        {"event": "generation_start", "generation": 0, "world_size": 2,
+         "t_mono": 0.5, "t_wall": 1e5, "seq": 1},
+        {"event": "process_lost", "robot": r1, "rank": 1,
+         "outcome": "signal:SIGKILL", "plane": "multihost",
+         "t_mono": 3.01, "t_wall": 1e5, "seq": 2},
+    ])
+    surv = _write_events(tmp_path / "g0-r0", [
+        {"event": "span", "name": "worker_boot", "phase": "comms",
+         "robot": r0, "t0_mono": 1.0, "t0_wall": 1e5, "dur_s": 0.2,
+         "t_mono": 1.2, "t_wall": 1e5, "seq": 0},
+        {"event": "span", "name": "barrier_wait", "phase": "comms",
+         "robot": r0, "seq_boundary": 0, "t0_mono": 2.0, "t0_wall": 1e5,
+         "dur_s": 0.03, "t_mono": 2.03, "t_wall": 1e5, "seq": 1},
+    ])
+    vict = _write_events(tmp_path / "g0-r1", [
+        {"event": "span", "name": "worker_boot", "phase": "comms",
+         "robot": r1, "t0_mono": 1.1, "t0_wall": 1e5, "dur_s": 0.2,
+         "t_mono": 1.3, "t_wall": 1e5, "seq": 0},
+        {"event": "verdict_publish", "robot": r1, "seq_boundary": 0,
+         "iteration": 4, "word": 17, "key": "dpgo/mh/g0/s0/r1",
+         "t_mono": 2.0, "t_wall": 1e5, "seq": 1},
+    ])
+    out = str(tmp_path / "fleet_trace.json")
+    info = fleetobs.write_fleet_trace(
+        [lead, surv, vict, str(tmp_path / "never-wrote-events")], out)
+    assert info["trace"] == out and info["streams"] == 3
+    assert info["spans"] >= 4
+    with open(out) as fh:
+        trace = json.load(fh)
+    names = {e["pid"]: e["args"]["name"]
+             for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert names[200] == "launcher"
+    assert names[300] == "rank 0" and names[301] == "rank 1"
+    lost = [e for e in trace["traceEvents"]
+            if e.get("ph") == "i" and e["name"] == "process_lost"]
+    assert lost and lost[0]["pid"] == 301  # the victim's own track
+    pubs = [e for e in trace["traceEvents"]
+            if e.get("ph") == "i" and e["name"] == "verdict_publish"]
+    assert pubs and pubs[0]["pid"] == 301
+
+
+# ---------------------------------------------------------------------------
+# Prometheus merge + the aggregated fleet sidecar
+# ---------------------------------------------------------------------------
+
+CHILD_TEXT = """# HELP solve_requests_total requests
+# TYPE solve_requests_total counter
+solve_requests_total{tenant="a"} 3
+# HELP process_rss_bytes rss
+# TYPE process_rss_bytes gauge
+process_rss_bytes 1048576
+"""
+
+
+def test_merge_prometheus_texts_labels_children_not_parent():
+    parent = ("# HELP fleet_replica_queue_depth q\n"
+              "# TYPE fleet_replica_queue_depth gauge\n"
+              'fleet_replica_queue_depth{replica="r0"} 2\n')
+    merged = merge_prometheus_texts(
+        {"": parent, "r0": CHILD_TEXT, "r1": CHILD_TEXT})
+    counts = validate_prometheus_text(merged)
+    assert counts["families"] == 3 and counts["samples"] == 5
+    # Child samples get replica labels; the parent's pass through as-is.
+    assert 'solve_requests_total{replica="r0",tenant="a"} 3' in merged
+    assert 'process_rss_bytes{replica="r1"} 1048576' in merged
+    assert 'fleet_replica_queue_depth{replica="r0"} 2' in merged
+    assert 'replica=""' not in merged
+    # Family-grouped: exactly one header per family.
+    assert merged.count("# TYPE process_rss_bytes gauge") == 1
+
+
+def test_relabel_preserves_existing_labels():
+    out = relabel_prometheus_text(CHILD_TEXT, {"replica": "r9"})
+    assert 'solve_requests_total{replica="r9",tenant="a"} 3' in out
+    validate_prometheus_text(out)
+
+
+class _FakeReplicaServer:
+    """Just enough server surface for the fleet source: a status dict
+    and an optional child ``/metrics`` URL."""
+
+    def __init__(self, rid, metrics_url=None, status=None, boom=False):
+        self.replica_id = rid
+        self.metrics_url = metrics_url
+        self._status = status or {"accepting": True, "queue_depth": 1,
+                                  "requests_served": 4}
+        self._boom = boom
+
+    def status(self):
+        if self._boom:
+            raise ConnectionResetError("child socket gone")
+        return dict(self._status)
+
+
+class _ChildScrapeServer:
+    """A real HTTP endpoint serving a fixed Prometheus text — stands in
+    for one child replica's MetricsSidecar."""
+
+    def __init__(self, text):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        body = text.encode()
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self.url = "http://127.0.0.1:%d/metrics" % self.httpd.server_address[1]
+        self._t = threading.Thread(target=self.httpd.serve_forever,
+                                   daemon=True)
+        self._t.start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._t.join(timeout=5.0)
+
+
+def test_fleet_sidecar_fence_returns_none_without_run():
+    assert obs.get_run() is None
+    src = fleetobs.ServersFleetSource([])
+    assert fleetobs.attach_fleet_sidecar(src) is None
+
+
+def test_fleet_sidecar_aggregates_and_marks_dead_replicas(tmp_path):
+    """Acceptance: the aggregated ``/metrics`` line-validates with the
+    parent's per-replica gauges plus each live child's samples
+    relabeled; a dead replica drops out of the merge and is MARKED
+    unreachable in ``/statusz`` — the scrape never 500s (satellite c)."""
+    import urllib.error
+
+    child = _ChildScrapeServer(CHILD_TEXT)
+    try:
+        with obs.run_scope(str(tmp_path / "run")) as run:
+            run.gauge("fleet_replica_queue_depth", "q").set(
+                2.0, replica="r0")
+            servers = [
+                _FakeReplicaServer("r0", metrics_url=child.url),
+                _FakeReplicaServer("r1", metrics_url="http://127.0.0.1:9/m",
+                                   boom=True),
+                _FakeReplicaServer("r2", status={"accepting": False,
+                                                 "closed": True}),
+            ]
+            with fleetobs.attach_fleet_sidecar(
+                    fleetobs.ServersFleetSource(servers),
+                    scrape_timeout_s=0.5) as sidecar:
+                assert isinstance(sidecar, fleetobs.FleetSidecar)
+                base = f"http://{sidecar.host}:{sidecar.port}"
+                with urllib.request.urlopen(base + "/metrics",
+                                            timeout=10) as r:
+                    text = r.read().decode()
+                counts = validate_prometheus_text(text)
+                assert counts["samples"] >= 3
+                assert 'fleet_replica_queue_depth{replica="r0"} 2' in text
+                assert ('solve_requests_total{replica="r0",tenant="a"} 3'
+                        in text)
+                assert 'process_rss_bytes{replica="r0"} 1048576' in text
+
+                with urllib.request.urlopen(base + "/statusz",
+                                            timeout=10) as r:
+                    st = json.load(r)
+                assert st["fleet"] == {"replicas": 3}
+                reps = st["replicas"]
+                assert reps["r0"]["reachable"] is True
+                assert reps["r1"]["reachable"] is False
+                assert "ConnectionResetError" in reps["r1"]["error"]
+                assert reps["r2"]["reachable"] is False  # closed = dead
+
+                # Satellite (c): report --live renders the PARTIAL fleet
+                # view with the dead replicas marked — rc 0, not rc 2.
+                out = io.StringIO()
+                rc = live_report(f"{sidecar.host}:{sidecar.port}", out=out)
+                assert rc == 0
+                txt = out.getvalue()
+                assert "1/3 reachable" in txt
+                assert "replica r1: ** UNREACHABLE **" in txt
+                assert "replica r0: queue 1" in txt
+
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(base + "/bogus", timeout=10)
+                assert ei.value.code == 404
+                ei.value.close()
+    finally:
+        child.close()
+
+
+def test_live_report_unreachable_aggregate_is_rc2(capsys):
+    rc = live_report("127.0.0.1:9", timeout=0.5, out=io.StringIO())
+    assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# regress --soak: the flat-memory gate over the sampler series
+# ---------------------------------------------------------------------------
+
+def _soak_run(tmp_path, name, series):
+    d = tmp_path / name
+    with obs.run_scope(str(d)) as run:
+        for who, vals in series.items():
+            for v in vals:
+                run.metric("process_rss_bytes", v, "B", phase="fleet",
+                           replica=who)
+    return str(d)
+
+
+def test_soak_gate_flat_memory_passes(tmp_path):
+    mb = 1 << 20
+    d = _soak_run(tmp_path, "flat", {
+        "r0": [100 * mb + i % 3 * mb for i in range(12)],
+        "r1": [140 * mb] * 12})
+    gate = soak_memory_gate(d)
+    assert gate["rc"] == 0 and gate["regressions"] == []
+    assert gate["series"]["r0"]["regressed"] is False
+
+
+def test_soak_gate_catches_a_leaking_replica(tmp_path):
+    mb = 1 << 20
+    d = _soak_run(tmp_path, "leak", {
+        "r0": [100 * mb] * 12,                              # flat
+        "r1": [100 * mb + i * 20 * mb for i in range(12)]})  # +20MiB/sample
+    gate = soak_memory_gate(d)
+    assert gate["rc"] == 2 and gate["regressions"] == ["r1"]
+    assert gate["series"]["r1"]["growth_bytes"] > 150 * mb
+    # The CLI contract: exit 2 on growth.
+    from dpgo_tpu.obs.regress import main as regress_main
+    assert regress_main(["--soak", d, "--json"]) == 2
+
+
+def test_soak_gate_too_few_samples_is_a_skip_not_a_pass(tmp_path):
+    d = _soak_run(tmp_path, "short", {"r0": [1.0, 2.0, 3.0]})
+    gate = soak_memory_gate(d)
+    assert gate["rc"] == 0
+    assert gate["series"]["r0"]["skipped"] is True
+    e = _soak_run(tmp_path, "empty", {})
+    gate = soak_memory_gate(e)
+    assert gate.get("skipped") is True and "no " in gate["reason"]

@@ -164,6 +164,69 @@ def test_generation_scopes_the_keyspace():
 
 
 # ---------------------------------------------------------------------------
+# Fleet telemetry at the lockstep boundary (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+def test_telemetry_off_keeps_the_kv_wire_byte_identical():
+    """DPG005 symmetry of the boundary instrumentation: with no ambient
+    run, verdict_sync writes EXACTLY the word keys — no clock-stamp
+    c-keys, no extra barrier traffic."""
+    coord = FakeCoord()
+    coord.kv["dpgo/mh/g0/s0/r0"] = "4:123"
+    w = _world(rank=1, world_size=2, client=coord)
+    w.verdict_sync(4, 123)
+    assert set(coord.kv) == {"dpgo/mh/g0/s0/r0", "dpgo/mh/g0/s0/r1"}
+    assert len(coord.barrier_calls) == 1
+
+
+def test_telemetry_on_stamps_and_samples_the_barrier(tmp_path):
+    """With a run on, the boundary publishes its durable verdict_publish
+    copy + a c-key clock stamp, times the barrier as a span, and pairs
+    the controller's stamp into a clock_sample — all on its OWN key
+    family, leaving the word protocol untouched."""
+    import json as _json
+
+    from dpgo_tpu.comms.protocol import mh_rank_actor
+
+    coord = FakeCoord()
+    coord.kv["dpgo/mh/g0/s0/r0"] = "4:123"
+    coord.kv["dpgo/mh/g0/c0/r0"] = "12.5:1000.5"  # controller's stamp
+    w = _world(rank=1, world_size=2, client=coord)
+    with obs.run_scope(str(tmp_path / "r1")):
+        w.verdict_sync(4, 123)
+    assert coord.kv["dpgo/mh/g0/s0/r1"] == "4:123"
+    mono, wall = map(float, coord.kv["dpgo/mh/g0/c0/r1"].split(":"))
+    assert mono > 0 and wall > 0
+    with open(tmp_path / "r1" / "events.jsonl") as fh:
+        evs = [_json.loads(ln) for ln in fh if ln.strip()]
+    (pub,) = [e for e in evs if e["event"] == "verdict_publish"]
+    assert pub["word"] == 123 and pub["robot"] == mh_rank_actor(1)
+    assert pub["key"] == "dpgo/mh/g0/s0/r1"
+    (bw,) = [e for e in evs if e.get("name") == "barrier_wait"]
+    assert bw["robot"] == mh_rank_actor(1) and bw["seq_boundary"] == 0
+    (cs,) = [e for e in evs if e["event"] == "clock_sample"]
+    assert cs["src"] == mh_rank_actor(0) and cs["t_send_mono"] == 12.5
+
+
+def test_telemetry_on_survives_a_stampless_controller(tmp_path):
+    """Mixed telemetry: a telemetry-off peer never writes its c-key; the
+    telemetry-on rank's stamp read fails open and the boundary still
+    completes."""
+    class NoStampCoord(FakeCoord):
+        def blocking_key_value_get(self, key, timeout_ms):
+            if "/c" in key:
+                raise RuntimeError("NOT_FOUND: no stamp")
+            return self.kv[key]
+
+    coord = NoStampCoord()
+    coord.kv["dpgo/mh/g0/s0/r0"] = "4:123"
+    w = _world(rank=1, world_size=2, client=coord)
+    with obs.run_scope(str(tmp_path / "r1")):
+        w.verdict_sync(4, 123)
+    assert w.boundaries == 1
+
+
+# ---------------------------------------------------------------------------
 # World faults vs the checkpoint supervisor
 # ---------------------------------------------------------------------------
 
@@ -249,9 +312,43 @@ def test_kill9_worker_recovers_on_shrunken_world(tmp_path):
     ref = launch_world(1, workdir=str(tmp_path / "ref"), **kw)
     chaos = launch_world(2, workdir=str(tmp_path / "chaos"),
                          kill_rank=1, kill_at_boundary=3,
-                         barrier_timeout_s=10.0, **kw)
+                         barrier_timeout_s=10.0,
+                         telemetry_dir=str(tmp_path / "tel"), **kw)
     assert chaos["recovered"] is True
     assert chaos["world_sizes"] == [2, 1]
+    # ISSUE 20 acceptance: the kill demo yields ONE schema-valid merged
+    # Chrome trace spanning launcher + both ranks + the respawned
+    # generation, with the kill as a process_lost instant on the
+    # victim's own track and the victim's harvested tail in the
+    # generation_postmortem.
+    tel = chaos["telemetry"]
+    assert "error" not in tel, tel
+    assert tel["streams"] == 4  # launcher + g0 r0/r1 + g1 r0
+    # Pid bands: launcher (200) + one track per RANK (300/301) — the
+    # respawned generation-1 rank 0 continues on rank 0's track, its
+    # presence visible as a second worker_boot span with generation 1.
+    assert tel["spans"] > 0 and tel["pids"] == 3
+    import json as _json
+
+    with open(tel["trace"]) as fh:
+        trace = _json.load(fh)
+    lost = [e for e in trace["traceEvents"]
+            if e.get("ph") == "i" and e["name"] == "process_lost"]
+    assert lost and all(e["pid"] == 301 for e in lost)  # rank 1's track
+    boots = [e for e in trace["traceEvents"]
+             if e.get("ph") == "X" and e["name"] == "worker_boot"]
+    assert {b["args"].get("generation") for b in boots
+            if b["pid"] == 300} == {0, 1}
+    with open(tmp_path / "tel" / "launcher" / "events.jsonl") as fh:
+        levs = [_json.loads(ln) for ln in fh if ln.strip()]
+    pms = [e for e in levs if e["event"] == "generation_postmortem"]
+    assert len(pms) == 2  # one per generation
+    victim = pms[0]["ranks"]["1"]
+    assert victim["outcome"] == "signal:SIGKILL"
+    assert victim["events"] > 0 and victim["tail"]
+    assert victim["last_verdict"] is not None
+    # Clock alignment found a bidirectional path to every rank stream.
+    assert all(s["aligned"] for s in tel["clock"]["streams"])
     gen0 = chaos["generations"][0]
     assert "signal:SIGKILL" in gen0["outcomes"]  # the victim
     assert "process_lost" in gen0["outcomes"]    # the survivor
@@ -259,6 +356,10 @@ def test_kill9_worker_recovers_on_shrunken_world(tmp_path):
     assert faults and all(f["kind"] == "process_lost"
                           and f["phase"] == "verdict_sync" for f in faults)
     res = chaos["result"]
+    # Telemetry + harvest on must not add device syncs: the KV clock
+    # stamps ride the coordination service, not the device.
+    assert res["host_syncs_per_100_rounds"] == \
+        pytest.approx(100.0 / kw["verdict_every"])
     # The victim died at boundary 3 = iteration K*3; generation 1
     # resumed from the controller's checkpoint there, not from zero.
     assert res["resumed"] is True

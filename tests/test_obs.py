@@ -457,6 +457,15 @@ def test_telemetry_off_is_zero_overhead(monkeypatch):
     monkeypatch.setattr(devprof_mod.DeviceTraceWindow, "__init__", boom)
     monkeypatch.setattr(devprof_mod, "profiled_program", boom)
     monkeypatch.setattr(ledger_mod.PerfLedger, "__init__", boom)
+    # ISSUE 20: the fleet-observability layer too — no sampler thread,
+    # no fleet HTTP sidecar, no harvest work with telemetry off.
+    from dpgo_tpu.obs import fleetobs as fleetobs_mod
+    monkeypatch.setattr(fleetobs_mod.ResourceSampler, "__init__", boom)
+    monkeypatch.setattr(fleetobs_mod.FleetSidecar, "__init__", boom)
+    assert fleetobs_mod.start_resource_sampler() is None
+    assert fleetobs_mod.attach_fleet_sidecar(
+        fleetobs_mod.ServersFleetSource([])) is None
+    assert fleetobs_mod.harvest_generation(None, 0, {}) is None
 
     assert obs.get_run() is None
     meas = _tiny_problem()

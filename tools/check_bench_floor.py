@@ -44,7 +44,9 @@ scales >= FLEET_MIN_SCALING (default 1.7) from 1 to 2 replicas, the
 chaos soak lost ZERO sessions while migrating at least one ticket and
 autoscaling at least once, and the cold-start arm served its warm first
 solve with serve_compile_seconds_total exactly 0 (disk hits only — XLA
-never ran on the restarted replica).
+never ran on the restarted replica).  Records whose soak carried the
+resource-sampled flat-memory gate (``rss_flat``, ISSUE 20) must report
+it true with the per-series detail attached.
 
 For a perf-ledger record (``record == "LEDGER"``; the ``report
 --ledger --json`` output, ISSUE 16): every row carries the normalized
@@ -264,6 +266,17 @@ def check_fleet(rec: dict) -> None:
                      f"SIGKILL: {soak}")
             if not soak.get("killed"):
                 fail(f"out-of-process soak names no killed replica: {soak}")
+        # Resource-sampled soaks (ISSUE 20) carry the flat-memory gate:
+        # a record claiming the soak passed while its own RSS series
+        # regressed is a contradiction, not a pass.  Older records
+        # without the field pass unchanged.
+        if "rss_flat" in soak:
+            if soak["rss_flat"] is not True:
+                fail(f"soak RSS series regressed (rss_flat false): "
+                     f"{soak.get('rss_gate')}")
+            if not isinstance(soak.get("rss_gate"), dict):
+                fail(f"soak rss_flat present without rss_gate detail: "
+                     f"{sorted(soak)}")
     cold = rec["cold_start"]
     if not cold.get("skipped"):
         if cold.get("compile_seconds_total") != 0:

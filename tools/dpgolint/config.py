@@ -104,7 +104,8 @@ def project_config() -> Config:
                                  "FlightRecorder", "MetricsSidecar",
                                  "ProfiledExecutable", "ProfilerWindow",
                                  "Span", "DeviceTraceWindow",
-                                 "PerfLedger"],
+                                 "PerfLedger", "ResourceSampler",
+                                 "FleetSidecar"],
                 # Obs-owned modules where construction IS the sanctioned
                 # implementation of the fence (each carries its own boom
                 # test): start_run/run_scope, span()/start_span(),
@@ -119,6 +120,7 @@ def project_config() -> Config:
                     "dpgo_tpu/obs/recorder.py",
                     "dpgo_tpu/obs/devprof.py",
                     "dpgo_tpu/obs/ledger.py",
+                    "dpgo_tpu/obs/fleetobs.py",
                 ],
             },
             "DPG003": {
@@ -224,11 +226,13 @@ def project_config() -> Config:
                         "pack_functions": ["pack_pose_dict",
                                            "pack_pose_arrays",
                                            "pack_trace_entries",
-                                           "pack_measurements"],
+                                           "pack_measurements",
+                                           "attach_clock"],
                         "unpack_functions": ["unpack_pose_dict",
                                              "unpack_pose_arrays",
                                              "unpack_trace_entries",
-                                             "unpack_measurements"],
+                                             "unpack_measurements",
+                                             "pop_clock"],
                     },
                     "dpgo_tpu/comms/reliable.py": {
                         "pack_functions": ["send"],
